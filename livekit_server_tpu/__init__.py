@@ -1,0 +1,23 @@
+"""livekit_server_tpu — a TPU-native real-time media framework.
+
+A brand-new framework with the capabilities of the reference Go SFU
+(suryatmodulus/livekit-server): rooms, participants, selective forwarding
+(simulcast/SVC), active-speaker detection, congestion control, JWT auth,
+multi-node routing, and observability — re-architected TPU-first.
+
+Architecture (see SURVEY.md §7):
+  - Control plane (signaling, rooms, subscriptions, auth, routing) is
+    host-side Python — thin and latency-insensitive, mirroring the seams of
+    the reference's pkg/service + pkg/rtc + pkg/routing layers.
+  - The media data plane — the reference's pkg/sfu goroutine-per-packet hot
+    path (receiver.go:635 forwardRTP, downtrack.go:680 WriteRTP) — is a
+    tick-driven, batched JAX program over `[rooms × tracks × pkts × subs]`
+    tensors: layer selection, SN/TS/codec munging, audio-level mixing, and
+    bandwidth estimation run as vmapped/fused XLA (+Pallas) kernels.
+  - The room axis shards over a `jax.sharding.Mesh` (ICI) for multi-chip
+    scale-out; cross-host signal relay stays on the host control plane.
+"""
+
+from livekit_server_tpu.version import __version__
+
+__all__ = ["__version__"]

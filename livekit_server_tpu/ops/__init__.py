@@ -1,0 +1,17 @@
+"""Pure-JAX kernel math for the media plane.
+
+These are the batched, unit-testable equivalents of the reference's
+pure-logic hot-path components (SURVEY.md §7 step 2):
+
+  - seqnum      — wrap-aware RTP SN/TS arithmetic (pkg/sfu/utils/wraparound.go)
+  - rtpmunger   — SN/TS rewrite with gap compaction (pkg/sfu/rtpmunger.go)
+  - vp8         — VP8 payload-descriptor rewriting (pkg/sfu/codecmunger/vp8.go)
+  - audio       — RFC6464 active-speaker levels (pkg/sfu/audio/audiolevel.go)
+  - selector    — simulcast/SVC layer selection (pkg/sfu/videolayerselector)
+  - allocation  — forwarder bandwidth-allocation algebra (pkg/sfu/forwarder.go)
+  - bwe         — trend detection / channel observation (pkg/sfu/streamallocator)
+  - quality     — E-model connection-quality scoring (pkg/sfu/connectionquality)
+
+Everything here is functional: `update(state, inputs) -> (state, outputs)`,
+jit/vmap/shard_map-friendly, static shapes, int32 modular arithmetic (no x64).
+"""

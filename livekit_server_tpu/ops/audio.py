@@ -1,0 +1,116 @@
+"""Batched RFC 6464 audio-level / active-speaker detection.
+
+Reference parity: pkg/sfu/audio/audiolevel.go:36-134 (windowed loudest-level
+observation with activity weighting and EMA smoothing) and the room
+active-speaker loop Room.audioUpdateWorker / GetActiveSpeakers
+(pkg/rtc/room.go:1278-1316, :254-279).
+
+TPU-first re-design: one state tensor row per track; packet observations
+arrive as per-tick batches and reduce along the packet axis; window
+finalization and EMA smoothing are elementwise over the track axis; room
+top-K speakers are a `lax.top_k` over the room-local track axis. This is the
+"active speaker" batch named in the north star (BASELINE.json).
+
+Levels are RFC 6464 dBov attenuation values in [0, 127]; *smaller is louder*.
+127 ⇒ digital silence.
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+
+SILENT_LEVEL = jnp.float32(127.0)
+
+
+class AudioLevelParams(NamedTuple):
+    """Mirrors config audio params (pkg/config/config.go AudioConfig)."""
+
+    active_level: int = 35        # dBov threshold: <= is active (config.go ActiveLevel)
+    min_percentile: int = 40      # % of window that must be active
+    observe_interval_ms: int = 500  # window length (UpdateInterval)
+    smooth_intervals: int = 2     # EMA horizon (SmoothIntervals)
+
+
+class AudioLevelState(NamedTuple):
+    """Per-track accumulators + smoothed level; fields are [..., T]."""
+
+    smoothed_level: jax.Array   # float32 dBov (127 = silent)
+    window_min: jax.Array       # float32 — loudest (min dBov) level this window
+    active_ms: jax.Array        # int32 — active milliseconds this window
+    window_ms: jax.Array        # int32 — elapsed milliseconds this window
+
+
+def init_state(num_tracks: int) -> AudioLevelState:
+    return AudioLevelState(
+        smoothed_level=jnp.full((num_tracks,), SILENT_LEVEL, jnp.float32),
+        window_min=jnp.full((num_tracks,), SILENT_LEVEL, jnp.float32),
+        active_ms=jnp.zeros((num_tracks,), jnp.int32),
+        window_ms=jnp.zeros((num_tracks,), jnp.int32),
+    )
+
+
+def observe_tick(
+    state: AudioLevelState,
+    params: AudioLevelParams,
+    levels: jax.Array,     # [T, P] int32 dBov per packet (127 if absent)
+    frame_ms: jax.Array,   # [T, P] int32 frame duration per packet
+    valid: jax.Array,      # [T, P] bool
+    tick_ms: jax.Array,    # scalar int32 — wall time advanced this tick
+):
+    """Accumulate one tick of observations and finalize windows that elapsed.
+
+    Equivalent of audiolevel.go Observe() per packet followed by the
+    window-end smoothing, batched over tracks. Returns (new_state,
+    linear_level [T] float32, is_active [T] bool).
+    """
+    lv = jnp.asarray(levels, jnp.float32)
+    dur = jnp.where(valid, jnp.asarray(frame_ms, jnp.int32), 0)
+    active = valid & (lv <= jnp.float32(params.active_level))
+
+    window_min = jnp.minimum(
+        state.window_min, jnp.min(jnp.where(active, lv, SILENT_LEVEL), axis=-1)
+    )
+    active_ms = state.active_ms + jnp.sum(jnp.where(active, dur, 0), axis=-1)
+    window_ms = state.window_ms + jnp.asarray(tick_ms, jnp.int32)
+
+    done = window_ms >= jnp.int32(params.observe_interval_ms)
+    min_active = jnp.int32(params.observe_interval_ms * params.min_percentile // 100)
+    was_active = done & (active_ms >= min_active)
+    # Window level = loudest observed while active (audiolevel.go tracks the
+    # min dBov over the window); inactive windows read as silence.
+    obs = jnp.where(was_active, window_min, SILENT_LEVEL)
+
+    alpha = jnp.float32(1.0 / max(params.smooth_intervals, 1))
+    smoothed = jnp.where(
+        done,
+        state.smoothed_level + (obs - state.smoothed_level) * alpha,
+        state.smoothed_level,
+    )
+    new_state = AudioLevelState(
+        smoothed_level=smoothed,
+        window_min=jnp.where(done, SILENT_LEVEL, window_min),
+        active_ms=jnp.where(done, 0, active_ms),
+        window_ms=jnp.where(done, 0, window_ms),
+    )
+    linear = level_to_linear(smoothed)
+    is_active = smoothed < jnp.float32(params.active_level)
+    return new_state, linear, is_active
+
+
+def level_to_linear(dbov: jax.Array) -> jax.Array:
+    """10^(-dBov/20), with digital silence mapped to 0 (audiolevel.go ConvertAudioLevel)."""
+    lin = jnp.power(10.0, -jnp.asarray(dbov, jnp.float32) / 20.0)
+    return jnp.where(dbov >= 126.5, 0.0, lin)
+
+
+def top_speakers(linear_levels: jax.Array, k: int):
+    """Top-K speakers along the last (track) axis.
+
+    Equivalent of Room.GetActiveSpeakers (room.go:254-279) sort, batched over
+    rooms. Returns (levels [.., k], indices [.., k]); silent tracks have
+    level 0 and should be masked by the caller.
+    """
+    return jax.lax.top_k(linear_levels, k)

@@ -1,0 +1,152 @@
+"""Batched RTP sequence-number / timestamp munging.
+
+Reference parity: pkg/sfu/rtpmunger.go (UpdateAndGetSnTs :183-271, SN-gap
+compaction via RangeMap offsets, PacketDropped, padding synthesis) and the
+source-switch re-anchoring in pkg/sfu/forwarder.go (processSourceSwitch
+:1456-1650). State snapshot/seed mirrors RTPMungerState (rtpmunger.go:53-69).
+
+TPU-first re-design
+-------------------
+The reference runs one stateful munger per (downtrack) with an ordered
+RangeMap of SN exclusion ranges — inherently serial per stream. Here the same
+semantics are expressed as a *tick-batched scan*: each tick delivers up to P
+ordered packets per track; per-subscriber offsets are carried in state
+tensors and updated by a `lax.scan` over the (small, static) packet axis,
+vectorized over the subscriber axis. Gap compaction becomes an increment of
+the per-subscriber SN offset for each dropped current-stream packet — the
+bounded-history reformulation of RangeMap called out in SURVEY.md §7.
+
+All arithmetic is modular int32 (see ops.seqnum): out_sn is 16-bit, out_ts is
+32-bit two's-complement.
+
+Shapes (per track):
+  P = max packets per tick (static), S = max subscribers (static).
+  Packet fields are [P]; masks are [P, S]; state fields are [S].
+
+Masks per (packet, subscriber):
+  forward — packet is sent to the subscriber (selected layer, passes filters)
+  drop    — packet belongs to the subscriber's *current* stream but is
+            dropped (temporal filter / padding-only) ⇒ compact the gap
+            (reference: PacketDropped → RangeMap exclusion)
+  switch  — subscriber switches source stream at this packet ⇒ re-anchor
+            offsets so out SN continues at last_sn+1 and out TS jumps by
+            `switch_ts_jump` (reference: processSourceSwitch)
+Packets that are neither forwarded nor dropped for a subscriber (other
+simulcast layers' packets) do not touch that subscriber's state.
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+
+from livekit_server_tpu.ops import seqnum
+
+
+class MungerState(NamedTuple):
+    """Per-(track, subscriber) munger state; fields are [...,S] int32/bool.
+
+    Serializable checkpoint — the analog of RTPMungerState
+    (pkg/sfu/rtpmunger.go:53-69) used for migration seeding.
+    """
+
+    sn_offset: jax.Array  # mod 2^16: out_sn = in_sn - sn_offset
+    ts_offset: jax.Array  # mod 2^32: out_ts = in_ts - ts_offset
+    last_sn: jax.Array    # last outgoing 16-bit SN
+    last_ts: jax.Array    # last outgoing 32-bit TS
+    started: jax.Array    # bool: offsets are valid
+
+
+def init_state(num_subscribers: int) -> MungerState:
+    z = jnp.zeros((num_subscribers,), jnp.int32)
+    return MungerState(
+        sn_offset=z,
+        ts_offset=z,
+        last_sn=z,
+        last_ts=z,
+        started=jnp.zeros((num_subscribers,), jnp.bool_),
+    )
+
+
+def munge_tick(
+    state: MungerState,
+    pkt_sn: jax.Array,         # [P] int32 (16-bit values)
+    pkt_ts: jax.Array,         # [P] int32 (32-bit values)
+    pkt_valid: jax.Array,      # [P] bool
+    forward: jax.Array,        # [P, S] bool
+    drop: jax.Array,           # [P, S] bool
+    switch: jax.Array,         # [P, S] bool
+    switch_ts_jump: jax.Array, # [P] int32 — TS advance applied at a switch
+):
+    """One tick of SN/TS munging for one track.
+
+    Returns (new_state, out_sn [P,S], out_ts [P,S], send [P,S]).
+    Equivalent of running rtpmunger.go UpdateAndGetSnTs over each forwarded
+    packet and PacketDropped over each dropped one, per subscriber.
+    """
+
+    def step(carry: MungerState, xs):
+        sn, ts, valid, fwd, drp, sw, jump = xs
+        fwd = fwd & valid
+        drp = drp & valid & ~fwd
+        sw = sw & fwd
+
+        # Source switch: continue output SN at last_sn + 1, TS at last_ts + jump.
+        sw_sn_off = seqnum.sub16(sn, seqnum.add16(carry.last_sn, 1))
+        sw_ts_off = seqnum.sub32(ts, seqnum.add32(carry.last_ts, jump))
+        # First packet ever: identity mapping (reference SetLastSnTs seeds
+        # outgoing = incoming on the first packet).
+        fresh = fwd & ~carry.started
+        resync = sw & carry.started
+        sn_offset = jnp.where(resync, sw_sn_off, jnp.where(fresh, 0, carry.sn_offset))
+        ts_offset = jnp.where(resync, sw_ts_off, jnp.where(fresh, 0, carry.ts_offset))
+
+        out_sn = seqnum.sub16(sn, sn_offset)
+        out_ts = seqnum.sub32(ts, ts_offset)
+
+        last_sn = jnp.where(fwd, out_sn, carry.last_sn)
+        last_ts = jnp.where(fwd, out_ts, carry.last_ts)
+        # Gap compaction: dropped current-stream packet ⇒ future out SNs shift
+        # down by one (reference RangeMap exclusion range).
+        sn_offset = jnp.where(drp & carry.started, seqnum.add16(sn_offset, 1), sn_offset)
+        started = carry.started | fwd
+
+        new_carry = MungerState(sn_offset, ts_offset, last_sn, last_ts, started)
+        return new_carry, (out_sn, out_ts, fwd)
+
+    xs = (pkt_sn, pkt_ts, pkt_valid, forward, drop, switch, switch_ts_jump)
+    new_state, (out_sn, out_ts, send) = jax.lax.scan(step, state, xs)
+    return new_state, out_sn, out_ts, send
+
+
+def padding_tick(
+    state: MungerState,
+    num: jax.Array,        # [S] int32 — padding packets to synthesize per sub
+    max_num: int,          # static upper bound on num
+    ts_advance: jax.Array, # [S] int32 — TS advance for the first padding pkt
+):
+    """Synthesize `num` padding packets per subscriber after the last sent one.
+
+    Reference parity: rtpmunger.go UpdateAndGetPaddingSnTs (padding for probing
+    via DownTrack.WritePaddingRTP downtrack.go:764-859). Padding advances the
+    outgoing SN space without a source packet, so the SN offset moves backward
+    (future source packets keep compact numbering).
+
+    Returns (new_state, pad_sn [max_num,S], pad_ts [max_num,S], valid [max_num,S]).
+    """
+    ks = jnp.arange(max_num, dtype=jnp.int32)[:, None]  # [max_num, 1]
+    valid = (ks < num[None, :]) & state.started[None, :]
+    pad_sn = seqnum.add16(state.last_sn[None, :], ks + 1)
+    pad_ts = seqnum.add32(state.last_ts[None, :], ts_advance[None, :])
+    n = jnp.where(state.started, num, 0)
+    new_state = MungerState(
+        # Outgoing SN space advanced by n with no incoming packets ⇒ offset -= n.
+        sn_offset=seqnum.sub16(state.sn_offset, n),
+        ts_offset=state.ts_offset,
+        last_sn=jnp.where(n > 0, seqnum.add16(state.last_sn, n), state.last_sn),
+        last_ts=jnp.where(n > 0, seqnum.add32(state.last_ts, ts_advance), state.last_ts),
+        started=state.started,
+    )
+    return new_state, pad_sn, pad_ts, valid

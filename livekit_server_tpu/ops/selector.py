@@ -1,0 +1,124 @@
+"""Batched simulcast / temporal video-layer selection.
+
+Reference parity: pkg/sfu/videolayerselector/simulcast.go:42 (key-frame-gated
+spatial switching), temporallayerselector/ (VP8 layer-sync-gated temporal
+upgrades), and the selector interface videolayerselector.go:31. SVC/
+dependency-descriptor selection (vp9.go, dependencydescriptor.go) builds on
+the same mask algebra and lands in ops.svc.
+
+TPU-first re-design: per-(track, subscriber) selector state lives in [S]
+int32 tensors; each tick a `lax.scan` over the (small, static) packet axis
+produces forward/drop/switch masks consumed by ops.rtpmunger / ops.vp8 —
+the decision half of the reference's DownTrack.WriteRTP hot path
+(downtrack.go:680 → forwarder.go GetTranslationParams :1436).
+
+Layer encoding: spatial/temporal are small ints; INVALID_LAYER (-1) means
+"not forwarding" (reference buffer.InvalidLayer{-1,-1}).
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+
+INVALID_LAYER = jnp.int32(-1)
+
+
+class SelectorState(NamedTuple):
+    """Per-(track, subscriber) selection state; fields are [..., S] int32.
+
+    current_*: layer currently forwarded (reference `currentLayer`)
+    target_*:  layer the allocator wants (reference `targetLayer`, set by
+               the stream allocator / forwarder allocation algebra)
+    """
+
+    current_spatial: jax.Array
+    current_temporal: jax.Array
+    target_spatial: jax.Array
+    target_temporal: jax.Array
+
+
+def init_state(num_subscribers: int, target_spatial: int = 2, target_temporal: int = 3) -> SelectorState:
+    s = jnp.full((num_subscribers,), INVALID_LAYER, jnp.int32)
+    return SelectorState(
+        current_spatial=s,
+        current_temporal=s,
+        target_spatial=jnp.full((num_subscribers,), target_spatial, jnp.int32),
+        target_temporal=jnp.full((num_subscribers,), target_temporal, jnp.int32),
+    )
+
+
+def select_tick(
+    state: SelectorState,
+    pkt_spatial: jax.Array,    # [P] int32 — simulcast layer of the packet
+    pkt_temporal: jax.Array,   # [P] int32 — temporal id (0 if none)
+    pkt_keyframe: jax.Array,   # [P] bool
+    pkt_layer_sync: jax.Array, # [P] bool — VP8 Y bit / temporal upswitch point
+    pkt_valid: jax.Array,      # [P] bool
+):
+    """One tick of layer selection for one video track.
+
+    Returns (new_state, forward [P,S], drop [P,S], switch [P,S],
+    need_keyframe [S]). `drop` marks current-stream packets filtered by the
+    temporal selector (they compact the SN space); `switch` marks the packet
+    where a subscriber changes spatial source; `need_keyframe` asks the host
+    to send a PLI upstream when a subscriber waits on a spatial switch
+    (reference Simulcast.Select key-frame gating + downtrack key-frame
+    requester downtrack.go:608).
+    """
+
+    def step(carry: SelectorState, xs):
+        sp, tp, kf, sync, valid = xs
+
+        # Spatial switch: only at a key frame of the target layer; also the
+        # initial lock-on when nothing is forwarding yet. A downgrade request
+        # (target < current) also waits for a target-layer key frame.
+        want_switch = (carry.target_spatial != carry.current_spatial) & (
+            carry.target_spatial >= 0
+        )
+        sw = valid & kf & want_switch & (sp == carry.target_spatial)
+        cur_sp = jnp.where(sw, carry.target_spatial, carry.current_spatial)
+        # Reset temporal on spatial switch: start from target temporal.
+        cur_tp = jnp.where(sw, carry.target_temporal, carry.current_temporal)
+
+        on_current = valid & (sp == cur_sp) & (cur_sp >= 0)
+
+        # Temporal selection (temporallayerselector/simple.go semantics):
+        # upgrade only at a layer-sync point, downgrade immediately.
+        can_up = on_current & sync & (tp <= carry.target_temporal)
+        cur_tp = jnp.where(can_up & (tp > cur_tp), tp, cur_tp)
+        cur_tp = jnp.where(
+            on_current & (carry.target_temporal < cur_tp), carry.target_temporal, cur_tp
+        )
+
+        fwd = on_current & (tp <= cur_tp)
+        drp = on_current & ~fwd
+        # Pause: target invalid ⇒ stop forwarding entirely.
+        paused = carry.target_spatial < 0
+        fwd = fwd & ~paused
+        drp = (drp | (on_current & paused))
+
+        new_carry = SelectorState(
+            current_spatial=jnp.where(paused, INVALID_LAYER, cur_sp),
+            current_temporal=cur_tp,
+            target_spatial=carry.target_spatial,
+            target_temporal=carry.target_temporal,
+        )
+        return new_carry, (fwd, drp, sw)
+
+    xs = (pkt_spatial, pkt_temporal, pkt_keyframe, pkt_layer_sync, pkt_valid)
+    new_state, (fwd, drp, sw) = jax.lax.scan(step, state, xs)
+    need_keyframe = (new_state.target_spatial >= 0) & (
+        new_state.target_spatial != new_state.current_spatial
+    )
+    return new_state, fwd, drp, sw, need_keyframe
+
+
+def set_target(state: SelectorState, target_spatial: jax.Array, target_temporal: jax.Array) -> SelectorState:
+    """Apply allocator-decided target layers (reference Forwarder.SetTargetLayer)."""
+    return state._replace(
+        target_spatial=jnp.asarray(target_spatial, jnp.int32),
+        target_temporal=jnp.asarray(target_temporal, jnp.int32),
+    )

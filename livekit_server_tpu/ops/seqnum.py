@@ -1,0 +1,101 @@
+"""Wrap-aware RTP sequence-number / timestamp arithmetic.
+
+Reference parity: pkg/sfu/utils/wraparound.go (16/32-bit SN/TS extension to
+monotonic counters). TPU-first design difference: rather than extending to
+64-bit integers (x64 is off in JAX and slow on TPU), all per-packet math is
+done modulo 2^16 / 2^32 in int32 lanes with *signed wrap-aware distances*
+(the classic RTP trick), and a separate int32 cycle counter is carried in
+stream state for statistics that need absolute totals.
+
+All functions are elementwise and batch over any leading axes.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+MASK16 = jnp.int32(0xFFFF)
+HALF16 = jnp.int32(0x8000)
+
+
+def diff16(a, b):
+    """Signed wrap-aware distance a-b for 16-bit sequence numbers.
+
+    Returns values in [-32768, 32767]; positive means `a` is newer.
+    Equivalent to the reference's signed delta logic in wraparound.go
+    (updateHighest / isHigher semantics).
+    """
+    a = jnp.asarray(a, jnp.int32)
+    b = jnp.asarray(b, jnp.int32)
+    return ((a - b + HALF16) & MASK16) - HALF16
+
+
+def diff32(a, b):
+    """Signed wrap-aware distance a-b for 32-bit values (RTP timestamps).
+
+    Operands are uint32 values stored in int32 lanes; int32 two's-complement
+    subtraction gives the signed wrapped distance directly.
+    """
+    a = jnp.asarray(a, jnp.int32)
+    b = jnp.asarray(b, jnp.int32)
+    return a - b
+
+
+def add16(a, d):
+    """(a + d) mod 2^16 for sequence numbers stored in int32 lanes."""
+    return (jnp.asarray(a, jnp.int32) + jnp.asarray(d, jnp.int32)) & MASK16
+
+
+def sub16(a, d):
+    """(a - d) mod 2^16."""
+    return (jnp.asarray(a, jnp.int32) - jnp.asarray(d, jnp.int32)) & MASK16
+
+
+def add32(a, d):
+    """(a + d) mod 2^32 in int32 lanes (two's complement wrap)."""
+    return jnp.asarray(a, jnp.int32) + jnp.asarray(d, jnp.int32)
+
+
+def sub32(a, d):
+    """(a - d) mod 2^32 in int32 lanes."""
+    return jnp.asarray(a, jnp.int32) - jnp.asarray(d, jnp.int32)
+
+
+def is_newer16(a, b):
+    """True where 16-bit SN `a` is strictly newer than `b` (wrap-aware)."""
+    return diff16(a, b) > 0
+
+
+def is_newer32(a, b):
+    """True where 32-bit TS `a` is strictly newer than `b` (wrap-aware)."""
+    return diff32(a, b) > 0
+
+
+def update_highest16(highest, cycles, new):
+    """Track the highest 16-bit SN seen and count wraps.
+
+    Mirrors wraparound.go Update() highest-tracking: `highest`/`new` are
+    16-bit values in int32 lanes; `cycles` counts wraps so that
+    ext = cycles * 2^16 + highest is monotonic for stats.
+
+    Returns (new_highest, new_cycles, is_new_highest).
+    """
+    d = diff16(new, highest)
+    newer = d > 0
+    wrapped = newer & (jnp.asarray(new, jnp.int32) < jnp.asarray(highest, jnp.int32))
+    new_highest = jnp.where(newer, jnp.asarray(new, jnp.int32), highest)
+    new_cycles = jnp.where(wrapped, cycles + 1, cycles)
+    return new_highest, new_cycles, newer
+
+
+def update_highest32(highest, cycles, new):
+    """Track the highest 32-bit TS seen and count wraps (see update_highest16)."""
+    d = diff32(new, highest)
+    newer = d > 0
+    # Wrap happened iff moving forward while the raw unsigned value decreased.
+    a_u = jnp.asarray(new, jnp.uint32)
+    b_u = jnp.asarray(highest, jnp.uint32)
+    wrapped = newer & (a_u < b_u)
+    new_highest = jnp.where(newer, jnp.asarray(new, jnp.int32), highest)
+    new_cycles = jnp.where(wrapped, cycles + 1, cycles)
+    return new_highest, new_cycles, newer

@@ -1,0 +1,116 @@
+"""Batched VP8 payload-descriptor munging.
+
+Reference parity: pkg/sfu/codecmunger/vp8.go (UpdateAndGet :161 — picture-id
+7/15-bit wrap, TL0PICIDX, KEYIDX offset rewriting; UpdateOffsets on source
+switch; state snapshot VP8State :35-50). Temporal-layer *decisions* live in
+ops.selector (the reference's temporallayerselector); this module only
+rewrites the descriptor fields for the chosen packets.
+
+TPU-first re-design: offsets per (track, subscriber) carried as int32 state
+tensors; a `lax.scan` over the per-tick packet axis applies modular-offset
+rewrites vectorized over subscribers. Dropped *pictures* (whole frames
+filtered by the temporal selector) compact the picture-id space by one, the
+analog of vp8.go's droppedPictureIds accounting.
+
+Field widths: picture-id 15-bit, TL0PICIDX 8-bit, KEYIDX 5-bit.
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+
+MASK15 = jnp.int32(0x7FFF)
+MASK8 = jnp.int32(0xFF)
+MASK5 = jnp.int32(0x1F)
+
+
+def sub15(a, d):
+    return (jnp.asarray(a, jnp.int32) - jnp.asarray(d, jnp.int32)) & MASK15
+
+
+def add15(a, d):
+    return (jnp.asarray(a, jnp.int32) + jnp.asarray(d, jnp.int32)) & MASK15
+
+
+def diff15(a, b):
+    return ((jnp.asarray(a, jnp.int32) - jnp.asarray(b, jnp.int32) + 0x4000) & MASK15) - 0x4000
+
+
+class VP8State(NamedTuple):
+    """Per-(track, subscriber) VP8 munger state, fields [...,S] int32/bool.
+
+    Serializable checkpoint — analog of VP8State (codecmunger/vp8.go:35-50)
+    used for migration seeding.
+    """
+
+    pid_offset: jax.Array   # mod 2^15
+    tl0_offset: jax.Array   # mod 2^8
+    keyidx_offset: jax.Array  # mod 2^5
+    last_pid: jax.Array
+    last_tl0: jax.Array
+    last_keyidx: jax.Array
+    started: jax.Array      # bool
+
+
+def init_state(num_subscribers: int) -> VP8State:
+    z = jnp.zeros((num_subscribers,), jnp.int32)
+    return VP8State(z, z, z, z, z, z, jnp.zeros((num_subscribers,), jnp.bool_))
+
+
+def munge_tick(
+    state: VP8State,
+    pid: jax.Array,        # [P] int32 — 15-bit picture id
+    tl0: jax.Array,        # [P] int32 — 8-bit TL0PICIDX
+    keyidx: jax.Array,     # [P] int32 — 5-bit KEYIDX
+    begin_pic: jax.Array,  # [P] bool — first packet of a picture (S bit start)
+    pkt_valid: jax.Array,  # [P] bool
+    forward: jax.Array,    # [P, S] bool — packet sent to subscriber
+    drop_pic: jax.Array,   # [P, S] bool — picture dropped for subscriber
+                           #   (set on the picture's first packet only)
+    switch: jax.Array,     # [P, S] bool — source-stream switch at this packet
+):
+    """One tick of VP8 descriptor munging for one track.
+
+    Returns (new_state, out_pid [P,S], out_tl0 [P,S], out_keyidx [P,S]).
+    Equivalent of vp8.go UpdateAndGet per forwarded packet plus
+    dropped-picture offset accounting, per subscriber.
+    """
+
+    def step(carry: VP8State, xs):
+        p, t0, ki, bp, valid, fwd, drp, sw = xs
+        fwd = fwd & valid
+        drp = drp & valid & ~fwd & bp
+        sw = sw & fwd
+
+        # Source switch: continue picture-id space at last+1 (vp8.go
+        # UpdateOffsets: offsets recomputed so out = last + 1 at switch).
+        sw_pid_off = sub15(p, add15(carry.last_pid, 1))
+        sw_tl0_off = (t0 - carry.last_tl0 - 1) & MASK8
+        sw_ki_off = (ki - carry.last_keyidx - 1) & MASK5
+
+        fresh = fwd & ~carry.started
+        resync = sw & carry.started
+        pid_off = jnp.where(resync, sw_pid_off, jnp.where(fresh, 0, carry.pid_offset))
+        tl0_off = jnp.where(resync, sw_tl0_off, jnp.where(fresh, 0, carry.tl0_offset))
+        ki_off = jnp.where(resync, sw_ki_off, jnp.where(fresh, 0, carry.keyidx_offset))
+
+        out_pid = sub15(p, pid_off)
+        out_tl0 = (t0 - tl0_off) & MASK8
+        out_ki = (ki - ki_off) & MASK5
+
+        last_pid = jnp.where(fwd & bp, out_pid, carry.last_pid)
+        last_tl0 = jnp.where(fwd & bp, out_tl0, carry.last_tl0)
+        last_ki = jnp.where(fwd & bp, out_ki, carry.last_keyidx)
+        # Dropped picture ⇒ future out picture-ids shift down by one.
+        pid_off = jnp.where(drp & carry.started, add15(pid_off, 1), pid_off)
+        started = carry.started | fwd
+
+        new_carry = VP8State(pid_off, tl0_off, ki_off, last_pid, last_tl0, last_ki, started)
+        return new_carry, (out_pid, out_tl0, out_ki)
+
+    xs = (pid, tl0, keyidx, begin_pic, pkt_valid, forward, drop_pic, switch)
+    new_state, (out_pid, out_tl0, out_ki) = jax.lax.scan(step, state, xs)
+    return new_state, out_pid, out_tl0, out_ki

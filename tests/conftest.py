@@ -1,0 +1,24 @@
+"""Test harness configuration.
+
+Tests run on a virtual 8-device CPU mesh so multi-chip sharding is exercised
+without TPU hardware (the driver separately dry-runs the multichip path on
+real/virtual devices).
+
+Note: this image's sitecustomize registers the remote `axon` TPU backend and
+forces `jax_platforms="axon,cpu"` via jax.config at interpreter start — env
+vars alone don't stick. Tests must run CPU-only (the TPU tunnel is a single
+shared chip), so we override the config value again here, before any backend
+is initialized.
+"""
+
+import os
+
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")

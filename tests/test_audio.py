@@ -1,0 +1,76 @@
+"""Audio-level / active-speaker tests (reference: pkg/sfu/audio/audiolevel_test.go)."""
+
+import jax.numpy as jnp
+import numpy as np
+
+from livekit_server_tpu.ops import audio
+
+
+PARAMS = audio.AudioLevelParams(
+    active_level=35, min_percentile=40, observe_interval_ms=500, smooth_intervals=1
+)
+
+
+def _run_window(state, level, n_ticks=25, tick_ms=20, tracks=1):
+    """Feed one 20ms frame per tick at the given dBov level."""
+    for _ in range(n_ticks):
+        state, linear, active = audio.observe_tick(
+            state,
+            PARAMS,
+            jnp.full((tracks, 1), level, jnp.int32),
+            jnp.full((tracks, 1), tick_ms, jnp.int32),
+            jnp.ones((tracks, 1), jnp.bool_),
+            jnp.int32(tick_ms),
+        )
+    return state, linear, active
+
+
+def test_loud_track_becomes_active():
+    st = audio.init_state(1)
+    st, linear, active = _run_window(st, level=20)  # 20 dBov attenuation: loud
+    assert bool(active[0])
+    assert float(linear[0]) > 0.05
+
+
+def test_silent_track_inactive():
+    st = audio.init_state(1)
+    st, linear, active = _run_window(st, level=127)
+    assert not bool(active[0])
+    assert float(linear[0]) == 0.0
+
+
+def test_quiet_speech_below_threshold_inactive():
+    st = audio.init_state(1)
+    st, linear, active = _run_window(st, level=60)  # below ActiveLevel=35 threshold
+    assert not bool(active[0])
+
+
+def test_sparse_activity_below_percentile_inactive():
+    # Active frames in only ~8% of the window < MinPercentile 40%.
+    st = audio.init_state(1)
+    for i in range(25):
+        level = 20 if i % 12 == 0 else 127
+        st, linear, active = audio.observe_tick(
+            st,
+            PARAMS,
+            jnp.full((1, 1), level, jnp.int32),
+            jnp.full((1, 1), 20, jnp.int32),
+            jnp.ones((1, 1), jnp.bool_),
+            jnp.int32(20),
+        )
+    assert not bool(active[0])
+
+
+def test_top_speakers_order():
+    lv = jnp.array([[0.1, 0.9, 0.0, 0.5]], jnp.float32)
+    levels, idx = audio.top_speakers(lv, 3)
+    np.testing.assert_array_equal(np.asarray(idx)[0], [1, 3, 0])
+
+
+def test_smoothing_decay():
+    st = audio.init_state(1)
+    st, linear1, _ = _run_window(st, level=20)
+    st, linear2, active = _run_window(st, level=127)
+    # With smooth_intervals=1 the level resets after a silent window.
+    assert float(linear2[0]) < float(linear1[0])
+    assert not bool(active[0])

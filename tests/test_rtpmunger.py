@@ -1,0 +1,103 @@
+"""RTP munger tests (reference: pkg/sfu/rtpmunger_test.go semantics)."""
+
+import jax.numpy as jnp
+import numpy as np
+
+from livekit_server_tpu.ops import rtpmunger
+
+
+def _tick(state, sns, tss, fwd, drop=None, switch=None, jump=None):
+    P = len(sns)
+    S = state.sn_offset.shape[0]
+    fwd = jnp.asarray(fwd, jnp.bool_).reshape(P, S)
+    drop = jnp.zeros((P, S), jnp.bool_) if drop is None else jnp.asarray(drop, jnp.bool_).reshape(P, S)
+    switch = jnp.zeros((P, S), jnp.bool_) if switch is None else jnp.asarray(switch, jnp.bool_).reshape(P, S)
+    jump = jnp.zeros((P,), jnp.int32) if jump is None else jnp.asarray(jump, jnp.int32)
+    return rtpmunger.munge_tick(
+        state,
+        jnp.asarray(sns, jnp.int32),
+        jnp.asarray(tss, jnp.int32),
+        jnp.ones((P,), jnp.bool_),
+        fwd,
+        drop,
+        switch,
+        jump,
+    )
+
+
+def test_identity_passthrough():
+    st = rtpmunger.init_state(1)
+    st, sn, ts, send = _tick(st, [100, 101, 102], [1000, 1000, 2000], [[1], [1], [1]])
+    np.testing.assert_array_equal(np.asarray(sn)[:, 0], [100, 101, 102])
+    np.testing.assert_array_equal(np.asarray(ts)[:, 0], [1000, 1000, 2000])
+    assert np.asarray(send).all()
+    assert int(st.last_sn[0]) == 102
+
+
+def test_gap_compaction():
+    # Drop the middle packet: subsequent SNs shift down by one
+    # (rtpmunger_test.go TestPacketDropped semantics).
+    st = rtpmunger.init_state(1)
+    st, sn, ts, send = _tick(
+        st, [10, 11, 12, 13], [5, 5, 5, 5], [[1], [0], [1], [1]], drop=[[0], [1], [0], [0]]
+    )
+    got = np.asarray(sn)[:, 0]
+    sent = np.asarray(send)[:, 0]
+    assert list(got[sent]) == [10, 11, 12]
+    assert int(st.sn_offset[0]) == 1
+
+
+def test_gap_compaction_across_ticks():
+    st = rtpmunger.init_state(1)
+    st, *_ = _tick(st, [10], [5], [[1]])
+    st, *_ = _tick(st, [11], [5], [[0]], drop=[[1]])
+    st, sn, _, send = _tick(st, [12], [5], [[1]])
+    assert int(sn[0, 0]) == 11
+    assert bool(send[0, 0])
+
+
+def test_source_switch_continues_sn_space():
+    # Switch to a stream with a totally different SN space: output continues
+    # at last_sn+1 (forwarder.go processSourceSwitch semantics).
+    st = rtpmunger.init_state(1)
+    st, *_ = _tick(st, [100, 101], [1000, 2000], [[1], [1]])
+    st, sn, ts, send = _tick(
+        st, [5000, 5001], [90000, 90500], [[1], [1]], switch=[[1], [0]], jump=[3000, 0]
+    )
+    np.testing.assert_array_equal(np.asarray(sn)[:, 0], [102, 103])
+    # TS continues at last_ts + jump = 2000 + 3000 = 5000
+    np.testing.assert_array_equal(np.asarray(ts)[:, 0], [5000, 5500])
+
+
+def test_sn_wraparound():
+    st = rtpmunger.init_state(1)
+    st, sn, _, _ = _tick(st, [65534, 65535, 0, 1], [0, 0, 0, 0], [[1]] * 4)
+    np.testing.assert_array_equal(np.asarray(sn)[:, 0], [65534, 65535, 0, 1])
+    assert int(st.last_sn[0]) == 1
+
+
+def test_per_subscriber_independent_offsets():
+    st = rtpmunger.init_state(2)
+    # Sub 0 gets all packets; sub 1 joins at the second packet.
+    st, sn, _, send = _tick(st, [50, 51], [0, 0], [[1, 0], [1, 1]])
+    assert int(sn[0, 0]) == 50
+    assert int(sn[1, 1]) == 51  # identity seed at join
+    # Now sub 1 drops one, sub 0 forwards all.
+    st, sn, _, send = _tick(st, [52, 53], [0, 0], [[1, 0], [1, 1]], drop=[[0, 1], [0, 0]])
+    assert int(sn[1, 0]) == 53
+    assert int(sn[1, 1]) == 52  # compacted for sub 1 only
+
+
+def test_padding_generation():
+    st = rtpmunger.init_state(2)
+    st, *_ = _tick(st, [10], [100], [[1, 1]])
+    st, pad_sn, pad_ts, valid = rtpmunger.padding_tick(
+        st, jnp.array([2, 0], jnp.int32), 4, jnp.array([960, 960], jnp.int32)
+    )
+    v = np.asarray(valid)
+    assert v[:, 0].sum() == 2 and v[:, 1].sum() == 0
+    np.testing.assert_array_equal(np.asarray(pad_sn)[:2, 0], [11, 12])
+    # Next real packet continues compactly after padding.
+    st, sn, _, _ = _tick(st, [11], [1060], [[1, 1]])
+    assert int(sn[0, 0]) == 13  # 11 - (-2) offset
+    assert int(sn[0, 1]) == 11

@@ -1,0 +1,101 @@
+"""Simulcast layer-selector tests (reference: pkg/sfu/videolayerselector/simulcast.go)."""
+
+import jax.numpy as jnp
+import numpy as np
+
+from livekit_server_tpu.ops import selector
+
+
+def _tick(state, spatial, temporal, keyframe, sync=None, valid=None):
+    P = len(spatial)
+    sync = [True] * P if sync is None else sync
+    valid = [True] * P if valid is None else valid
+    return selector.select_tick(
+        state,
+        jnp.asarray(spatial, jnp.int32),
+        jnp.asarray(temporal, jnp.int32),
+        jnp.asarray(keyframe, jnp.bool_),
+        jnp.asarray(sync, jnp.bool_),
+        jnp.asarray(valid, jnp.bool_),
+    )
+
+
+def test_locks_on_at_keyframe_of_target():
+    st = selector.init_state(1, target_spatial=1, target_temporal=2)
+    # Non-keyframe packets of the target layer are not forwarded before lock-on.
+    st, fwd, drp, sw, need_kf = _tick(st, [1, 1], [0, 0], [False, False])
+    assert not np.asarray(fwd).any()
+    assert bool(need_kf[0])
+    # Keyframe arrives: switch + forward.
+    st, fwd, drp, sw, need_kf = _tick(st, [1, 1], [0, 0], [True, False])
+    assert np.asarray(fwd)[:, 0].all()
+    assert bool(sw[0, 0]) and not bool(sw[1, 0])
+    assert not bool(need_kf[0])
+    assert int(st.current_spatial[0]) == 1
+
+
+def test_other_layers_ignored():
+    st = selector.init_state(1, target_spatial=0, target_temporal=3)
+    st, fwd, drp, sw, _ = _tick(st, [0, 1, 2, 0], [0, 0, 0, 0], [True, True, True, False])
+    f = np.asarray(fwd)[:, 0]
+    assert list(f) == [True, False, False, True]
+    # Non-current layers are neither forwarded nor dropped (independent SN spaces).
+    d = np.asarray(drp)[:, 0]
+    assert not d.any()
+
+
+def test_spatial_upgrade_waits_for_keyframe():
+    st = selector.init_state(1, target_spatial=0, target_temporal=3)
+    st, *_ = _tick(st, [0], [0], [True])
+    st = selector.set_target(st, jnp.array([2], jnp.int32), jnp.array([3], jnp.int32))
+    # Still forwarding layer 0 until a layer-2 keyframe shows up.
+    st, fwd, _, sw, need_kf = _tick(st, [0, 2], [0, 0], [False, False])
+    assert bool(fwd[0, 0]) and not bool(fwd[1, 0])
+    assert bool(need_kf[0])
+    st, fwd, _, sw, need_kf = _tick(st, [2, 0], [0, 0], [True, False])
+    assert bool(fwd[0, 0]) and not bool(fwd[1, 0])  # switched to layer 2
+    assert bool(sw[0, 0])
+    assert int(st.current_spatial[0]) == 2
+
+
+def test_temporal_filtering_drops_and_compacts():
+    st = selector.init_state(1, target_spatial=0, target_temporal=0)
+    st, fwd, drp, *_ = _tick(st, [0, 0, 0], [0, 2, 0], [True, False, False])
+    f = np.asarray(fwd)[:, 0]
+    d = np.asarray(drp)[:, 0]
+    assert list(f) == [True, False, True]
+    assert list(d) == [False, True, False]
+
+
+def test_temporal_upgrade_at_sync_point():
+    st = selector.init_state(1, target_spatial=0, target_temporal=0)
+    st, *_ = _tick(st, [0], [0], [True])
+    st = selector.set_target(st, jnp.array([0], jnp.int32), jnp.array([2], jnp.int32))
+    # tid-2 packet without layer sync: still dropped.
+    st, fwd, drp, *_ = _tick(st, [0], [2], [False], sync=[False])
+    assert not bool(fwd[0, 0])
+    # With layer sync: upgraded and forwarded.
+    st, fwd, drp, *_ = _tick(st, [0], [2], [False], sync=[True])
+    assert bool(fwd[0, 0])
+    assert int(st.current_temporal[0]) == 2
+
+
+def test_pause_stops_forwarding():
+    st = selector.init_state(1, target_spatial=0, target_temporal=3)
+    st, *_ = _tick(st, [0], [0], [True])
+    st = selector.set_target(st, jnp.array([-1], jnp.int32), jnp.array([-1], jnp.int32))
+    st, fwd, *_ = _tick(st, [0], [0], [False])
+    assert not np.asarray(fwd).any()
+    assert int(st.current_spatial[0]) == -1
+
+
+def test_vmap_over_subscribers():
+    st = selector.init_state(3, target_spatial=1, target_temporal=3)
+    st = selector.set_target(
+        st, jnp.array([0, 1, -1], jnp.int32), jnp.array([3, 3, -1], jnp.int32)
+    )
+    st, fwd, drp, sw, need_kf = _tick(st, [0, 1], [0, 0], [True, True])
+    f = np.asarray(fwd)
+    assert bool(f[0, 0]) and not bool(f[1, 0])   # sub0 on layer 0
+    assert not bool(f[0, 1]) and bool(f[1, 1])   # sub1 on layer 1
+    assert not f[:, 2].any()                     # sub2 paused

@@ -1,0 +1,52 @@
+"""Wraparound arithmetic tests (reference: pkg/sfu/utils/wraparound_test.go)."""
+
+import jax.numpy as jnp
+import numpy as np
+
+from livekit_server_tpu.ops import seqnum
+
+
+def test_diff16_basic():
+    assert int(seqnum.diff16(10, 5)) == 5
+    assert int(seqnum.diff16(5, 10)) == -5
+    assert int(seqnum.diff16(2, 65534)) == 4      # wrap forward
+    assert int(seqnum.diff16(65534, 2)) == -4     # wrap backward
+    assert int(seqnum.diff16(0, 32768)) == -32768
+
+
+def test_diff32_wrap():
+    a = jnp.int32(5)            # 5 as uint32
+    b = jnp.int32(-5)           # 2^32-5 as uint32
+    assert int(seqnum.diff32(a, b)) == 10
+    assert int(seqnum.diff32(b, a)) == -10
+
+
+def test_add_sub16():
+    assert int(seqnum.add16(65535, 1)) == 0
+    assert int(seqnum.sub16(0, 1)) == 65535
+    assert int(seqnum.add16(100, 200)) == 300
+
+
+def test_is_newer():
+    assert bool(seqnum.is_newer16(1, 65535))
+    assert not bool(seqnum.is_newer16(65535, 1))
+    assert bool(seqnum.is_newer32(jnp.int32(-2147483648), jnp.int32(2147483647)))
+
+
+def test_update_highest16_counts_cycles():
+    highest = jnp.int32(65530)
+    cycles = jnp.int32(0)
+    for sn, want_h, want_c in [(65534, 65534, 0), (2, 2, 1), (1, 2, 1), (10, 10, 1)]:
+        highest, cycles, _ = seqnum.update_highest16(highest, cycles, jnp.int32(sn))
+        assert int(highest) == want_h
+        assert int(cycles) == want_c
+
+
+def test_update_highest16_vectorized():
+    highest = jnp.array([100, 65535], jnp.int32)
+    cycles = jnp.zeros(2, jnp.int32)
+    new = jnp.array([99, 0], jnp.int32)
+    h, c, newer = seqnum.update_highest16(highest, cycles, new)
+    np.testing.assert_array_equal(np.asarray(h), [100, 0])
+    np.testing.assert_array_equal(np.asarray(c), [0, 1])
+    np.testing.assert_array_equal(np.asarray(newer), [False, True])

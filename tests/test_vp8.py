@@ -1,0 +1,69 @@
+"""VP8 munger tests (reference: pkg/sfu/codecmunger/vp8_test.go semantics)."""
+
+import jax.numpy as jnp
+import numpy as np
+
+from livekit_server_tpu.ops import vp8
+
+
+def _tick(state, pids, tl0s, kis, begin, fwd, drop_pic=None, switch=None):
+    P = len(pids)
+    S = state.pid_offset.shape[0]
+    mk = lambda m: jnp.zeros((P, S), jnp.bool_) if m is None else jnp.asarray(m, jnp.bool_).reshape(P, S)
+    return vp8.munge_tick(
+        state,
+        jnp.asarray(pids, jnp.int32),
+        jnp.asarray(tl0s, jnp.int32),
+        jnp.asarray(kis, jnp.int32),
+        jnp.asarray(begin, jnp.bool_),
+        jnp.ones((P,), jnp.bool_),
+        mk(fwd),
+        mk(drop_pic),
+        mk(switch),
+    )
+
+
+def test_identity():
+    st = vp8.init_state(1)
+    st, pid, tl0, ki = _tick(st, [100, 100, 101], [7, 7, 7], [3, 3, 3], [1, 0, 1], [[1], [1], [1]])
+    np.testing.assert_array_equal(np.asarray(pid)[:, 0], [100, 100, 101])
+    assert int(st.last_pid[0]) == 101
+
+
+def test_dropped_picture_compacts_pid():
+    st = vp8.init_state(1)
+    st, pid, *_ = _tick(
+        st,
+        [10, 11, 12],
+        [1, 1, 1],
+        [0, 0, 0],
+        [1, 1, 1],
+        fwd=[[1], [0], [1]],
+        drop_pic=[[0], [1], [0]],
+    )
+    p = np.asarray(pid)[:, 0]
+    assert p[0] == 10 and p[2] == 11
+
+
+def test_pid_15bit_wrap():
+    st = vp8.init_state(1)
+    st, pid, *_ = _tick(st, [0x7FFE, 0x7FFF, 0], [1, 1, 1], [0, 0, 0], [1, 1, 1], [[1]] * 3)
+    np.testing.assert_array_equal(np.asarray(pid)[:, 0], [0x7FFE, 0x7FFF, 0])
+
+
+def test_switch_continues_pid_space():
+    st = vp8.init_state(1)
+    st, *_ = _tick(st, [200, 201], [5, 5], [2, 2], [1, 1], [[1], [1]])
+    st, pid, tl0, ki = _tick(
+        st, [9000, 9001], [77, 77], [9, 9], [1, 1], [[1], [1]], switch=[[1], [0]]
+    )
+    np.testing.assert_array_equal(np.asarray(pid)[:, 0], [202, 203])
+    np.testing.assert_array_equal(np.asarray(tl0)[:, 0], [6, 6])
+    np.testing.assert_array_equal(np.asarray(ki)[:, 0], [3, 3])
+
+
+def test_tl0_8bit_wrap():
+    st = vp8.init_state(1)
+    st, *_ = _tick(st, [1], [255], [0], [1], [[1]])
+    st, pid, tl0, ki = _tick(st, [2], [0], [0], [1], [[1]], switch=None)
+    assert int(tl0[0, 0]) == 0
