@@ -1,0 +1,168 @@
+"""Batched forwarder bandwidth-allocation algebra.
+
+Reference parity: pkg/sfu/forwarder.go allocation family — AllocateOptimal
+(:591), ProvisionalAllocate/ProvisionalAllocateMute/ProvisionalAllocateGetCooperativeTransition
+(:727-1105), AllocateNextHigher (:1107), Pause (:1308), DistanceToDesired
+(:569) — and the cooperative cross-track allocation loop in
+pkg/sfu/streamallocator/streamallocator.go (allocateAllTracks).
+
+TPU-first re-design: per track a `[4, 4]` (spatial × temporal) bitrate
+matrix (the reference's `Bitrates` [4][4] — receiver.go:49); allocation is
+mask algebra + argmax/scan over layer matrices, vmapped over subscribers.
+The cross-track greedy loop is a `lax.scan` over the (static) track axis
+carrying the remaining-budget register — the per-tick "allocation matmul"
+named in the north star.
+
+Layer encoding: flat index l = spatial*MAX_T + temporal, -1 = paused.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+MAX_SPATIAL = 4
+MAX_TEMPORAL = 4
+NUM_LAYERS = MAX_SPATIAL * MAX_TEMPORAL  # 16 flat layers
+
+
+def flat_layer(spatial, temporal):
+    return jnp.asarray(spatial, jnp.int32) * MAX_TEMPORAL + jnp.asarray(temporal, jnp.int32)
+
+
+def spatial_of(flat):
+    return jnp.where(flat < 0, -1, flat // MAX_TEMPORAL)
+
+
+def temporal_of(flat):
+    return jnp.where(flat < 0, -1, flat % MAX_TEMPORAL)
+
+
+def allowed_mask(bitrates, max_spatial, max_temporal):
+    """[..., 4, 4] bool — layers that exist (bitrate > 0) and satisfy the
+    subscriber's max-layer settings (reference maxLayer in forwarder.go).
+
+    bitrates: [..., 4, 4] float32/int32 bps; max_spatial/max_temporal: [...]
+    """
+    s_idx = jnp.arange(MAX_SPATIAL, dtype=jnp.int32)[:, None]
+    t_idx = jnp.arange(MAX_TEMPORAL, dtype=jnp.int32)[None, :]
+    avail = jnp.asarray(bitrates) > 0
+    cap = (s_idx <= jnp.asarray(max_spatial, jnp.int32)[..., None, None]) & (
+        t_idx <= jnp.asarray(max_temporal, jnp.int32)[..., None, None]
+    )
+    return avail & cap
+
+
+def optimal_layer(bitrates, max_spatial, max_temporal):
+    """Highest allowed layer per element — reference AllocateOptimal (:591).
+
+    Returns flat layer index [...], -1 where nothing is allowed.
+    """
+    mask = allowed_mask(bitrates, max_spatial, max_temporal)
+    flat = mask.reshape(*mask.shape[:-2], NUM_LAYERS)
+    idx = jnp.arange(NUM_LAYERS, dtype=jnp.int32)
+    best = jnp.max(jnp.where(flat, idx, -1), axis=-1)
+    return best
+
+
+def lowest_layer(bitrates, max_spatial, max_temporal):
+    """Lowest allowed layer per element (minimal allocation seed)."""
+    mask = allowed_mask(bitrates, max_spatial, max_temporal)
+    flat = mask.reshape(*mask.shape[:-2], NUM_LAYERS)
+    idx = jnp.arange(NUM_LAYERS, dtype=jnp.int32)
+    best = jnp.min(jnp.where(flat, idx, NUM_LAYERS), axis=-1)
+    return jnp.where(best >= NUM_LAYERS, -1, best)
+
+
+def layer_bitrate(bitrates, flat):
+    """Bitrate of a flat layer index; 0 for -1. bitrates [..., 4, 4]."""
+    b = bitrates.reshape(*bitrates.shape[:-2], NUM_LAYERS)
+    safe = jnp.clip(flat, 0, NUM_LAYERS - 1)
+    val = jnp.take_along_axis(b, safe[..., None], axis=-1)[..., 0]
+    return jnp.where(flat < 0, 0, val)
+
+
+def allocate_budget(bitrates, max_spatial, max_temporal, muted, budget):
+    """Cooperative constrained allocation across one subscriber's tracks.
+
+    Reference parity: streamallocator.go allocateAllTracks — two passes over
+    tracks sorted by priority: (1) give every audible/visible track its
+    minimal layer, (2) upgrade tracks in order to the best layer that fits
+    the remaining budget. Tracks the reference marks "deficient" are those
+    whose target < optimal.
+
+    Args (leading axes vmap over subscribers):
+      bitrates      [T, 4, 4] float32 bps
+      max_spatial   [T] int32, max_temporal [T] int32 — subscriber caps
+      muted         [T] bool — pub/sub muted (ProvisionalAllocateMute)
+      budget        scalar float32 — available channel capacity (bps)
+
+    Returns (target_flat [T] int32, used_bps scalar, deficient [T] bool).
+    """
+    lo = lowest_layer(bitrates, max_spatial, max_temporal)
+    hi = optimal_layer(bitrates, max_spatial, max_temporal)
+    lo = jnp.where(muted, -1, lo)
+    hi = jnp.where(muted, -1, hi)
+    lo_cost = layer_bitrate(bitrates, lo)
+
+    # Pass 1: minimal layers, in track order, while budget lasts.
+    def p1(budget_left, xs):
+        cost, valid = xs
+        take = valid & (cost <= budget_left)
+        budget_left = jnp.where(take, budget_left - cost, budget_left)
+        return budget_left, take
+
+    budget_left, got_min = jax.lax.scan(p1, jnp.asarray(budget, jnp.float32), (lo_cost, lo >= 0))
+
+    # Pass 2: upgrade each track (in order) to the best layer that fits
+    # budget_left + its own minimal cost.
+    b_flat = bitrates.reshape(-1, NUM_LAYERS).astype(jnp.float32)
+    mask_flat = allowed_mask(bitrates, max_spatial, max_temporal).reshape(-1, NUM_LAYERS)
+    idx = jnp.arange(NUM_LAYERS, dtype=jnp.int32)
+
+    def p2(budget_left, xs):
+        costs, mask, min_l, min_cost, valid = xs
+        avail = jnp.where(valid, budget_left + min_cost, 0.0)
+        fits = mask & (costs <= avail)
+        best = jnp.max(jnp.where(fits, idx, -1))
+        best = jnp.where(valid, jnp.maximum(best, min_l), -1)
+        cost = jnp.where(best >= 0, costs[jnp.clip(best, 0, NUM_LAYERS - 1)], 0.0)
+        budget_left = jnp.where(valid, avail - cost, budget_left)
+        return budget_left, best
+
+    budget_left, target = jax.lax.scan(
+        p2, budget_left, (b_flat, mask_flat, lo, jnp.where(got_min, lo_cost, 0.0), got_min)
+    )
+    used = jnp.asarray(budget, jnp.float32) - budget_left
+    deficient = (hi >= 0) & (target < hi)
+    return target, used, deficient
+
+
+def next_higher(bitrates, max_spatial, max_temporal, current_flat):
+    """Next layer above current and its incremental cost — reference
+    AllocateNextHigher (:1107), used when probing succeeds.
+
+    Returns (next_flat [...], delta_bps [...]); next == current where no
+    higher layer exists.
+    """
+    mask = allowed_mask(bitrates, max_spatial, max_temporal)
+    flat_mask = mask.reshape(*mask.shape[:-2], NUM_LAYERS)
+    idx = jnp.arange(NUM_LAYERS, dtype=jnp.int32)
+    above = flat_mask & (idx > current_flat[..., None])
+    nxt = jnp.min(jnp.where(above, idx, NUM_LAYERS), axis=-1)
+    has = nxt < NUM_LAYERS
+    nxt = jnp.where(has, nxt, current_flat)
+    delta = jnp.where(
+        has, layer_bitrate(bitrates, nxt) - layer_bitrate(bitrates, current_flat), 0
+    )
+    return nxt, delta
+
+
+def distance_to_desired(target_flat, optimal_flat):
+    """Layer distance between allocation and optimum — reference
+    DistanceToDesired (:569); >0 means deficient, drives probing and
+    connection-quality penalties.
+    """
+    t = jnp.where(target_flat < 0, -1, target_flat)
+    o = jnp.where(optimal_flat < 0, -1, optimal_flat)
+    return (o - t).astype(jnp.float32) / MAX_TEMPORAL

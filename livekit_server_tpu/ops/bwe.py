@@ -1,0 +1,142 @@
+"""Batched bandwidth estimation: channel observation + trend detection.
+
+Reference parity: pkg/sfu/streamallocator — ChannelObserver
+(channelobserver.go:77-170), TrendDetector (trenddetector.go:73-200),
+NackTracker (nacktracker.go), RateMonitor, and the congestion-state
+machine of the StreamAllocator event loop (streamallocator.go:563-720,
+100 ms tick :575).
+
+TPU-first re-design: one state row per subscriber peer connection; the
+estimate history is a fixed ring [W]; the trend statistic is a dot product
+of the (time-ordered) history with a centered linear-regression weight
+vector — the whole per-tick update over all subscribers is one fused
+elementwise + matvec kernel (the "BWE per-tick batched matmul" of the north
+star). Probe *scheduling* stays host-side (probe_controller timing), fed by
+the `probe_good` / congestion outputs here.
+
+Congestion states (streamallocator.go State): 0 = clear, 1 = congested.
+Trend directions (trenddetector.go): -1 lowering, 0 neutral, +1 upgrading.
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+
+WINDOW = 8  # estimate samples per trend window (trenddetector RequiredSamples)
+
+
+class BWEParams(NamedTuple):
+    """Mirrors config congestion-control tuning (config.go CongestionControlConfig)."""
+
+    nack_ratio_threshold: float = 0.08   # nacktracker.go ratio threshold
+    nack_window_min_packets: int = 10
+    estimate_required_downgrades: int = 3  # lowering samples to call a downtrend
+    congested_min_estimate: float = 100_000.0  # floor on usable estimate
+
+
+class BWEState(NamedTuple):
+    """Per-subscriber-PC state; fields are [..., S]."""
+
+    estimate_ring: jax.Array   # [..., S, W] float32 — recent estimate samples
+    ring_pos: jax.Array        # [..., S] int32 — next write slot
+    last_estimate: jax.Array   # [..., S] float32 — latest committed estimate
+    nack_packets: jax.Array    # [..., S] float32 — window packet count
+    nack_count: jax.Array      # [..., S] float32 — window nack count
+    congested: jax.Array       # [..., S] bool
+    committed_channel_capacity: jax.Array  # [..., S] float32 — allocator budget
+
+
+def init_state(num_subscribers: int, initial_estimate: float = 7_000_000.0) -> BWEState:
+    s = (num_subscribers,)
+    return BWEState(
+        estimate_ring=jnp.full(s + (WINDOW,), initial_estimate, jnp.float32),
+        ring_pos=jnp.zeros(s, jnp.int32),
+        last_estimate=jnp.full(s, initial_estimate, jnp.float32),
+        nack_packets=jnp.zeros(s, jnp.float32),
+        nack_count=jnp.zeros(s, jnp.float32),
+        congested=jnp.zeros(s, jnp.bool_),
+        committed_channel_capacity=jnp.full(s, initial_estimate, jnp.float32),
+    )
+
+
+def _trend_weights() -> jax.Array:
+    """Centered linear-regression slope weights over the window."""
+    x = jnp.arange(WINDOW, dtype=jnp.float32)
+    xc = x - jnp.mean(x)
+    return xc / jnp.sum(xc * xc)
+
+
+def update_tick(
+    state: BWEState,
+    params: BWEParams,
+    estimate: jax.Array,        # [S] float32 — new TWCC/REMB estimate sample
+    estimate_valid: jax.Array,  # [S] bool — a sample arrived this tick
+    pkts_sent: jax.Array,       # [S] float32 — packets sent this tick
+    nacks: jax.Array,           # [S] float32 — NACKs received this tick
+):
+    """One BWE tick over all subscribers.
+
+    Returns (new_state, congested [S] bool, trend [S] int32,
+    available_capacity [S] float32). `available_capacity` is the committed
+    channel capacity the allocator should budget against
+    (streamallocator.go handleSignalEstimate → allocateAllTracks).
+    """
+    # --- estimate ring update (only where a sample arrived) ---
+    pos = state.ring_pos % WINDOW
+    ring = jnp.where(
+        estimate_valid[..., None],
+        _scatter_ring(state.estimate_ring, pos, estimate),
+        state.estimate_ring,
+    )
+    ring_pos = jnp.where(estimate_valid, state.ring_pos + 1, state.ring_pos)
+    last_estimate = jnp.where(estimate_valid, estimate, state.last_estimate)
+
+    # --- trend: slope of time-ordered ring (batched matvec) ---
+    order = (pos[..., None] + 1 + jnp.arange(WINDOW, dtype=jnp.int32)) % WINDOW
+    ordered = jnp.take_along_axis(ring, order, axis=-1)
+    slope = ordered @ _trend_weights()  # [S]
+    mean = jnp.mean(ordered, axis=-1)
+    rel_slope = slope / jnp.maximum(mean, 1.0)
+    trend = jnp.where(rel_slope < -0.02, -1, jnp.where(rel_slope > 0.02, 1, 0)).astype(jnp.int32)
+
+    # --- nack ratio window ---
+    nack_packets = state.nack_packets + pkts_sent
+    nack_count = state.nack_count + nacks
+    ratio = nack_count / jnp.maximum(nack_packets, 1.0)
+    nack_bad = (nack_packets >= params.nack_window_min_packets) & (
+        ratio > params.nack_ratio_threshold
+    )
+
+    # --- congestion state machine (channelobserver GetTrend semantics:
+    # lowering estimate or high nack ratio ⇒ congested) ---
+    congested = (trend < 0) | nack_bad
+    # Commit capacity on congestion onset; recover to estimate when clear.
+    committed = jnp.where(
+        congested,
+        jnp.maximum(
+            jnp.minimum(state.committed_channel_capacity, last_estimate),
+            params.congested_min_estimate,
+        ),
+        last_estimate,
+    )
+
+    # Decay the nack window each tick (rolling window approximation).
+    new_state = BWEState(
+        estimate_ring=ring,
+        ring_pos=ring_pos,
+        last_estimate=last_estimate,
+        nack_packets=nack_packets * 0.5,
+        nack_count=nack_count * 0.5,
+        congested=congested,
+        committed_channel_capacity=committed,
+    )
+    return new_state, congested, trend, committed
+
+
+def _scatter_ring(ring: jax.Array, pos: jax.Array, value: jax.Array) -> jax.Array:
+    """ring[..., pos] = value without dynamic slicing (one-hot mask)."""
+    oh = jax.nn.one_hot(pos, ring.shape[-1], dtype=ring.dtype)
+    return ring * (1.0 - oh) + oh * value[..., None]

@@ -1,0 +1,87 @@
+"""Batched connection-quality scoring (simplified E-model).
+
+Reference parity: pkg/sfu/connectionquality/scorer.go:45-120 (R-factor from
+loss / RTT / jitter, MOS mapping) and connectionstats.go windows; consumed
+by ParticipantImpl.GetConnectionQuality (participant.go:927) and the room
+connection-quality worker (room.go:1318-1396).
+
+TPU-first re-design: scoring is pure elementwise float math over the track
+(or participant) axis — one fused kernel per tick, then a segment-min
+reduction to participant level.
+
+Quality enum (livekit.ConnectionQuality): 0 POOR, 1 GOOD, 2 EXCELLENT,
+3 LOST — numeric values chosen so min() aggregates to the worst.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+QUALITY_POOR = 0
+QUALITY_GOOD = 1
+QUALITY_EXCELLENT = 2
+QUALITY_LOST = 3
+
+
+def r_factor(loss_pct, rtt_ms, jitter_ms, is_deficient=None):
+    """Transmission rating factor R (simplified E-model, scorer.go).
+
+    loss_pct  [..] float32 — packet loss percentage over the window (0-100)
+    rtt_ms    [..] float32
+    jitter_ms [..] float32
+    is_deficient [..] bool — layer allocation below optimal (distance
+        penalty, forwarder DistanceToDesired feeding the scorer)
+    """
+    loss = jnp.asarray(loss_pct, jnp.float32)
+    rtt = jnp.asarray(rtt_ms, jnp.float32)
+    jitter = jnp.asarray(jitter_ms, jnp.float32)
+
+    # Delay impairment: one-way delay estimate incl. jitter buffer.
+    d = rtt / 2.0 + jitter * 2.0 + 20.0
+    id_ = 0.024 * d + 0.11 * (d - 177.3) * (d > 177.3)
+    # Equipment/loss impairment (Opus-ish: Ie=0, Bpl=25).
+    ie_eff = 0.0 + (95.0 - 0.0) * loss / (loss + 25.0)
+    r = 94.2 - id_ - ie_eff
+    if is_deficient is not None:
+        r = r - jnp.where(jnp.asarray(is_deficient), 10.0, 0.0)
+    return jnp.clip(r, 0.0, 100.0)
+
+
+def mos(r):
+    """R → mean-opinion-score (ITU G.107 mapping used by scorer.go)."""
+    r = jnp.asarray(r, jnp.float32)
+    m = 1.0 + 0.035 * r + 7.1e-6 * r * (r - 60.0) * (100.0 - r)
+    return jnp.clip(m, 1.0, 5.0)
+
+
+def score_to_quality(score, has_packets):
+    """MOS → ConnectionQuality enum; no packets in window ⇒ LOST
+    (connectionstats.go LOST detection)."""
+    q = jnp.where(
+        score >= 4.1,
+        QUALITY_EXCELLENT,
+        jnp.where(score >= 3.5, QUALITY_GOOD, QUALITY_POOR),
+    ).astype(jnp.int32)
+    return jnp.where(jnp.asarray(has_packets), q, QUALITY_LOST)
+
+
+def connection_quality(loss_pct, rtt_ms, jitter_ms, has_packets, is_deficient=None):
+    """Full pipeline: impairments → R → MOS → quality enum. Elementwise."""
+    r = r_factor(loss_pct, rtt_ms, jitter_ms, is_deficient)
+    m = mos(r)
+    return m, score_to_quality(m, has_packets)
+
+
+def aggregate_min(quality, mask, axis=-1):
+    """Worst-of aggregation (participant = min over its tracks), masked.
+
+    LOST entries only dominate if everything is LOST, mirroring
+    ParticipantImpl.GetConnectionQuality aggregation.
+    """
+    q = jnp.asarray(quality)
+    masked = jnp.where(mask, jnp.where(q == QUALITY_LOST, QUALITY_POOR, q), QUALITY_EXCELLENT)
+    worst = jnp.min(masked, axis=axis)
+    all_lost = jnp.all(jnp.where(mask, q == QUALITY_LOST, True), axis=axis) & jnp.any(
+        mask, axis=axis
+    )
+    return jnp.where(all_lost, QUALITY_LOST, worst)

@@ -1,0 +1,95 @@
+"""Allocation algebra tests (reference: pkg/sfu/forwarder_test.go allocation cases)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from livekit_server_tpu.ops import allocation as al
+
+
+def _bitrates():
+    # 2 tracks × 4 spatial × 4 temporal; only 3×2 layers populated for track0,
+    # track1 is audio-like single layer.
+    b = np.zeros((2, 4, 4), np.float32)
+    b[0, 0, 0], b[0, 0, 1] = 150e3, 200e3
+    b[0, 1, 0], b[0, 1, 1] = 500e3, 700e3
+    b[0, 2, 0], b[0, 2, 1] = 1.5e6, 2.5e6
+    b[1, 0, 0] = 32e3
+    return jnp.asarray(b)
+
+
+def test_optimal_layer_respects_caps():
+    b = _bitrates()
+    opt = al.optimal_layer(b, jnp.array([2, 3]), jnp.array([3, 3]))
+    assert int(al.spatial_of(opt)[0]) == 2 and int(al.temporal_of(opt)[0]) == 1
+    assert int(al.spatial_of(opt)[1]) == 0 and int(al.temporal_of(opt)[1]) == 0
+    opt = al.optimal_layer(b, jnp.array([1, 3]), jnp.array([0, 3]))
+    assert int(al.spatial_of(opt)[0]) == 1 and int(al.temporal_of(opt)[0]) == 0
+
+
+def test_optimal_layer_none_available():
+    b = jnp.zeros((1, 4, 4))
+    opt = al.optimal_layer(b, jnp.array([3]), jnp.array([3]))
+    assert int(opt[0]) == -1
+
+
+def test_allocate_budget_rich_channel_gets_optimal():
+    b = _bitrates()
+    target, used, deficient = al.allocate_budget(
+        b, jnp.array([3, 3]), jnp.array([3, 3]), jnp.array([False, False]), 10e6
+    )
+    assert int(al.spatial_of(target)[0]) == 2 and int(al.temporal_of(target)[0]) == 1
+    assert int(target[1]) == 0
+    assert not bool(deficient.any())
+    assert abs(float(used) - (2.5e6 + 32e3)) < 1
+
+
+def test_allocate_budget_constrained_downgrades():
+    b = _bitrates()
+    target, used, deficient = al.allocate_budget(
+        b, jnp.array([3, 3]), jnp.array([3, 3]), jnp.array([False, False]), 800e3
+    )
+    # Track0 should land on a sub-optimal layer; track1 audio fits.
+    assert bool(deficient[0])
+    assert float(used) <= 800e3 + 1
+    assert int(target[0]) >= 0  # minimal allocation guaranteed
+    assert int(target[1]) == 0
+
+
+def test_allocate_budget_starvation_pauses():
+    b = _bitrates()
+    target, used, deficient = al.allocate_budget(
+        b, jnp.array([3, 3]), jnp.array([3, 3]), jnp.array([False, False]), 10e3
+    )
+    assert int(target[0]) == -1  # cannot afford even minimal video
+    assert bool(deficient[0])
+
+
+def test_allocate_budget_mute_skips():
+    b = _bitrates()
+    target, used, deficient = al.allocate_budget(
+        b, jnp.array([3, 3]), jnp.array([3, 3]), jnp.array([True, False]), 10e6
+    )
+    assert int(target[0]) == -1
+    assert not bool(deficient[0])
+    assert abs(float(used) - 32e3) < 1
+
+
+def test_next_higher():
+    b = _bitrates()
+    cur = jnp.array([al.flat_layer(0, 1), 0], jnp.int32)
+    nxt, delta = al.next_higher(b, jnp.array([3, 3]), jnp.array([3, 3]), cur)
+    assert int(al.spatial_of(nxt)[0]) == 1 and int(al.temporal_of(nxt)[0]) == 0
+    assert abs(float(delta[0]) - (500e3 - 200e3)) < 1
+    assert int(nxt[1]) == 0 and float(delta[1]) == 0  # no higher layer
+
+
+def test_vmap_over_subscribers():
+    b = _bitrates()
+    budgets = jnp.array([10e6, 300e3], jnp.float32)
+    f = jax.vmap(lambda bud: al.allocate_budget(
+        b, jnp.array([3, 3]), jnp.array([3, 3]), jnp.array([False, False]), bud
+    ))
+    target, used, deficient = f(budgets)
+    assert target.shape == (2, 2)
+    assert not bool(deficient[0, 0]) and bool(deficient[1, 0])

@@ -1,0 +1,70 @@
+"""BWE / channel-observer tests (reference: pkg/sfu/streamallocator trend + nack)."""
+
+import jax.numpy as jnp
+import numpy as np
+
+from livekit_server_tpu.ops import bwe
+
+
+P = bwe.BWEParams()
+
+
+def _tick(st, est=None, pkts=0.0, nacks=0.0, n=1):
+    S = st.last_estimate.shape[0]
+    valid = jnp.full((S,), est is not None, jnp.bool_)
+    e = jnp.full((S,), 0.0 if est is None else est, jnp.float32)
+    return bwe.update_tick(
+        st, P, e, valid, jnp.full((S,), pkts, jnp.float32), jnp.full((S,), nacks, jnp.float32)
+    )
+
+
+def test_steady_estimate_not_congested():
+    st = bwe.init_state(1, initial_estimate=2e6)
+    for _ in range(10):
+        st, congested, trend, cap = _tick(st, est=2e6, pkts=100)
+    assert not bool(congested[0])
+    assert abs(float(cap[0]) - 2e6) < 1
+
+
+def test_falling_estimate_detected_as_congestion():
+    st = bwe.init_state(1, initial_estimate=2e6)
+    est = 2e6
+    for _ in range(bwe.WINDOW):
+        est *= 0.8
+        st, congested, trend, cap = _tick(st, est=est, pkts=100)
+    assert int(trend[0]) == -1
+    assert bool(congested[0])
+    assert float(cap[0]) <= est * 1.01
+
+
+def test_nack_storm_congests():
+    st = bwe.init_state(1, initial_estimate=2e6)
+    for _ in range(3):
+        st, congested, trend, cap = _tick(st, est=2e6, pkts=100, nacks=30)
+    assert bool(congested[0])
+
+
+def test_recovery_restores_capacity():
+    st = bwe.init_state(1, initial_estimate=2e6)
+    est = 2e6
+    for _ in range(bwe.WINDOW):
+        est *= 0.8
+        st, congested, *_ = _tick(st, est=est, pkts=100)
+    assert bool(congested[0])
+    for _ in range(bwe.WINDOW + 2):
+        st, congested, trend, cap = _tick(st, est=3e6, pkts=100)
+    assert not bool(congested[0])
+    assert abs(float(cap[0]) - 3e6) < 1
+
+
+def test_batched_independent_subscribers():
+    st = bwe.init_state(2, initial_estimate=2e6)
+    # Sub 0 falls, sub 1 steady.
+    est = np.array([2e6, 2e6], np.float32)
+    for _ in range(bwe.WINDOW):
+        est[0] *= 0.8
+        st, congested, trend, cap = bwe.update_tick(
+            st, P, jnp.asarray(est), jnp.array([True, True]),
+            jnp.array([100.0, 100.0]), jnp.array([0.0, 0.0]),
+        )
+    assert bool(congested[0]) and not bool(congested[1])

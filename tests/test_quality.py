@@ -1,0 +1,65 @@
+"""Connection-quality scorer tests (reference: pkg/sfu/connectionquality/scorer.go)."""
+
+import jax.numpy as jnp
+import numpy as np
+
+from livekit_server_tpu.ops import quality as q
+
+
+def test_clean_channel_excellent():
+    mos, qual = q.connection_quality(
+        jnp.array([0.0]), jnp.array([50.0]), jnp.array([5.0]), jnp.array([True])
+    )
+    assert float(mos[0]) > 4.1
+    assert int(qual[0]) == q.QUALITY_EXCELLENT
+
+
+def test_heavy_loss_poor():
+    mos, qual = q.connection_quality(
+        jnp.array([15.0]), jnp.array([50.0]), jnp.array([5.0]), jnp.array([True])
+    )
+    assert int(qual[0]) == q.QUALITY_POOR
+
+
+def test_high_rtt_degrades():
+    mos_lo, _ = q.connection_quality(
+        jnp.array([0.0]), jnp.array([50.0]), jnp.array([5.0]), jnp.array([True])
+    )
+    mos_hi, _ = q.connection_quality(
+        jnp.array([0.0]), jnp.array([600.0]), jnp.array([40.0]), jnp.array([True])
+    )
+    assert float(mos_hi[0]) < float(mos_lo[0])
+
+
+def test_no_packets_lost():
+    _, qual = q.connection_quality(
+        jnp.array([0.0]), jnp.array([0.0]), jnp.array([0.0]), jnp.array([False])
+    )
+    assert int(qual[0]) == q.QUALITY_LOST
+
+
+def test_deficiency_penalty():
+    mos_ok, _ = q.connection_quality(
+        jnp.array([1.0]), jnp.array([80.0]), jnp.array([10.0]), jnp.array([True])
+    )
+    mos_def, _ = q.connection_quality(
+        jnp.array([1.0]), jnp.array([80.0]), jnp.array([10.0]), jnp.array([True]),
+        is_deficient=jnp.array([True]),
+    )
+    assert float(mos_def[0]) < float(mos_ok[0])
+
+
+def test_aggregate_min():
+    qual = jnp.array([[q.QUALITY_EXCELLENT, q.QUALITY_POOR, q.QUALITY_LOST]])
+    mask = jnp.array([[True, True, True]])
+    agg = q.aggregate_min(qual, mask)
+    assert int(agg[0]) == q.QUALITY_POOR
+    # All lost ⇒ LOST.
+    qual = jnp.full((1, 3), q.QUALITY_LOST)
+    agg = q.aggregate_min(qual, mask)
+    assert int(agg[0]) == q.QUALITY_LOST
+    # Masked-out entries ignored.
+    qual = jnp.array([[q.QUALITY_EXCELLENT, q.QUALITY_POOR, q.QUALITY_EXCELLENT]])
+    mask = jnp.array([[True, False, True]])
+    agg = q.aggregate_min(qual, mask)
+    assert int(agg[0]) == q.QUALITY_EXCELLENT

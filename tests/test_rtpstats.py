@@ -1,0 +1,72 @@
+"""RTP stats tests (reference: pkg/sfu/buffer/rtpstats_receiver_test.go semantics)."""
+
+import jax.numpy as jnp
+import numpy as np
+
+from livekit_server_tpu.ops import rtpstats
+
+
+def _tick(st, sns, tss=None, sizes=None, arr=None, valid=None):
+    N, K = 1, len(sns)
+    tss = tss or [0] * K
+    sizes = sizes or [100] * K
+    arr = arr or tss
+    valid = [True] * K if valid is None else valid
+    return rtpstats.update_tick(
+        st,
+        jnp.asarray([sns], jnp.int32),
+        jnp.asarray([tss], jnp.int32),
+        jnp.asarray([sizes], jnp.int32),
+        jnp.asarray([arr], jnp.int32),
+        jnp.asarray([valid], jnp.bool_),
+    )
+
+
+def test_basic_counts():
+    st = rtpstats.init_state(1)
+    st = _tick(st, [100, 101, 102])
+    assert int(st.received[0]) == 3
+    assert int(st.bytes[0]) == 300
+    assert int(rtpstats.expected_packets(st)[0]) == 3
+    assert int(rtpstats.cumulative_lost(st)[0]) == 0
+
+
+def test_loss_detection():
+    st = rtpstats.init_state(1)
+    st = _tick(st, [100, 103, 104])  # 101, 102 missing
+    assert int(rtpstats.expected_packets(st)[0]) == 5
+    assert int(rtpstats.cumulative_lost(st)[0]) == 2
+
+
+def test_duplicates_counted():
+    st = rtpstats.init_state(1)
+    st = _tick(st, [100, 100, 101])
+    assert int(st.dups[0]) == 1
+    assert int(st.received[0]) == 3
+
+
+def test_sn_wrap_expected():
+    st = rtpstats.init_state(1)
+    st = _tick(st, [65534, 65535, 0, 1])
+    assert int(st.sn_cycles[0]) == 1
+    assert int(rtpstats.expected_packets(st)[0]) == 4
+
+
+def test_jitter_accumulates():
+    st = rtpstats.init_state(1)
+    # Packets 160 RTP units apart but arriving with increasing delay.
+    st = _tick(st, [1, 2, 3, 4], tss=[0, 160, 320, 480], arr=[0, 200, 420, 700])
+    assert int(st.jitter_q4[0]) > 0
+
+
+def test_receiver_report_deltas():
+    st = rtpstats.init_state(1)
+    st = _tick(st, [10, 12])  # 1 lost
+    st, rep = rtpstats.receiver_report(st)
+    assert int(rep["cumulative_lost"][0]) == 1
+    assert int(rep["fraction_lost_q8"][0]) == (1 << 8) // 3
+    # Second window clean.
+    st = _tick(st, [13, 14])
+    st, rep = rtpstats.receiver_report(st)
+    assert int(rep["fraction_lost_q8"][0]) == 0
+    assert int(rep["cumulative_lost"][0]) == 1
