@@ -1,0 +1,9 @@
+"""Media-plane models: the tick-driven batched SFU programs.
+
+The "flagship model" is `plane.media_plane_tick` — one tick of the whole
+SFU data plane for a node's rooms: layer selection, SN/TS/VP8 munging,
+audio-level mixing, RTP stats, BWE, and bandwidth allocation, as a single
+fused XLA program over `[rooms × tracks × pkts × subscribers]` tensors.
+This replaces the reference's per-packet goroutine hot path
+(pkg/sfu/receiver.go:635 forwardRTP → downtrack.go:680 WriteRTP).
+"""

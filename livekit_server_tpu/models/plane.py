@@ -1,0 +1,331 @@
+"""The batched SFU media plane — flagship model.
+
+One `media_plane_tick` call advances the entire media plane of a node by one
+tick (~5-20 ms): for every room, every published track, every subscriber, it
+
+  1. folds received packets into per-stream RTP stats
+     (reference: buffer.Buffer.calc — pkg/sfu/buffer/buffer.go:417)
+  2. updates per-layer bitrate estimates
+     (reference: StreamTrackerManager Bitrates — streamtrackermanager.go)
+  3. runs BWE trend detection + congestion per subscriber
+     (reference: StreamAllocator event loop — streamallocator.go:563)
+  4. allocates layers across tracks under the committed channel budget
+     (reference: allocateAllTracks + Forwarder provisional algebra)
+  5. selects simulcast/temporal layers per packet per subscriber
+     (reference: videolayerselector — the Select half of WriteRTP)
+  6. munges SN/TS and VP8 descriptors per (packet, subscriber)
+     (reference: rtpmunger.go + codecmunger/vp8.go — the rewrite half)
+  7. mixes audio levels into active-speaker rankings per room
+     (reference: audio.AudioLevel + Room.audioUpdateWorker)
+
+The whole thing is jit-compiled once; the room axis is vmapped and shards
+over the device mesh (livekit_server_tpu.parallel). The host control plane
+mutates subscription/mute masks and reads egress outputs between ticks.
+
+Shape glossary (static per compiled program):
+  R rooms · T tracks/room · K packets/track/tick · S subscribers/room
+  streams N = T (one SN space per simulcast layer is carried in the packet
+  `layer` field; per-layer stats use T*L rows with L = MAX_LAYERS).
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+
+from livekit_server_tpu.ops import (
+    allocation,
+    audio,
+    bwe,
+    quality,
+    rtpmunger,
+    rtpstats,
+    selector,
+    vp8,
+)
+
+MAX_LAYERS = 3          # simulcast spatial layers (reference: 3 — receiver.go)
+SPEAKER_TOP_K = 3
+# Per-temporal-sublayer share of a spatial layer's bitrate (coarse model of
+# the reference's [4][4] Bitrates matrix until temporal-layer byte
+# attribution lands in stats).
+TEMPORAL_FRACTIONS = (0.45, 0.65, 0.85, 1.0)
+
+
+class PlaneDims(NamedTuple):
+    rooms: int = 1
+    tracks: int = 4        # per room
+    pkts: int = 4          # per track per tick
+    subs: int = 4          # per room
+
+
+class TrackMeta(NamedTuple):
+    """Host-written per-track control tensors, [R, T]."""
+
+    is_video: jax.Array     # bool
+    published: jax.Array    # bool — track exists and is live
+    pub_muted: jax.Array    # bool — publisher muted
+
+
+class SubControl(NamedTuple):
+    """Host-written per-(track, subscriber) control tensors, [R, T, S]."""
+
+    subscribed: jax.Array    # bool — SubscriptionManager desired state
+    sub_muted: jax.Array     # bool — subscriber-requested mute
+    max_spatial: jax.Array   # int32 — adaptive-stream cap
+    max_temporal: jax.Array  # int32
+
+
+class PlaneState(NamedTuple):
+    """Full media-plane state, all leading axis [R] (sharded over mesh)."""
+
+    meta: TrackMeta
+    ctrl: SubControl
+    stats: rtpstats.StreamStats          # [R, T*L] per (track, layer) stream
+    audio_state: audio.AudioLevelState   # [R, T]
+    munger: rtpmunger.MungerState        # [R, T, S]
+    vp8_state: vp8.VP8State              # [R, T, S]
+    sel: selector.SelectorState          # [R, T, S]
+    bwe_state: bwe.BWEState              # [R, S]
+    layer_bytes_ema: jax.Array           # [R, T, L] float32 — per-layer byte/tick EMA
+
+
+class TickInputs(NamedTuple):
+    """Per-tick ingest tensors (host-packed; static shapes)."""
+
+    # Packet fields, [R, T, K]:
+    sn: jax.Array          # int32, 16-bit
+    ts: jax.Array          # int32, 32-bit
+    layer: jax.Array       # int32 — spatial/simulcast layer (0 for audio)
+    temporal: jax.Array    # int32 — temporal id (0 if none)
+    keyframe: jax.Array    # bool
+    layer_sync: jax.Array  # bool — temporal upswitch point (VP8 Y bit)
+    begin_pic: jax.Array   # bool — first packet of a picture / frame
+    pid: jax.Array         # int32 — VP8 picture id (0 for audio)
+    tl0: jax.Array         # int32 — VP8 TL0PICIDX
+    keyidx: jax.Array      # int32 — VP8 KEYIDX
+    size: jax.Array        # int32 — payload bytes
+    audio_level: jax.Array # int32 — RFC6464 dBov (127 if none)
+    arrival_rtp: jax.Array # int32 — arrival time in RTP units
+    valid: jax.Array       # bool
+    # Per-subscriber feedback, [R, S]:
+    estimate: jax.Array        # float32 — TWCC/REMB estimate sample
+    estimate_valid: jax.Array  # bool
+    nacks: jax.Array           # float32 — NACK count this tick
+    # Scalars:
+    tick_ms: jax.Array     # int32
+
+
+class TickOutputs(NamedTuple):
+    """Egress + signal tensors pulled by the host after each tick."""
+
+    send: jax.Array       # [R, T, K, S] bool — forward packet k to sub s
+    out_sn: jax.Array     # [R, T, K, S] int32
+    out_ts: jax.Array     # [R, T, K, S] int32
+    out_pid: jax.Array    # [R, T, K, S] int32 (video only)
+    out_tl0: jax.Array    # [R, T, K, S] int32
+    out_keyidx: jax.Array # [R, T, K, S] int32
+    need_keyframe: jax.Array   # [R, T, S] bool — host sends PLI upstream
+    speaker_levels: jax.Array  # [R, SPEAKER_TOP_K] float32
+    speaker_tracks: jax.Array  # [R, SPEAKER_TOP_K] int32 — room-local track idx
+    congested: jax.Array       # [R, S] bool
+    target_layers: jax.Array   # [R, S, T] int32 — flat layer targets
+    fwd_packets: jax.Array     # [R] int32 — packets forwarded (telemetry)
+    fwd_bytes: jax.Array       # [R] int32
+
+
+def init_state(dims: PlaneDims, audio_params: audio.AudioLevelParams | None = None) -> PlaneState:
+    R, T, K, S = dims
+    L = MAX_LAYERS
+
+    def tile(x, *lead):
+        return jnp.broadcast_to(x, lead + x.shape).copy()
+
+    meta = TrackMeta(
+        is_video=jnp.zeros((R, T), jnp.bool_),
+        published=jnp.zeros((R, T), jnp.bool_),
+        pub_muted=jnp.zeros((R, T), jnp.bool_),
+    )
+    ctrl = SubControl(
+        subscribed=jnp.zeros((R, T, S), jnp.bool_),
+        sub_muted=jnp.zeros((R, T, S), jnp.bool_),
+        max_spatial=jnp.full((R, T, S), MAX_LAYERS - 1, jnp.int32),
+        max_temporal=jnp.full((R, T, S), 3, jnp.int32),
+    )
+    return PlaneState(
+        meta=meta,
+        ctrl=ctrl,
+        stats=jax.tree.map(lambda x: tile(x, R), rtpstats.init_state(T * L)),
+        audio_state=jax.tree.map(lambda x: tile(x, R), audio.init_state(T)),
+        munger=jax.tree.map(lambda x: tile(x, R, T), rtpmunger.init_state(S)),
+        vp8_state=jax.tree.map(lambda x: tile(x, R, T), vp8.init_state(S)),
+        sel=jax.tree.map(lambda x: tile(x, R, T), selector.init_state(S)),
+        bwe_state=jax.tree.map(lambda x: tile(x, R), bwe.init_state(S)),
+        layer_bytes_ema=jnp.zeros((R, T, L), jnp.float32),
+    )
+
+
+def _room_tick(
+    state: PlaneState,
+    inp: TickInputs,
+    audio_params: audio.AudioLevelParams,
+    bwe_params: bwe.BWEParams,
+):
+    """Tick for ONE room; every field has its leading R axis stripped."""
+    T, K = inp.sn.shape
+    S = state.ctrl.subscribed.shape[-1]
+    L = MAX_LAYERS
+
+    # ---- 1. RTP stats per (track, layer) stream -------------------------
+    stream_idx = jnp.arange(T, dtype=jnp.int32)[:, None] * L + jnp.clip(inp.layer, 0, L - 1)
+    # Scatter packets into [T*L, K] rows by (track, layer).
+    def to_streams(x, fill):
+        out = jnp.full((T * L, K), fill, x.dtype)
+        return out.at[stream_idx.reshape(-1), jnp.tile(jnp.arange(K), T)].set(x.reshape(-1))
+
+    st_sn = to_streams(inp.sn, 0)
+    st_ts = to_streams(inp.ts, 0)
+    st_size = to_streams(inp.size, 0)
+    st_arr = to_streams(inp.arrival_rtp, 0)
+    st_valid = to_streams(inp.valid, False)
+    stats = rtpstats.update_tick(state.stats, st_sn, st_ts, st_size, st_arr, st_valid)
+
+    # ---- 2. per-layer bitrate EMA --------------------------------------
+    layer_oh = jax.nn.one_hot(jnp.clip(inp.layer, 0, L - 1), L, dtype=jnp.float32)
+    tick_bytes = jnp.einsum(
+        "tk,tkl->tl", jnp.where(inp.valid, inp.size, 0).astype(jnp.float32), layer_oh
+    )
+    ema = state.layer_bytes_ema * 0.9 + tick_bytes * 0.1
+    tick_s = jnp.maximum(inp.tick_ms.astype(jnp.float32), 1.0) / 1000.0
+    layer_bps = ema * 8.0 / tick_s  # [T, L]
+    # Expand to the [T, 4, 4] bitrate matrix with temporal fractions.
+    frac = jnp.asarray(TEMPORAL_FRACTIONS, jnp.float32)
+    bitrates = jnp.zeros((T, 4, 4), jnp.float32)
+    bitrates = bitrates.at[:, :L, :].set(layer_bps[:, :, None] * frac[None, None, :])
+    # Audio has a single "layer": zero the matrix so allocation skips it.
+    bitrates = jnp.where(state.meta.is_video[:, None, None], bitrates, 0.0)
+
+    # ---- 3. per-packet layer selection with last tick's targets --------
+    # (the reference's allocator also lags forwarding: StreamAllocator ticks
+    # at 100 ms while WriteRTP runs continuously)
+    sel_state, v_fwd, v_drop, v_switch, need_kf = jax.vmap(selector.select_tick)(
+        state.sel, inp.layer, inp.temporal, inp.keyframe, inp.layer_sync, inp.valid
+    )  # masks [T, K, S]
+
+    # Audio path: forward to every subscribed, unmuted subscriber.
+    base = (
+        state.ctrl.subscribed
+        & ~state.ctrl.sub_muted
+        & (state.meta.published & ~state.meta.pub_muted)[:, None]
+    )  # [T, S]
+    a_fwd = inp.valid[:, :, None] & base[:, None, :]  # [T, K, S]
+    is_video = state.meta.is_video[:, None, None]
+    fwd = jnp.where(is_video, v_fwd & base[:, None, :], a_fwd)
+    drop = jnp.where(is_video, v_drop & base[:, None, :], False)
+    switch = jnp.where(is_video, v_switch & base[:, None, :], False)
+    need_kf = need_kf & base & state.meta.is_video[:, None]
+
+    # ---- 6. SN/TS + VP8 munging (vmap over tracks) ---------------------
+    # TS jump at a source switch ≈ one frame at 90 kHz/30 fps. Cross-layer
+    # TS alignment via sender reports refines this host-side.
+    ts_jump = jnp.full((T, K), 3000, jnp.int32)
+    munger_state, out_sn, out_ts, send = jax.vmap(rtpmunger.munge_tick)(
+        state.munger, inp.sn, inp.ts, inp.valid, fwd, drop, switch, ts_jump
+    )
+    vp8_state, out_pid, out_tl0, out_ki = jax.vmap(vp8.munge_tick)(
+        state.vp8_state, inp.pid, inp.tl0, inp.keyidx, inp.begin_pic,
+        inp.valid, fwd, drop & inp.begin_pic[:, :, None], switch,
+    )
+
+    # ---- BWE per subscriber (uses this tick's actual send counts) ------
+    pkts_sent = jnp.sum(send, axis=(0, 1)).astype(jnp.float32)  # [S]
+    bwe_state, congested, trend, budget = bwe.update_tick(
+        state.bwe_state, bwe_params, inp.estimate, inp.estimate_valid,
+        pkts_sent, inp.nacks,
+    )
+
+    # ---- allocation across tracks per subscriber → targets for next tick
+    video_active = state.meta.is_video & state.meta.published & ~state.meta.pub_muted
+    alloc_muted = ~(
+        state.ctrl.subscribed & video_active[:, None] & ~state.ctrl.sub_muted
+    ).transpose(1, 0)  # [S, T]
+    target_flat, used, deficient = jax.vmap(
+        lambda ms, mt, mu, bud: allocation.allocate_budget(bitrates, ms, mt, mu, bud)
+    )(
+        state.ctrl.max_spatial.transpose(1, 0),
+        state.ctrl.max_temporal.transpose(1, 0),
+        alloc_muted,
+        budget,
+    )  # [S, T]
+    sel_state = selector.set_target(
+        sel_state,
+        jnp.clip(allocation.spatial_of(target_flat.transpose(1, 0)), -1, L - 1),
+        allocation.temporal_of(target_flat.transpose(1, 0)),
+    )
+
+    # ---- 7. audio levels + active speakers -----------------------------
+    is_audio_pkt = inp.valid & ~state.meta.is_video[:, None]
+    audio_state, linear, is_active = audio.observe_tick(
+        state.audio_state, audio_params,
+        jnp.where(is_audio_pkt, inp.audio_level, 127),
+        jnp.full((T, K), 20, jnp.int32),
+        is_audio_pkt,
+        inp.tick_ms,
+    )
+    k = min(SPEAKER_TOP_K, T)
+    spk_levels, spk_tracks = audio.top_speakers(
+        jnp.where(is_active & state.meta.published, linear, 0.0), k
+    )
+    if k < SPEAKER_TOP_K:
+        pad = SPEAKER_TOP_K - k
+        spk_levels = jnp.pad(spk_levels, (0, pad))
+        spk_tracks = jnp.pad(spk_tracks, (0, pad), constant_values=-1)
+
+    new_state = PlaneState(
+        meta=state.meta,
+        ctrl=state.ctrl,
+        stats=stats,
+        audio_state=audio_state,
+        munger=munger_state,
+        vp8_state=vp8_state,
+        sel=sel_state,
+        bwe_state=bwe_state,
+        layer_bytes_ema=ema,
+    )
+    outputs = TickOutputs(
+        send=send,
+        out_sn=out_sn,
+        out_ts=out_ts,
+        out_pid=out_pid,
+        out_tl0=out_tl0,
+        out_keyidx=out_ki,
+        need_keyframe=need_kf,
+        speaker_levels=spk_levels,
+        speaker_tracks=spk_tracks,
+        congested=congested,
+        target_layers=target_flat,
+        fwd_packets=jnp.sum(send.astype(jnp.int32)),
+        fwd_bytes=jnp.sum(jnp.where(send, inp.size[:, :, None], 0)),
+    )
+    return new_state, outputs
+
+
+def media_plane_tick(
+    state: PlaneState,
+    inp: TickInputs,
+    audio_params: audio.AudioLevelParams = audio.AudioLevelParams(),
+    bwe_params: bwe.BWEParams = bwe.BWEParams(),
+):
+    """One tick of the full media plane, vmapped over the room axis.
+
+    jit this (donating `state`) and step it from the runtime loop. The [R]
+    axis is the mesh-sharded axis (see livekit_server_tpu.parallel.mesh).
+    """
+    # Scalars (tick_ms) broadcast; everything else has a leading R axis.
+    def tick_one(st, i):
+        return _room_tick(st, i, audio_params, bwe_params)
+
+    inp_axes = TickInputs(**{f: 0 for f in TickInputs._fields})._replace(tick_ms=None)
+    return jax.vmap(tick_one, in_axes=(0, inp_axes))(state, inp)

@@ -84,10 +84,13 @@ def observe_tick(
     obs = jnp.where(was_active, window_min, SILENT_LEVEL)
 
     alpha = jnp.float32(1.0 / max(params.smooth_intervals, 1))
+    ema = state.smoothed_level + (obs - state.smoothed_level) * alpha
+    # Seed directly on the first active window after silence (the reference
+    # seeds smoothedLevel rather than EMA-ing up from digital silence, so a
+    # new speaker is detected within one observe window).
+    was_silent = state.smoothed_level >= 126.5
     smoothed = jnp.where(
-        done,
-        state.smoothed_level + (obs - state.smoothed_level) * alpha,
-        state.smoothed_level,
+        done, jnp.where(was_silent & was_active, obs, ema), state.smoothed_level
     )
     new_state = AudioLevelState(
         smoothed_level=smoothed,

@@ -1,0 +1,202 @@
+"""End-to-end media-plane tick tests.
+
+Behavioral spec: BASELINE.md config 1 (single room, 2 participants, 1 Opus
+audio track each — the reference's TestSinglePublisher scenario,
+test/singlenode_test.go:140) plus a VP8 simulcast room.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from livekit_server_tpu.models import plane
+from livekit_server_tpu.ops import audio
+
+
+def make_inputs(dims: plane.PlaneDims, **over):
+    R, T, K, S = dims
+    z = lambda dt: jnp.zeros((R, T, K), dt)
+    inp = plane.TickInputs(
+        sn=z(jnp.int32), ts=z(jnp.int32), layer=z(jnp.int32), temporal=z(jnp.int32),
+        keyframe=z(jnp.bool_), layer_sync=jnp.ones((R, T, K), jnp.bool_),
+        begin_pic=jnp.ones((R, T, K), jnp.bool_),
+        pid=z(jnp.int32), tl0=z(jnp.int32), keyidx=z(jnp.int32),
+        size=z(jnp.int32), audio_level=jnp.full((R, T, K), 127, jnp.int32),
+        arrival_rtp=z(jnp.int32), valid=jnp.zeros((R, T, K), jnp.bool_),
+        estimate=jnp.zeros((R, S), jnp.float32),
+        estimate_valid=jnp.zeros((R, S), jnp.bool_),
+        nacks=jnp.zeros((R, S), jnp.float32),
+        tick_ms=jnp.int32(20),
+    )
+    return inp._replace(**over)
+
+
+def two_party_audio_state():
+    """Room with participants A, B; track 0 published by A (sub: B=slot1),
+    track 1 published by B (sub: A=slot0)."""
+    dims = plane.PlaneDims(rooms=1, tracks=2, pkts=1, subs=2)
+    st = plane.init_state(dims)
+    pub = np.zeros((1, 2), bool); pub[0, :] = True
+    subd = np.zeros((1, 2, 2), bool)
+    subd[0, 0, 1] = True  # track0 → sub B
+    subd[0, 1, 0] = True  # track1 → sub A
+    st = st._replace(
+        meta=st.meta._replace(published=jnp.asarray(pub)),
+        ctrl=st.ctrl._replace(subscribed=jnp.asarray(subd)),
+    )
+    return dims, st
+
+
+def test_two_party_audio_forwarding():
+    dims, st = two_party_audio_state()
+    step = jax.jit(plane.media_plane_tick)
+    sn = 1000
+    for i in range(5):
+        inp = make_inputs(
+            dims,
+            sn=jnp.asarray([[[sn + i], [sn + i]]], jnp.int32),
+            ts=jnp.asarray([[[960 * i], [960 * i]]], jnp.int32),
+            size=jnp.full((1, 2, 1), 120, jnp.int32),
+            audio_level=jnp.asarray([[[20], [90]]], jnp.int32),  # A loud, B quiet
+            valid=jnp.ones((1, 2, 1), jnp.bool_),
+        )
+        st, out = step(st, inp)
+        send = np.asarray(out.send)[0]  # [T, K, S]
+        # Track 0 goes only to sub 1; track 1 only to sub 0.
+        assert send[0, 0, 1] and not send[0, 0, 0]
+        assert send[1, 0, 0] and not send[1, 0, 1]
+        # Audio munging is identity for a continuous stream.
+        assert int(out.out_sn[0, 0, 0, 1]) == sn + i
+        assert int(out.out_ts[0, 0, 0, 1]) == 960 * i
+    assert int(out.fwd_packets[0]) == 2
+
+
+def test_two_party_active_speaker():
+    dims, st = two_party_audio_state()
+    step = jax.jit(plane.media_plane_tick)
+    # 30 ticks × 20 ms = 600 ms > 500 ms window ⇒ speaker ranking updates.
+    for i in range(30):
+        inp = make_inputs(
+            dims,
+            sn=jnp.asarray([[[i], [i]]], jnp.int32),
+            size=jnp.full((1, 2, 1), 120, jnp.int32),
+            audio_level=jnp.asarray([[[20], [90]]], jnp.int32),
+            valid=jnp.ones((1, 2, 1), jnp.bool_),
+        )
+        st, out = step(st, inp)
+    levels = np.asarray(out.speaker_levels)[0]
+    tracks = np.asarray(out.speaker_tracks)[0]
+    assert tracks[0] == 0          # track 0 (loud) is top speaker
+    assert levels[0] > 0.05
+    assert levels[1] == 0.0        # quiet track below active threshold
+
+
+def test_unsubscribed_not_forwarded():
+    dims, st = two_party_audio_state()
+    st = st._replace(ctrl=st.ctrl._replace(subscribed=jnp.zeros((1, 2, 2), jnp.bool_)))
+    step = jax.jit(plane.media_plane_tick)
+    inp = make_inputs(
+        dims,
+        valid=jnp.ones((1, 2, 1), jnp.bool_),
+        size=jnp.full((1, 2, 1), 120, jnp.int32),
+    )
+    st, out = step(st, inp)
+    assert not np.asarray(out.send).any()
+    assert int(out.fwd_packets[0]) == 0
+
+
+def test_pub_mute_stops_forwarding():
+    dims, st = two_party_audio_state()
+    st = st._replace(meta=st.meta._replace(pub_muted=jnp.asarray([[True, False]])))
+    step = jax.jit(plane.media_plane_tick)
+    inp = make_inputs(
+        dims, valid=jnp.ones((1, 2, 1), jnp.bool_), size=jnp.full((1, 2, 1), 100, jnp.int32)
+    )
+    st, out = step(st, inp)
+    send = np.asarray(out.send)[0]
+    assert not send[0].any()       # muted track 0
+    assert send[1, 0, 0]           # track 1 still flows
+
+
+def video_room_state():
+    """1 video track (simulcast 3-layer), 3 subscribers."""
+    dims = plane.PlaneDims(rooms=1, tracks=1, pkts=3, subs=3)
+    st = plane.init_state(dims)
+    st = st._replace(
+        meta=plane.TrackMeta(
+            is_video=jnp.ones((1, 1), jnp.bool_),
+            published=jnp.ones((1, 1), jnp.bool_),
+            pub_muted=jnp.zeros((1, 1), jnp.bool_),
+        ),
+        ctrl=st.ctrl._replace(subscribed=jnp.ones((1, 1, 3), jnp.bool_)),
+    )
+    return dims, st
+
+
+def test_simulcast_keyframe_lockon_and_munge():
+    dims, st = video_room_state()
+    # Targets: selector init targets spatial 2; sub caps limit sub0 to layer 0.
+    sel = st.sel._replace(
+        target_spatial=jnp.asarray([[[0, 2, 2]]], jnp.int32),
+        target_temporal=jnp.full((1, 1, 3), 3, jnp.int32),
+    )
+    # Pin allocator caps so per-tick allocation preserves the intent.
+    ctrl = st.ctrl._replace(max_spatial=jnp.asarray([[[0, 2, 2]]], jnp.int32))
+    st = st._replace(sel=sel, ctrl=ctrl)
+    step = jax.jit(plane.media_plane_tick)
+
+    # Tick 1: keyframes on all three layers (one packet per layer).
+    inp = make_inputs(
+        dims,
+        sn=jnp.asarray([[[100, 5000, 9000]]], jnp.int32),
+        ts=jnp.asarray([[[10, 20, 30]]], jnp.int32),
+        layer=jnp.asarray([[[0, 1, 2]]], jnp.int32),
+        keyframe=jnp.ones((1, 1, 3), jnp.bool_),
+        pid=jnp.asarray([[[7, 300, 900]]], jnp.int32),
+        size=jnp.full((1, 1, 3), 500, jnp.int32),
+        valid=jnp.ones((1, 1, 3), jnp.bool_),
+    )
+    st, out = step(st, inp)
+    send = np.asarray(out.send)[0, 0]  # [K, S]
+    assert send[0, 0] and not send[1, 0] and not send[2, 0]  # sub0 ← layer0
+    assert send[2, 1] and send[2, 2]                          # subs 1,2 ← layer2
+    assert not send[0, 1]
+    # Identity munge on first packet.
+    assert int(out.out_sn[0, 0, 0, 0]) == 100
+    assert int(out.out_sn[0, 0, 2, 1]) == 9000
+
+    # Tick 2: delta frames keep flowing on locked layers.
+    inp2 = make_inputs(
+        dims,
+        sn=jnp.asarray([[[101, 5001, 9001]]], jnp.int32),
+        ts=jnp.asarray([[[3010, 3020, 3030]]], jnp.int32),
+        layer=jnp.asarray([[[0, 1, 2]]], jnp.int32),
+        pid=jnp.asarray([[[8, 301, 901]]], jnp.int32),
+        size=jnp.full((1, 1, 3), 500, jnp.int32),
+        valid=jnp.ones((1, 1, 3), jnp.bool_),
+    )
+    st, out = step(st, inp2)
+    send = np.asarray(out.send)[0, 0]
+    assert send[0, 0] and send[2, 1] and send[2, 2]
+    assert int(out.out_sn[0, 0, 0, 0]) == 101
+    assert not np.asarray(out.need_keyframe).any()
+
+
+def test_multi_room_vmap_isolation():
+    dims = plane.PlaneDims(rooms=2, tracks=1, pkts=1, subs=2)
+    st = plane.init_state(dims)
+    pub = jnp.asarray([[True], [True]])
+    subd = np.zeros((2, 1, 2), bool)
+    subd[0, 0, 1] = True   # room0: sub1 subscribed
+    # room1: nobody subscribed
+    st = st._replace(
+        meta=st.meta._replace(published=pub),
+        ctrl=st.ctrl._replace(subscribed=jnp.asarray(subd)),
+    )
+    step = jax.jit(plane.media_plane_tick)
+    inp = make_inputs(
+        dims, valid=jnp.ones((2, 1, 1), jnp.bool_), size=jnp.full((2, 1, 1), 99, jnp.int32)
+    )
+    st, out = step(st, inp)
+    assert int(out.fwd_packets[0]) == 1
+    assert int(out.fwd_packets[1]) == 0
