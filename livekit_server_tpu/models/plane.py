@@ -107,6 +107,8 @@ class TickInputs(NamedTuple):
     tl0: jax.Array         # int32 — VP8 TL0PICIDX
     keyidx: jax.Array      # int32 — VP8 KEYIDX
     size: jax.Array        # int32 — payload bytes
+    frame_ms: jax.Array    # int32 — media duration carried by the packet
+                           # (Opus ptime; 0 for video — levels are audio-only)
     audio_level: jax.Array # int32 — RFC6464 dBov (127 if none)
     arrival_rtp: jax.Array # int32 — arrival time in RTP units
     valid: jax.Array       # bool
@@ -136,7 +138,7 @@ class TickOutputs(NamedTuple):
     fwd_bytes: jax.Array       # [R] int32
 
 
-def init_state(dims: PlaneDims, audio_params: audio.AudioLevelParams | None = None) -> PlaneState:
+def init_state(dims: PlaneDims) -> PlaneState:
     R, T, K, S = dims
     L = MAX_LAYERS
 
@@ -236,7 +238,7 @@ def _room_tick(
     )
     vp8_state, out_pid, out_tl0, out_ki = jax.vmap(vp8.munge_tick)(
         state.vp8_state, inp.pid, inp.tl0, inp.keyidx, inp.begin_pic,
-        inp.valid, fwd, drop & inp.begin_pic[:, :, None], switch,
+        inp.valid, fwd, drop, switch,
     )
 
     # ---- BWE per subscriber (uses this tick's actual send counts) ------
@@ -270,7 +272,7 @@ def _room_tick(
     audio_state, linear, is_active = audio.observe_tick(
         state.audio_state, audio_params,
         jnp.where(is_audio_pkt, inp.audio_level, 127),
-        jnp.full((T, K), 20, jnp.int32),
+        inp.frame_ms,
         is_audio_pkt,
         inp.tick_ms,
     )

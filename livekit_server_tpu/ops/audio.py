@@ -83,7 +83,8 @@ def observe_tick(
     # min dBov over the window); inactive windows read as silence.
     obs = jnp.where(was_active, window_min, SILENT_LEVEL)
 
-    alpha = jnp.float32(1.0 / max(params.smooth_intervals, 1))
+    # jnp.maximum (not Python max): params may be traced leaves under jit.
+    alpha = 1.0 / jnp.maximum(jnp.asarray(params.smooth_intervals, jnp.float32), 1.0)
     ema = state.smoothed_level + (obs - state.smoothed_level) * alpha
     # Seed directly on the first active window after silence (the reference
     # seeds smoothedLevel rather than EMA-ing up from digital silence, so a

@@ -1,0 +1,29 @@
+"""Multi-chip scale-out for the media plane.
+
+The reference scales out by pinning rooms to nodes and relaying signal
+messages across nodes over Redis/psrpc (SURVEY.md §5.8,
+pkg/routing/redisrouter.go). The TPU-native equivalent keeps that seam but
+moves the *data plane* onto the device mesh: the `[R]` room axis of
+`PlaneState` / `TickInputs` is sharded across chips over ICI
+(`jax.sharding.Mesh` + NamedSharding), so one jitted `media_plane_tick`
+advances every room on every chip, with XLA inserting any collectives.
+
+Host-side room→shard placement (the analog of RedisRouter room pinning)
+lives in livekit_server_tpu.routing; this package owns the device side.
+"""
+
+from livekit_server_tpu.parallel.mesh import (
+    ROOM_AXIS,
+    make_mesh,
+    make_sharded_tick,
+    room_sharding,
+    shard_tree,
+)
+
+__all__ = [
+    "ROOM_AXIS",
+    "make_mesh",
+    "make_sharded_tick",
+    "room_sharding",
+    "shard_tree",
+]

@@ -1,0 +1,108 @@
+"""Device mesh + shardings for the room axis.
+
+Reference parity: the multi-node scale-out layer (pkg/routing/redisrouter.go
+node registry + room pinning; SURVEY.md §2.3, §5.8). Where the reference
+distributes rooms across *processes* connected by Redis pub/sub, this build
+distributes rooms across *chips* connected by ICI: every media-plane tensor
+carries a leading `[R]` room axis, sharded with
+`NamedSharding(mesh, P("rooms", ...))`. One compiled program steps all
+shards; per-room work never crosses chips, so no collectives are required on
+the hot path — cross-room reductions (node telemetry) are the only psum.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Any, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from livekit_server_tpu.models import plane
+
+ROOM_AXIS = "rooms"
+
+
+def make_mesh(devices: Sequence[jax.Device] | None = None, n_devices: int | None = None) -> Mesh:
+    """1-D mesh over the room axis.
+
+    Rooms are embarrassingly parallel in the data plane (the reference's
+    insight too: a room lives entirely on one node — roomallocator.go), so a
+    1-D mesh is the right shape; within a shard, the tracks/packets/
+    subscriber axes batch onto the MXU/VPU of that chip.
+    """
+    if devices is None:
+        devices = jax.devices()
+    if n_devices is not None:
+        devices = devices[:n_devices]
+    return Mesh(np.asarray(devices), (ROOM_AXIS,))
+
+
+def room_sharding(mesh: Mesh) -> NamedSharding:
+    """Sharding for any tensor with a leading [R] room axis."""
+    return NamedSharding(mesh, P(ROOM_AXIS))
+
+
+def replicated(mesh: Mesh) -> NamedSharding:
+    return NamedSharding(mesh, P())
+
+
+def shard_tree(tree: Any, mesh: Mesh) -> Any:
+    """device_put every leaf with its leading axis split over the mesh.
+
+    Scalar leaves (e.g. tick_ms) are replicated.
+    """
+    rs = room_sharding(mesh)
+    rep = replicated(mesh)
+
+    def put(x):
+        x = jnp.asarray(x)
+        return jax.device_put(x, rep if x.ndim == 0 else rs)
+
+    return jax.tree.map(put, tree)
+
+
+def make_sharded_tick(
+    mesh: Mesh,
+    audio_params: Any | None = None,
+    bwe_params: Any | None = None,
+    donate: bool = True,
+):
+    """jit of the full media-plane tick with room-axis in/out shardings.
+
+    Returns a function (state, inputs) -> (state, outputs); `state` is
+    donated so the per-tick state update is in-place in HBM.
+    """
+    from livekit_server_tpu.ops import audio as audio_ops, bwe as bwe_ops
+
+    ap = audio_params or audio_ops.AudioLevelParams()
+    bp = bwe_params or bwe_ops.BWEParams()
+
+    def tick(state, inp):
+        return plane.media_plane_tick(state, inp, ap, bp)
+
+    rs = room_sharding(mesh)
+    rep = replicated(mesh)
+
+    def specs(tree):
+        return jax.tree.map(lambda x: rep if jnp.asarray(x).ndim == 0 else rs, tree)
+
+    # Shardings are resolved lazily at first call (the caller's state/input
+    # NamedTuples define the tree structure), then the jitted fn is cached so
+    # subsequent ticks hit the compilation cache.
+    cache: dict[str, Any] = {}
+
+    @functools.wraps(tick)
+    def compiled(state, inp):
+        if "fn" not in cache:
+            cache["fn"] = jax.jit(
+                tick,
+                in_shardings=(specs(state), specs(inp)),
+                out_shardings=(specs(state), None),
+                donate_argnums=(0,) if donate else (),
+            )
+        return cache["fn"](state, inp)
+
+    return compiled

@@ -21,7 +21,8 @@ def make_inputs(dims: plane.PlaneDims, **over):
         keyframe=z(jnp.bool_), layer_sync=jnp.ones((R, T, K), jnp.bool_),
         begin_pic=jnp.ones((R, T, K), jnp.bool_),
         pid=z(jnp.int32), tl0=z(jnp.int32), keyidx=z(jnp.int32),
-        size=z(jnp.int32), audio_level=jnp.full((R, T, K), 127, jnp.int32),
+        size=z(jnp.int32), frame_ms=jnp.full((R, T, K), 20, jnp.int32),
+        audio_level=jnp.full((R, T, K), 127, jnp.int32),
         arrival_rtp=z(jnp.int32), valid=jnp.zeros((R, T, K), jnp.bool_),
         estimate=jnp.zeros((R, S), jnp.float32),
         estimate_valid=jnp.zeros((R, S), jnp.bool_),
