@@ -40,19 +40,26 @@ def main() -> None:
         jax.config.update("jax_platforms", "cpu")
 
     dims = plane.PlaneDims(args.rooms, args.tracks, args.pkts, args.subs)
-    spec = synth.TrafficSpec(video_tracks=4, audio_tracks=4)
-
-    state = plane.init_state(dims)
-    meta, ctrl = synth.make_meta_ctrl(dims, spec)
-    state = state._replace(
-        meta=jax.tree.map(jnp.asarray, plane.TrackMeta(*meta)),
-        ctrl=jax.tree.map(jnp.asarray, plane.SubControl(*ctrl)),
+    # Dense, realistic load: 4×3 Mbps simulcast video + 4 Opus tracks per
+    # room at a 20 ms tick ≈ 6-7 video pkts/track/tick (fills ~half the K=16
+    # packet slots; the valid mask gates the rest).
+    spec = synth.TrafficSpec(
+        video_tracks=4, audio_tracks=4, tick_ms=20, video_kbps=3000
     )
+
+    state = synth.make_state(dims, spec)
 
     @jax.jit
     def step(state, writes, inp):
+        # One "write" = one (valid packet, subscribed subscriber) pair put
+        # through the forwarding kernel — exactly the calls the reference
+        # makes to DownTrack.WriteRTP (drops happen inside, there and here).
+        evaluated = jnp.sum(
+            (inp.valid[:, :, :, None] & state.ctrl.subscribed[:, :, None, :]),
+            dtype=jnp.int32,
+        )
         state, out = plane.media_plane_tick(state, inp)
-        return state, writes + jnp.sum(out.send, dtype=jnp.int32), out.fwd_packets
+        return state, writes + evaluated, out.fwd_packets
 
     # Pre-generate host inputs so host-side synthesis isn't in the timed loop
     # (the runtime overlaps ingest packing with the device tick the same way).
@@ -67,17 +74,15 @@ def main() -> None:
         state, writes, _ = step(state, writes, inputs[i])
     jax.block_until_ready(writes)
 
+    writes = jnp.zeros((), jnp.int32)  # count only the timed window
     t0 = time.perf_counter()
     for i in range(args.warmup, args.warmup + args.ticks):
         state, writes, _ = step(state, writes, inputs[i])
-    writes = jax.block_until_ready(writes)
+    writes = int(jax.block_until_ready(writes))
     dt = time.perf_counter() - t0
 
-    # Opportunity writes/sec = every (packet, subscriber) pair evaluated by
-    # the selective-forwarding kernel per wall second; this is the work the
-    # reference performs one goroutine call at a time.
-    pairs = args.rooms * args.tracks * args.pkts * args.subs * args.ticks
-    value = pairs / dt
+    # Same unit as the reference's 50 µs figure: WriteRTP invocations/sec.
+    value = writes / dt
     print(
         json.dumps(
             {

@@ -1,0 +1,17 @@
+"""JWT authentication: access tokens and grants.
+
+Reference parity: livekit/protocol auth (JWT HS256 access tokens carrying
+`video` grants) as enforced by pkg/service/auth.go:45-188 (middleware →
+ClaimGrants in context; permission guards EnsureJoinPermission /
+EnsureAdminPermission / …) and minted by cmd create-join-token.
+"""
+
+from livekit_server_tpu.auth.token import (
+    AccessToken,
+    ClaimGrants,
+    TokenError,
+    VideoGrant,
+    verify_token,
+)
+
+__all__ = ["AccessToken", "ClaimGrants", "TokenError", "VideoGrant", "verify_token"]

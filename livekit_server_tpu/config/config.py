@@ -1,0 +1,297 @@
+"""Typed config schema with YAML/env/CLI merging.
+
+Reference parity: pkg/config/config.go:57-946. The reference's notable
+mechanism — CLI flags generated from the YAML schema via reflection so
+every key is settable by flag or env (GenerateCLIFlags,
+cmd/server/main.go:126-135) — is reproduced here over dataclasses:
+`generate_cli_flags` walks the schema and registers `--rtc.tick-ms`-style
+flags; env vars use `LIVEKIT_`-prefixed upper-snake paths; strict mode
+rejects unknown YAML keys (main.go:197-200).
+
+TPU-specific section: `plane` (tick sizing, tensor capacities, mesh) —
+the knobs of the batched media plane that replace the reference's
+per-goroutine tuning.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import os
+from dataclasses import dataclass, field
+from typing import Any, get_args, get_origin
+
+import yaml
+
+
+class ConfigError(Exception):
+    pass
+
+
+@dataclass
+class RegionConfig:
+    name: str = ""
+    lat: float = 0.0
+    lon: float = 0.0
+
+
+@dataclass
+class NodeSelectorConfig:
+    """pkg/routing/selector — room placement policy."""
+
+    kind: str = "any"            # any | cpuload | sysload | regionaware
+    sort_by: str = "random"      # random | sysload | cpuload | rooms
+    cpu_load_limit: float = 0.9  # cpuload.go CPULoadLimit
+    sysload_limit: float = 0.9   # sysload.go
+    regions: list[RegionConfig] = field(default_factory=list)
+
+
+@dataclass
+class AudioConfig:
+    """pkg/config/config.go AudioConfig — active speaker tuning."""
+
+    active_level: int = 35
+    min_percentile: int = 40
+    update_interval_ms: int = 500
+    smooth_intervals: int = 2
+
+
+@dataclass
+class BWEConfig:
+    """CongestionControlConfig (config.go) — stream allocator tuning."""
+
+    enabled: bool = True
+    allow_pause: bool = False
+    nack_ratio_threshold: float = 0.08
+    nack_window_min_packets: int = 10
+    estimate_required_downgrades: int = 3
+    min_channel_capacity: float = 100_000.0
+    probe_interval_ms: int = 5000
+
+
+@dataclass
+class RTCConfig:
+    """pkg/config RTCConfig — transport + media-plane edges."""
+
+    udp_port: int = 7882
+    tcp_port: int = 7881
+    port_range_start: int = 50000
+    port_range_end: int = 60000
+    use_external_ip: bool = False
+    node_ip: str = ""
+    stun_servers: list[str] = field(default_factory=list)
+    pli_throttle_ms: int = 500         # PLIThrottleConfig
+    congestion_control: BWEConfig = field(default_factory=BWEConfig)
+
+
+@dataclass
+class RoomConfig:
+    """pkg/config RoomConfig."""
+
+    auto_create: bool = True
+    empty_timeout_s: int = 300
+    departure_timeout_s: int = 20
+    max_participants: int = 0
+    enabled_codecs: list[str] = field(
+        default_factory=lambda: [
+            "audio/opus",
+            "audio/red",
+            "video/vp8",
+            "video/h264",
+            "video/vp9",
+            "video/av1",
+        ]
+    )
+    max_metadata_size: int = 0
+    playout_delay_min_ms: int = 0
+    playout_delay_max_ms: int = 0
+
+
+@dataclass
+class LimitsConfig:
+    """config.go LimitConfig — node admission limits."""
+
+    num_tracks: int = 0          # 0 = unlimited
+    bytes_per_sec: float = 0.0
+    subscription_limit_video: int = 0
+    subscription_limit_audio: int = 0
+    max_rooms: int = 0
+
+
+@dataclass
+class PlaneConfig:
+    """TPU media-plane sizing (no reference equivalent — replaces
+    goroutine tuning like receiver.go lbThreshold with tensor capacities)."""
+
+    tick_ms: int = 10
+    rooms: int = 64              # room rows per shard
+    tracks_per_room: int = 16
+    pkts_per_track: int = 16     # packet slots per track per tick
+    subs_per_room: int = 32
+    mesh_devices: int = 0        # 0 = all local devices
+    donate_state: bool = True
+
+
+@dataclass
+class KeyValueConfig:
+    """Shared KV for multi-node state (the reference's Redis seat,
+    redisrouter.go / redisstore.go). kind=memory keeps single-node mode
+    dependency-free (the reference's LocalRouter/LocalStore path)."""
+
+    kind: str = "memory"         # memory | external
+    address: str = ""
+
+
+@dataclass
+class WebHookConfig:
+    """config.go WebHookConfig."""
+
+    urls: list[str] = field(default_factory=list)
+    api_key: str = ""
+
+
+@dataclass
+class Config:
+    """Top-level server config (pkg/config/config.go Config)."""
+
+    bind_addresses: list[str] = field(default_factory=lambda: ["127.0.0.1"])
+    port: int = 7880
+    prometheus_port: int = 0
+    region: str = ""
+    keys: dict[str, str] = field(default_factory=dict)
+    log_level: str = "info"
+    development: bool = False
+    rtc: RTCConfig = field(default_factory=RTCConfig)
+    room: RoomConfig = field(default_factory=RoomConfig)
+    audio: AudioConfig = field(default_factory=AudioConfig)
+    limits: LimitsConfig = field(default_factory=LimitsConfig)
+    node_selector: NodeSelectorConfig = field(default_factory=NodeSelectorConfig)
+    plane: PlaneConfig = field(default_factory=PlaneConfig)
+    kv: KeyValueConfig = field(default_factory=KeyValueConfig)
+    webhook: WebHookConfig = field(default_factory=WebHookConfig)
+
+
+_SCALARS = (int, float, str, bool)
+
+
+def _merge_into(obj: Any, data: dict, path: str = "") -> None:
+    """Strict recursive merge of a dict into a dataclass tree."""
+    names = {f.name: f for f in dataclasses.fields(obj)}
+    for k, v in data.items():
+        key = k.replace("-", "_")
+        if key not in names:
+            raise ConfigError(f"unknown config key: {path + k}")
+        cur = getattr(obj, key)
+        if dataclasses.is_dataclass(cur) and isinstance(v, dict):
+            _merge_into(cur, v, path + k + ".")
+        elif isinstance(cur, list) and names[key].type == "list[RegionConfig]":
+            setattr(obj, key, [RegionConfig(**r) for r in v])
+        else:
+            setattr(obj, key, _coerce(cur, v, path + k))
+
+
+def _coerce(cur: Any, v: Any, path: str) -> Any:
+    if isinstance(cur, bool):
+        if isinstance(v, str):
+            return v.lower() in ("1", "true", "yes", "on")
+        return bool(v)
+    if isinstance(cur, int) and not isinstance(cur, bool):
+        return int(v)
+    if isinstance(cur, float):
+        return float(v)
+    if isinstance(cur, str):
+        return str(v)
+    return v
+
+
+def _walk_scalars(obj: Any, prefix: str = ""):
+    """Yield (dotted_path, field, current_value) for every scalar/list leaf."""
+    for f in dataclasses.fields(obj):
+        v = getattr(obj, f.name)
+        p = f"{prefix}{f.name}"
+        if dataclasses.is_dataclass(v):
+            yield from _walk_scalars(v, p + ".")
+        else:
+            yield p, f, v
+
+
+def generate_cli_flags(parser, config: Config | None = None) -> None:
+    """Register every config leaf as a CLI flag (GenerateCLIFlags analog).
+
+    Dotted paths become flags: plane.tick_ms -> --plane.tick-ms.
+    """
+    config = config or Config()
+    for path, _f, v in _walk_scalars(config):
+        flag = "--" + path.replace("_", "-")
+        if isinstance(v, bool):
+            parser.add_argument(flag, type=str, default=None, metavar="BOOL")
+        elif isinstance(v, (int, float)):
+            parser.add_argument(flag, type=type(v), default=None)
+        elif isinstance(v, str):
+            parser.add_argument(flag, type=str, default=None)
+        elif isinstance(v, list):
+            parser.add_argument(flag, type=str, default=None, metavar="CSV")
+        elif isinstance(v, dict):
+            parser.add_argument(flag, type=str, default=None, metavar="K:V,K:V")
+
+
+def _apply_path(cfg: Config, path: str, raw: Any) -> None:
+    parts = path.split(".")
+    obj = cfg
+    for p in parts[:-1]:
+        obj = getattr(obj, p)
+    cur = getattr(obj, parts[-1])
+    if isinstance(cur, list):
+        raw = [s for s in str(raw).split(",") if s]
+    elif isinstance(cur, dict):
+        raw = dict(kv.split(":", 1) for kv in str(raw).split(",") if ":" in kv)
+    setattr(obj, parts[-1], _coerce(cur, raw, path))
+
+
+ENV_PREFIX = "LIVEKIT_"
+
+
+def load_config(
+    yaml_text: str | None = None,
+    yaml_path: str | None = None,
+    cli_args: Any = None,
+    env: dict[str, str] | None = None,
+) -> Config:
+    """YAML < env < CLI precedence (main.go getConfig order)."""
+    cfg = Config()
+    if yaml_path:
+        with open(yaml_path) as f:
+            yaml_text = f.read()
+    if yaml_text:
+        data = yaml.safe_load(yaml_text) or {}
+        if not isinstance(data, dict):
+            raise ConfigError("config root must be a mapping")
+        _merge_into(cfg, data)
+    env = os.environ if env is None else env
+    paths = {p for p, _f, _v in _walk_scalars(cfg)}
+    for path in sorted(paths):
+        var = ENV_PREFIX + path.replace(".", "_").upper()
+        if var in env:
+            _apply_path(cfg, path, env[var])
+    if cli_args is not None:
+        for path in sorted(paths):
+            attr = path.replace(".", "_").replace("-", "_")
+            # argparse stores --a.b-c under "a.b_c"; normalize both ways.
+            for cand in (path, attr, path.replace("_", "-")):
+                v = getattr(cli_args, cand, None) if not isinstance(cli_args, dict) else cli_args.get(cand)
+                if v is not None:
+                    _apply_path(cfg, path, v)
+                    break
+    _validate(cfg)
+    return cfg
+
+
+def _validate(cfg: Config) -> None:
+    if not cfg.development and not cfg.keys:
+        raise ConfigError("one or more API keys are required (or set development: true)")
+    if cfg.development and not cfg.keys:
+        # dev-mode auto keys (main.go:208-246)
+        cfg.keys = {"devkey": "secret"}
+    p = cfg.plane
+    for name in ("tick_ms", "rooms", "tracks_per_room", "pkts_per_track", "subs_per_room"):
+        if getattr(p, name) <= 0:
+            raise ConfigError(f"plane.{name} must be positive")

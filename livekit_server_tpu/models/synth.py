@@ -83,6 +83,20 @@ def make_meta_ctrl(dims: plane.PlaneDims, spec: TrafficSpec):
     return meta, ctrl
 
 
+def make_state(dims: plane.PlaneDims, spec: TrafficSpec) -> plane.PlaneState:
+    """Device-ready PlaneState with this spec's tracks published and every
+    subscriber subscribed (the standard bench/test/entry setup)."""
+    import jax
+    import jax.numpy as jnp
+
+    meta, ctrl = make_meta_ctrl(dims, spec)
+    state = plane.init_state(dims)
+    return state._replace(
+        meta=jax.tree.map(jnp.asarray, plane.TrackMeta(*meta)),
+        ctrl=jax.tree.map(jnp.asarray, plane.SubControl(*ctrl)),
+    )
+
+
 def next_tick(
     state: TrafficState,
     dims: plane.PlaneDims,
@@ -133,6 +147,10 @@ def next_tick(
     )
     begin_pic = np.logical_and(is_video[None, :, None], new_frame[:, :, None])
     layer_sync = keyframe | (begin_pic & (temporal == 0))
+
+    # First packet of the new picture only (per spatial layer, one packet
+    # carries begin_pic — layer == k for k < 3 under the k%3 cycling).
+    begin_pic = begin_pic & (k_idx[None, None, :] == layer)
 
     pid_inc = new_frame.astype(np.int64)
     pid = (state.pid + pid_inc)[:, :, None] & 0x7FFF

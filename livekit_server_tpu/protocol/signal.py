@@ -1,0 +1,122 @@
+"""Signal protocol: the /rtc WebSocket message surface.
+
+Reference parity: livekit.SignalRequest / livekit.SignalResponse oneofs as
+dispatched by pkg/rtc/signalhandler.go:24-97 (14 request variants) and
+emitted throughout pkg/rtc (JoinResponse room.go:935, ParticipantUpdate,
+SpeakersChanged, StreamStateUpdate, …). Framing is the JSON oneof shape of
+the reference's JSON signal mode (pkg/service/wsprotocol.go): one
+single-key object `{"<variant>": {...}}`.
+
+Messages are tagged unions: `SignalRequest(kind, data)` where `kind` names
+the oneof arm and `data` is the payload dict (typed payload dataclasses in
+protocol.models are used for the structured ones). This keeps the wire
+surface complete without a protobuf toolchain; a protobuf codec can slot in
+behind encode/decode later without touching callers.
+"""
+
+from __future__ import annotations
+
+import json
+from dataclasses import dataclass, field
+from typing import Any
+
+# Request variants a client may send (signalhandler.go:24-97).
+REQUEST_KINDS = frozenset(
+    {
+        "offer",            # publisher SDP offer
+        "answer",           # subscriber SDP answer
+        "trickle",          # ICE candidate
+        "add_track",        # AddTrackRequest
+        "mute",             # MuteTrackRequest
+        "subscription",     # UpdateSubscription
+        "track_setting",    # UpdateTrackSettings (quality/dims/fps)
+        "leave",            # LeaveRequest
+        "update_layers",    # UpdateVideoLayers (deprecated upstream, kept)
+        "subscription_permission",  # per-publisher subscription grants
+        "sync_state",       # resume: replay subscriptions/tracks
+        "simulate",         # fault injection scenarios
+        "ping",             # rtt ping (responds pong)
+        "update_metadata",  # participant metadata/name/attributes
+    }
+)
+
+# Response variants the server may send.
+RESPONSE_KINDS = frozenset(
+    {
+        "join",
+        "answer",
+        "offer",
+        "trickle",
+        "update",                    # ParticipantUpdate
+        "track_published",
+        "track_unpublished",
+        "leave",
+        "mute",
+        "speakers_changed",
+        "room_update",
+        "connection_quality",
+        "stream_state_update",
+        "subscribed_quality_update",
+        "subscription_permission_update",
+        "refresh_token",
+        "pong",
+        "reconnect",
+        "subscription_response",
+        "request_response",
+        "track_subscribed",
+    }
+)
+
+
+@dataclass
+class SignalRequest:
+    kind: str
+    data: dict[str, Any] = field(default_factory=dict)
+
+    def __post_init__(self):
+        if self.kind not in REQUEST_KINDS:
+            raise ValueError(f"unknown signal request kind: {self.kind!r}")
+
+
+@dataclass
+class SignalResponse:
+    kind: str
+    data: dict[str, Any] = field(default_factory=dict)
+
+    def __post_init__(self):
+        if self.kind not in RESPONSE_KINDS:
+            raise ValueError(f"unknown signal response kind: {self.kind!r}")
+
+
+def _encode(kind: str, data: dict) -> str:
+    return json.dumps({kind: data}, separators=(",", ":"))
+
+
+def _decode(raw: str | bytes, kinds: frozenset[str], what: str) -> tuple[str, dict]:
+    msg = json.loads(raw)
+    if not isinstance(msg, dict) or len(msg) != 1:
+        raise ValueError(f"{what}: expected single-key oneof object")
+    kind, data = next(iter(msg.items()))
+    if kind not in kinds:
+        raise ValueError(f"{what}: unknown variant {kind!r}")
+    if data is None:
+        data = {}
+    if not isinstance(data, dict):
+        raise ValueError(f"{what}: payload for {kind!r} must be an object")
+    return kind, data
+
+
+def encode_signal_request(req: SignalRequest) -> str:
+    return _encode(req.kind, req.data)
+
+
+def decode_signal_request(raw: str | bytes) -> SignalRequest:
+    return SignalRequest(*_decode(raw, REQUEST_KINDS, "SignalRequest"))
+
+
+def encode_signal_response(resp: SignalResponse) -> str:
+    return _encode(resp.kind, resp.data)
+
+
+def decode_signal_response(raw: str | bytes) -> SignalResponse:
+    return SignalResponse(*_decode(raw, RESPONSE_KINDS, "SignalResponse"))

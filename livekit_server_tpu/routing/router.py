@@ -1,0 +1,309 @@
+"""Routers: room→node mapping + participant signal start.
+
+Reference parity: pkg/routing interfaces (interfaces.go:83-114 Router /
+MessageRouter), LocalRouter (localrouter.go:32-147) for single-node, and
+the Redis-backed router (redisrouter.go:48-311) for multi-node — node
+registry, room pinning, signal relay, keep-alive stats. The KVRouter here
+runs the same protocol over a MessageBus; with MemoryBus it reproduces the
+reference's multi-node tests (N nodes, one process) and with a real KV it
+scales to hosts.
+
+Signal relay: StartParticipantSignal returns (connection_id, request_sink,
+response_source). On the RTC-node side the registered session handler is
+invoked with mirrored channels (signal.go RelaySignal stream semantics:
+sequence-numbered envelopes, drop-on-overflow).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import json
+import time
+from dataclasses import dataclass
+from typing import Awaitable, Callable, Protocol
+
+from livekit_server_tpu.routing.kv import MemoryBus, MessageBus
+from livekit_server_tpu.routing.messagechannel import MessageChannel
+from livekit_server_tpu.routing.node import LocalNode, NodeState
+from livekit_server_tpu.routing.selector import NodeSelector
+from livekit_server_tpu.utils import ids
+
+NODES_KEY = "nodes"            # redisrouter.go NodesKey hash
+NODE_ROOM_KEY = "room_node_map"  # NodeRoomKey hash
+STATS_MAX_AGE = 30.0
+
+# handler(room_name, participant_init, request_source, response_sink)
+SessionHandler = Callable[[str, dict, MessageChannel, MessageChannel], Awaitable[None]]
+
+
+class RouterError(Exception):
+    pass
+
+
+@dataclass
+class ParticipantInit:
+    """routing.ParticipantInit (interfaces.go) — session start params."""
+
+    identity: str
+    name: str = ""
+    reconnect: bool = False
+    reconnect_reason: int = 0
+    auto_subscribe: bool = True
+    client_info: dict | None = None
+    grants: dict | None = None
+    region: str = ""
+    connection_id: str = ""
+
+    def to_dict(self) -> dict:
+        return vars(self)
+
+    @classmethod
+    def from_dict(cls, d: dict) -> "ParticipantInit":
+        return cls(**d)
+
+
+class Router(Protocol):
+    local_node: LocalNode
+
+    async def register_node(self) -> None: ...
+    async def unregister_node(self) -> None: ...
+    async def list_nodes(self) -> list[LocalNode]: ...
+    async def get_node_for_room(self, room_name: str) -> str: ...
+    async def set_node_for_room(self, room_name: str, node_id: str) -> None: ...
+    async def clear_room_state(self, room_name: str) -> None: ...
+    def on_new_session(self, handler: SessionHandler) -> None: ...
+    async def start_participant_signal(
+        self, room_name: str, init: ParticipantInit
+    ) -> tuple[str, MessageChannel, MessageChannel]: ...
+    async def drain(self) -> None: ...
+
+
+class LocalRouter:
+    """Single-node router (localrouter.go:32): identity mapping, in-memory
+    channels, no external bus."""
+
+    def __init__(self, local_node: LocalNode):
+        self.local_node = local_node
+        self._handler: SessionHandler | None = None
+        self._room_nodes: dict[str, str] = {}
+        # Strong refs: the event loop only weakly references tasks, so
+        # untracked fire-and-forget sessions could be GC'd mid-flight.
+        self._tasks: set[asyncio.Task] = set()
+
+    def _track(self, task: asyncio.Task) -> asyncio.Task:
+        self._tasks.add(task)
+        task.add_done_callback(self._tasks.discard)
+        return task
+
+    async def register_node(self) -> None:
+        self.local_node.stats.updated_at = time.time()
+
+    async def unregister_node(self) -> None:
+        pass
+
+    async def list_nodes(self) -> list[LocalNode]:
+        return [self.local_node]
+
+    async def get_node_for_room(self, room_name: str) -> str:
+        return self._room_nodes.get(room_name, "")
+
+    async def set_node_for_room(self, room_name: str, node_id: str) -> None:
+        self._room_nodes[room_name] = node_id
+
+    async def clear_room_state(self, room_name: str) -> None:
+        self._room_nodes.pop(room_name, None)
+
+    def on_new_session(self, handler: SessionHandler) -> None:
+        self._handler = handler
+
+    async def start_participant_signal(
+        self, room_name: str, init: ParticipantInit
+    ) -> tuple[str, MessageChannel, MessageChannel]:
+        if self._handler is None:
+            raise RouterError("no session handler registered")
+        connection_id = ids.new_connection_id()
+        init.connection_id = connection_id
+        req = MessageChannel(connection_id=connection_id)
+        resp = MessageChannel(connection_id=connection_id)
+        self._track(asyncio.ensure_future(self._handler(room_name, init.to_dict(), req, resp)))
+        return connection_id, req, resp
+
+    async def drain(self) -> None:
+        self.local_node.state = NodeState.SHUTTING_DOWN
+
+
+class KVRouter(LocalRouter):
+    """Multi-node router over a MessageBus (redisrouter.go:48).
+
+    Nodes register in the NODES_KEY hash, heartbeat stats every
+    `stats_interval`, pin rooms in NODE_ROOM_KEY, and relay signal messages
+    over per-connection pub/sub channels with sequence numbers
+    (signal.go:220-239 seq reconciliation: gaps are surfaced as relay
+    errors rather than silently reordered).
+    """
+
+    def __init__(self, local_node: LocalNode, bus: MessageBus, stats_interval: float = 2.0):
+        super().__init__(local_node)
+        self.bus = bus
+        self.stats_interval = stats_interval
+        self._stats_task: asyncio.Task | None = None
+        self._session_task: asyncio.Task | None = None
+        self._session_sub = None
+
+    # -- node registry --------------------------------------------------
+    async def register_node(self) -> None:
+        self.local_node.stats.updated_at = time.time()
+        await self.bus.hset(NODES_KEY, self.local_node.node_id, json.dumps(self.local_node.to_dict()))
+        self._session_sub = self.bus.subscribe(f"node_session:{self.local_node.node_id}")
+        self._stats_task = self._track(asyncio.ensure_future(self._stats_worker()))
+        self._session_task = self._track(asyncio.ensure_future(self._session_worker()))
+
+    async def unregister_node(self) -> None:
+        if self._stats_task:
+            self._stats_task.cancel()
+        if self._session_task:
+            self._session_task.cancel()
+        if self._session_sub is not None:
+            self._session_sub.close()
+        await self.bus.hdel(NODES_KEY, self.local_node.node_id)
+
+    async def remove_dead_nodes(self) -> None:
+        """redisrouter.go RemoveDeadNodes — reap stale registry entries."""
+        for node in await self.list_nodes():
+            if not node.is_available(STATS_MAX_AGE) and node.node_id != self.local_node.node_id:
+                await self.bus.hdel(NODES_KEY, node.node_id)
+
+    async def _stats_worker(self) -> None:
+        while True:
+            await asyncio.sleep(self.stats_interval)
+            self.local_node.stats.updated_at = time.time()
+            await self.bus.hset(
+                NODES_KEY, self.local_node.node_id, json.dumps(self.local_node.to_dict())
+            )
+
+    async def list_nodes(self) -> list[LocalNode]:
+        raw = await self.bus.hgetall(NODES_KEY)
+        return [LocalNode.from_dict(json.loads(v)) for v in raw.values()]
+
+    # -- room pinning ---------------------------------------------------
+    async def get_node_for_room(self, room_name: str) -> str:
+        return await self.bus.hget(NODE_ROOM_KEY, room_name) or ""
+
+    async def set_node_for_room(self, room_name: str, node_id: str) -> None:
+        await self.bus.hset(NODE_ROOM_KEY, room_name, node_id)
+
+    async def clear_room_state(self, room_name: str) -> None:
+        await self.bus.hdel(NODE_ROOM_KEY, room_name)
+
+    # -- signal relay ---------------------------------------------------
+    async def start_participant_signal(
+        self, room_name: str, init: ParticipantInit
+    ) -> tuple[str, MessageChannel, MessageChannel]:
+        node_id = await self.get_node_for_room(room_name)
+        if not node_id:
+            raise RouterError(f"no node for room {room_name}")
+        if node_id == self.local_node.node_id and self._handler is not None:
+            return await super().start_participant_signal(room_name, init)
+
+        connection_id = ids.new_connection_id()
+        init.connection_id = connection_id
+        req = MessageChannel(connection_id=connection_id)
+        resp = MessageChannel(connection_id=connection_id)
+        resp_sub = self.bus.subscribe(f"signal_resp:{connection_id}")
+
+        await self.bus.publish(
+            f"node_session:{node_id}",
+            json.dumps({"room": room_name, "init": init.to_dict()}),
+        )
+
+        async def pump_requests():
+            seq = 0
+            try:
+                while True:
+                    msg = await req.read_message()
+                    seq += 1
+                    await self.bus.publish(
+                        f"signal_req:{connection_id}", json.dumps({"seq": seq, "msg": msg})
+                    )
+            except Exception:
+                await self.bus.publish(f"signal_req:{connection_id}", json.dumps({"close": True}))
+
+        async def pump_responses():
+            expect = 0
+            try:
+                async for raw in resp_sub:
+                    env = json.loads(raw)
+                    if env.get("close"):
+                        break
+                    expect += 1
+                    if env["seq"] != expect:
+                        break  # relay gap ⇒ force client reconnect (signal.go:232)
+                    resp.write_message(env["msg"])
+            finally:
+                resp.close()
+                resp_sub.close()
+
+        self._track(asyncio.ensure_future(pump_requests()))
+        self._track(asyncio.ensure_future(pump_responses()))
+        return connection_id, req, resp
+
+    async def _session_worker(self) -> None:
+        """RTC-node side: receive session starts, bridge bus↔handler."""
+        assert self._session_sub is not None
+        async for raw in self._session_sub:
+            msg = json.loads(raw)
+            if self._handler is None:
+                continue
+            init = ParticipantInit.from_dict(msg["init"])
+            connection_id = init.connection_id
+            req = MessageChannel(connection_id=connection_id)
+            resp = MessageChannel(connection_id=connection_id)
+            req_sub = self.bus.subscribe(f"signal_req:{connection_id}")
+
+            async def pump_in(req_sub=req_sub, req=req):
+                expect = 0
+                try:
+                    async for raw_req in req_sub:
+                        env = json.loads(raw_req)
+                        if env.get("close"):
+                            break
+                        expect += 1
+                        if env["seq"] != expect:
+                            break  # dropped request envelope ⇒ kill session,
+                            # client reconnects (signal.go:232 semantics)
+                        req.write_message(env["msg"])
+                finally:
+                    req.close()
+                    req_sub.close()
+
+            async def pump_out(resp=resp, connection_id=connection_id):
+                seq = 0
+                try:
+                    while True:
+                        msg_out = await resp.read_message()
+                        seq += 1
+                        await self.bus.publish(
+                            f"signal_resp:{connection_id}",
+                            json.dumps({"seq": seq, "msg": msg_out}),
+                        )
+                except Exception:
+                    await self.bus.publish(
+                        f"signal_resp:{connection_id}", json.dumps({"close": True})
+                    )
+
+            self._track(asyncio.ensure_future(pump_in()))
+            self._track(asyncio.ensure_future(pump_out()))
+            self._track(
+                asyncio.ensure_future(self._handler(msg["room"], msg["init"], req, resp))
+            )
+
+    async def drain(self) -> None:
+        self.local_node.state = NodeState.SHUTTING_DOWN
+        await self.bus.hset(NODES_KEY, self.local_node.node_id, json.dumps(self.local_node.to_dict()))
+
+
+def create_router(local_node: LocalNode, bus: MessageBus | None) -> Router:
+    """interfaces.go:116 CreateRouter — bus present ⇒ distributed."""
+    if bus is None:
+        return LocalRouter(local_node)
+    return KVRouter(local_node, bus)

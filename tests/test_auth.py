@@ -1,0 +1,73 @@
+"""JWT auth tests (reference: pkg/service/auth_test.go + livekit/protocol
+auth semantics)."""
+
+import pytest
+
+from livekit_server_tpu.auth import AccessToken, TokenError, VideoGrant, verify_token
+
+KEYS = {"APIkey1": "secret1", "APIkey2": "secret2"}
+
+
+def mint(**grant_kw):
+    t = AccessToken("APIkey1", "secret1")
+    t.identity = "alice"
+    t.grant = VideoGrant(**grant_kw)
+    return t
+
+
+def test_round_trip_grants():
+    tok = mint(room_join=True, room="lobby", can_publish=False).to_jwt()
+    claims = verify_token(tok, KEYS)
+    assert claims.identity == "alice"
+    assert claims.video.room_join is True
+    assert claims.video.room == "lobby"
+    assert claims.video.can_publish is False
+    assert claims.video.can_subscribe is None  # unset stays unset
+
+
+def test_wrong_secret_rejected():
+    tok = mint(room_join=True, room="x").to_jwt()
+    with pytest.raises(TokenError, match="signature"):
+        verify_token(tok, {"APIkey1": "wrong"})
+
+
+def test_unknown_key_rejected():
+    t = AccessToken("APIother", "s")
+    t.identity = "a"
+    with pytest.raises(TokenError, match="unknown API key"):
+        verify_token(t.to_jwt(), KEYS)
+
+
+def test_expired_rejected():
+    t = mint(room_join=True, room="x")
+    tok = t.to_jwt(now=1000)
+    with pytest.raises(TokenError, match="expired"):
+        verify_token(tok, KEYS, now=1000 + t.ttl + 1)
+    # still valid just before expiry
+    assert verify_token(tok, KEYS, now=1000 + t.ttl - 1).identity == "alice"
+
+
+def test_tampered_payload_rejected():
+    tok = mint(room_join=True, room="x").to_jwt()
+    h, p, s = tok.split(".")
+    import base64, json
+    payload = json.loads(base64.urlsafe_b64decode(p + "=" * (-len(p) % 4)))
+    payload["video"]["roomAdmin"] = True
+    p2 = base64.urlsafe_b64encode(
+        json.dumps(payload).encode()
+    ).rstrip(b"=").decode()
+    with pytest.raises(TokenError):
+        verify_token(f"{h}.{p2}.{s}", KEYS)
+
+
+def test_join_token_requires_identity():
+    t = AccessToken("APIkey1", "secret1")
+    t.grant = VideoGrant(room_join=True, room="x")
+    with pytest.raises(TokenError, match="identity"):
+        t.to_jwt()
+
+
+def test_malformed_tokens():
+    for bad in ["", "a.b", "a.b.c.d", "x.y.z"]:
+        with pytest.raises(TokenError):
+            verify_token(bad, KEYS)

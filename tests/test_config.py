@@ -1,0 +1,76 @@
+"""Config system tests (reference: pkg/config/config.go defaults +
+strict unmarshal, cmd/server/main.go flag/env merging)."""
+
+import argparse
+
+import pytest
+
+from livekit_server_tpu.config import Config, ConfigError, generate_cli_flags, load_config
+
+
+def test_defaults_dev_mode():
+    cfg = load_config(yaml_text="development: true")
+    assert cfg.port == 7880
+    assert cfg.keys == {"devkey": "secret"}  # dev auto-keys (main.go:208)
+    assert cfg.plane.tick_ms == 10
+    assert cfg.rtc.congestion_control.enabled is True
+
+
+def test_keys_required_outside_dev():
+    with pytest.raises(ConfigError, match="API keys"):
+        load_config(yaml_text="port: 7880")
+
+
+def test_yaml_nested_merge_and_strictness():
+    cfg = load_config(
+        yaml_text="""
+development: true
+port: 9000
+rtc:
+  udp_port: 8882
+  congestion_control:
+    nack_ratio_threshold: 0.2
+plane:
+  rooms: 128
+node_selector:
+  kind: regionaware
+  regions:
+    - name: us-west
+      lat: 37.6
+      lon: -122.4
+"""
+    )
+    assert cfg.port == 9000
+    assert cfg.rtc.udp_port == 8882
+    assert cfg.rtc.congestion_control.nack_ratio_threshold == 0.2
+    assert cfg.plane.rooms == 128
+    assert cfg.node_selector.regions[0].name == "us-west"
+    # strict unknown-key rejection (main.go:197-200)
+    with pytest.raises(ConfigError, match="unknown config key: bogus"):
+        load_config(yaml_text="development: true\nbogus: 1")
+    with pytest.raises(ConfigError, match="rtc.nope"):
+        load_config(yaml_text="development: true\nrtc:\n  nope: 1")
+
+
+def test_env_overrides_yaml():
+    cfg = load_config(
+        yaml_text="development: true\nport: 9000",
+        env={"LIVEKIT_PORT": "9100", "LIVEKIT_PLANE_TICK_MS": "5"},
+    )
+    assert cfg.port == 9100
+    assert cfg.plane.tick_ms == 5
+
+
+def test_cli_overrides_env():
+    parser = argparse.ArgumentParser()
+    generate_cli_flags(parser)
+    args = parser.parse_args(["--port", "9999", "--plane.rooms", "256", "--keys", "k:s"])
+    cfg = load_config(yaml_text=None, cli_args=args, env={"LIVEKIT_PORT": "9100"})
+    assert cfg.port == 9999
+    assert cfg.plane.rooms == 256
+    assert cfg.keys == {"k": "s"}
+
+
+def test_invalid_plane_sizes():
+    with pytest.raises(ConfigError, match="plane.tick_ms"):
+        load_config(yaml_text="development: true\nplane:\n  tick_ms: 0")

@@ -22,13 +22,7 @@ def mesh():
 
 
 def _setup(dims, spec):
-    state = plane.init_state(dims)
-    meta, ctrl = synth.make_meta_ctrl(dims, spec)
-    state = state._replace(
-        meta=jax.tree.map(jnp.asarray, plane.TrackMeta(*meta)),
-        ctrl=jax.tree.map(jnp.asarray, plane.SubControl(*ctrl)),
-    )
-    return state
+    return synth.make_state(dims, spec)
 
 
 def test_sharded_tick_matches_single_device(mesh):

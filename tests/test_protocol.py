@@ -1,0 +1,77 @@
+"""Protocol model + signal framing tests (reference: livekit/protocol
+types, pkg/service/wsprotocol.go JSON mode)."""
+
+import json
+
+import pytest
+
+from livekit_server_tpu.protocol import (
+    ParticipantInfo,
+    ParticipantPermission,
+    RoomInfo,
+    SignalRequest,
+    SignalResponse,
+    TrackInfo,
+    TrackType,
+    decode_signal_request,
+    decode_signal_response,
+    encode_signal_request,
+    encode_signal_response,
+)
+
+
+def test_model_round_trip():
+    p = ParticipantInfo(
+        sid="PA_abc",
+        identity="alice",
+        tracks=[TrackInfo(sid="TR_x", type=TrackType.VIDEO, simulcast=True)],
+        permission=ParticipantPermission(can_publish=False),
+    )
+    d = p.to_dict()
+    assert d["tracks"][0]["type"] == 1
+    back = ParticipantInfo.from_dict(json.loads(json.dumps(d)))
+    assert back.identity == "alice"
+    assert back.tracks[0].sid == "TR_x"
+    assert back.tracks[0].simulcast is True
+    assert back.permission.can_publish is False
+
+
+def test_room_info_defaults():
+    r = RoomInfo(name="lobby")
+    assert r.empty_timeout == 300
+    assert RoomInfo.from_dict(r.to_dict()).name == "lobby"
+
+
+def test_signal_request_round_trip():
+    req = SignalRequest("add_track", {"cid": "c1", "type": 1, "name": "cam"})
+    raw = encode_signal_request(req)
+    assert json.loads(raw) == {"add_track": {"cid": "c1", "type": 1, "name": "cam"}}
+    back = decode_signal_request(raw)
+    assert back.kind == "add_track" and back.data["cid"] == "c1"
+
+
+def test_signal_response_round_trip():
+    resp = SignalResponse("speakers_changed", {"speakers": [{"sid": "PA_1", "level": 0.4}]})
+    back = decode_signal_response(encode_signal_response(resp))
+    assert back.data["speakers"][0]["sid"] == "PA_1"
+
+
+def test_unknown_kind_rejected():
+    with pytest.raises(ValueError):
+        SignalRequest("bogus")
+    with pytest.raises(ValueError):
+        decode_signal_request('{"bogus": {}}')
+    with pytest.raises(ValueError):
+        decode_signal_request('{"offer": {}, "answer": {}}')
+    with pytest.raises(ValueError):
+        decode_signal_request('{"offer": 5}')
+
+
+def test_every_reference_request_variant_supported():
+    # signalhandler.go:24-97 dispatches these 14 oneof arms.
+    for kind in [
+        "offer", "answer", "trickle", "add_track", "mute", "subscription",
+        "track_setting", "leave", "update_layers", "subscription_permission",
+        "sync_state", "simulate", "ping", "update_metadata",
+    ]:
+        assert decode_signal_request(json.dumps({kind: {}})).kind == kind

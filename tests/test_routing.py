@@ -1,0 +1,181 @@
+"""Routing layer tests.
+
+Reference parity: pkg/routing/selector/*_test.go (policy tests with
+synthetic node stats), router room pinning + signal relay
+(pkg/routing/redisrouter.go), bounded channel drop semantics
+(messagechannel.go).
+"""
+
+import asyncio
+import time
+
+import pytest
+
+from livekit_server_tpu.config.config import NodeSelectorConfig, RegionConfig
+from livekit_server_tpu.routing import (
+    AnySelector,
+    CPULoadSelector,
+    ChannelClosed,
+    ChannelFull,
+    KVRouter,
+    LocalNode,
+    LocalRouter,
+    MemoryBus,
+    MessageChannel,
+    NodeStats,
+    ParticipantInit,
+    RegionAwareSelector,
+    create_selector,
+)
+from livekit_server_tpu.routing.selector import NoNodesAvailable
+
+
+def node(nid="n1", region="", cpu=0.1, rooms_used=0, cap=0, fresh=True):
+    n = LocalNode(node_id=nid, region=region)
+    n.stats = NodeStats(
+        updated_at=time.time() if fresh else time.time() - 120,
+        cpu_load=cpu,
+        plane_rooms_used=rooms_used,
+        plane_rooms_capacity=cap,
+    )
+    return n
+
+
+# ---- selectors (cpuload_test.go style) --------------------------------
+
+def test_any_selector_skips_stale():
+    live, stale = node("a"), node("b", fresh=False)
+    assert AnySelector().select_node([stale, live]).node_id == "a"
+    with pytest.raises(NoNodesAvailable):
+        AnySelector().select_node([stale])
+
+
+def test_cpu_load_selector():
+    low, high = node("low", cpu=0.2), node("high", cpu=0.95)
+    sel = CPULoadSelector(cpu_load_limit=0.9, sort_by="cpuload")
+    assert sel.select_node([high, low]).node_id == "low"
+    # all above limit ⇒ falls back rather than failing (reference behavior)
+    assert sel.select_node([high]).node_id == "high"
+
+
+def test_plane_capacity_gate():
+    full = node("full", rooms_used=64, cap=64)
+    free = node("free", rooms_used=3, cap=64)
+    assert AnySelector().select_node([full, free]).node_id == "free"
+    with pytest.raises(NoNodesAvailable):
+        AnySelector().select_node([full])
+
+
+def test_region_aware_selector():
+    regions = [
+        RegionConfig("us-west", 37.64, -122.43),
+        RegionConfig("us-east", 40.68, -74.12),
+        RegionConfig("eu", 53.43, 6.84),
+    ]
+    sel = RegionAwareSelector("us-west", regions, sort_by="cpuload")
+    nodes = [node("east", region="us-east"), node("eu", region="eu"), node("west", region="us-west")]
+    assert sel.select_node(nodes).node_id == "west"
+    # no local-region node ⇒ nearest region wins (us-east < eu from us-west)
+    assert sel.select_node(nodes[:2]).node_id == "east"
+
+
+def test_create_selector_kinds():
+    for kind in ("any", "cpuload", "sysload", "regionaware"):
+        assert create_selector(NodeSelectorConfig(kind=kind)) is not None
+    with pytest.raises(ValueError):
+        create_selector(NodeSelectorConfig(kind="bogus"))
+
+
+# ---- message channel --------------------------------------------------
+
+@pytest.mark.asyncio
+async def test_channel_drop_on_full_and_close():
+    ch = MessageChannel(size=2)
+    ch.write_message({"a": 1})
+    ch.write_message({"a": 2})
+    with pytest.raises(ChannelFull):
+        ch.write_message({"a": 3})
+    assert await ch.read_message() == {"a": 1}
+    ch.close()
+    assert await ch.read_message() == {"a": 2}
+    with pytest.raises(ChannelClosed):
+        await ch.read_message()
+    with pytest.raises(ChannelClosed):
+        ch.write_message({"a": 4})
+
+
+# ---- routers ----------------------------------------------------------
+
+async def echo_handler(room, init, req, resp):
+    """Session handler: echoes requests with the room tag."""
+    try:
+        while True:
+            msg = await req.read_message()
+            resp.write_message({"room": room, "echo": msg, "identity": init["identity"]})
+    except ChannelClosed:
+        resp.close()
+
+
+@pytest.mark.asyncio
+async def test_local_router_session():
+    router = LocalRouter(LocalNode(node_id="n1"))
+    router.on_new_session(echo_handler)
+    cid, req, resp = await router.start_participant_signal(
+        "lobby", ParticipantInit(identity="alice")
+    )
+    assert cid.startswith("CO_")
+    req.write_message({"ping": 1})
+    out = await asyncio.wait_for(resp.read_message(), 2)
+    assert out == {"room": "lobby", "echo": {"ping": 1}, "identity": "alice"}
+
+
+@pytest.mark.asyncio
+async def test_kv_router_cross_node_relay():
+    """Two logical nodes, one bus — the reference's multinode test shape."""
+    bus = MemoryBus()
+    rtc_node = KVRouter(LocalNode(node_id="rtc"), bus)
+    signal_node = KVRouter(LocalNode(node_id="sig"), bus)
+    rtc_node.on_new_session(echo_handler)
+    await rtc_node.register_node()
+    await signal_node.register_node()
+    try:
+        nodes = {n.node_id for n in await signal_node.list_nodes()}
+        assert nodes == {"rtc", "sig"}
+
+        await signal_node.set_node_for_room("lobby", "rtc")
+        assert await rtc_node.get_node_for_room("lobby") == "rtc"
+
+        cid, req, resp = await signal_node.start_participant_signal(
+            "lobby", ParticipantInit(identity="bob")
+        )
+        req.write_message({"offer": {"sdp": "x"}})
+        out = await asyncio.wait_for(resp.read_message(), 2)
+        assert out["echo"] == {"offer": {"sdp": "x"}}
+        assert out["identity"] == "bob"
+
+        await signal_node.clear_room_state("lobby")
+        assert await rtc_node.get_node_for_room("lobby") == ""
+    finally:
+        await rtc_node.unregister_node()
+        await signal_node.unregister_node()
+
+
+@pytest.mark.asyncio
+async def test_kv_router_heartbeat_and_reap():
+    bus = MemoryBus()
+    a = KVRouter(LocalNode(node_id="a"), bus, stats_interval=0.05)
+    await a.register_node()
+    try:
+        t0 = (await a.list_nodes())[0].stats.updated_at
+        await asyncio.sleep(0.12)
+        t1 = (await a.list_nodes())[0].stats.updated_at
+        assert t1 > t0  # heartbeat refreshed
+        # dead-node reap
+        stale = LocalNode(node_id="dead")
+        stale.stats.updated_at = time.time() - 300
+        import json
+        await bus.hset("nodes", "dead", json.dumps(stale.to_dict()))
+        await a.remove_dead_nodes()
+        assert {n.node_id for n in await a.list_nodes()} == {"a"}
+    finally:
+        await a.unregister_node()
