@@ -1,0 +1,4 @@
+from livekit_server_tpu.cli import main
+import sys
+
+sys.exit(main())

@@ -103,6 +103,7 @@ class AccessToken:
         self.attributes: dict[str, str] = {}
         self.kind = ""
         self.grant = VideoGrant()
+        self.sha256 = ""  # body-integrity claim (webhook signing)
         self.ttl = 6 * 3600  # auth defaultValidDuration
 
     def to_jwt(self, now: int | None = None) -> str:
@@ -127,6 +128,8 @@ class AccessToken:
             payload["attributes"] = self.attributes
         if self.kind:
             payload["kind"] = self.kind
+        if self.sha256:
+            payload["sha256"] = self.sha256
         signing = _b64url(json.dumps(header, separators=(",", ":")).encode()) + "." + _b64url(
             json.dumps(payload, separators=(",", ":")).encode()
         )

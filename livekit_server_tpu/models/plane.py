@@ -121,14 +121,25 @@ class TickInputs(NamedTuple):
 
 
 class TickOutputs(NamedTuple):
-    """Egress + signal tensors pulled by the host after each tick."""
+    """Egress + signal tensors pulled by the host after each tick.
 
-    send: jax.Array       # [R, T, K, S] bool — forward packet k to sub s
-    out_sn: jax.Array     # [R, T, K, S] int32
-    out_ts: jax.Array     # [R, T, K, S] int32
-    out_pid: jax.Array    # [R, T, K, S] int32 (video only)
-    out_tl0: jax.Array    # [R, T, K, S] int32
-    out_keyidx: jax.Array # [R, T, K, S] int32
+    Egress is COMPACTED on device: instead of dense [R, T, K, S] grids
+    (whose device→host transfer dominates the tick on a remote/tunneled
+    chip), each room returns up to `egress_cap` (track,pkt,sub) writes as a
+    fixed-size index list + gathered fields. Compaction is per-room
+    (jnp.nonzero(size=cap) under vmap), so the room axis stays shardable
+    with no cross-chip gathers. `egress_overflow` counts writes dropped by
+    an undersized cap — the host should widen egress_cap if it's ever
+    nonzero (the analog of the reference's bounded pacer queues).
+    """
+
+    egress_idx: jax.Array     # [R, E] int32 — flat t*K*S + k*S + s; -1 = empty
+    egress_sn: jax.Array      # [R, E] int32 — munged SN
+    egress_ts: jax.Array      # [R, E] int32 — munged TS
+    egress_pid: jax.Array     # [R, E] int32 (video only)
+    egress_tl0: jax.Array     # [R, E] int32
+    egress_keyidx: jax.Array  # [R, E] int32
+    egress_overflow: jax.Array  # [R] int32 — sends beyond cap (dropped)
     need_keyframe: jax.Array   # [R, T, S] bool — host sends PLI upstream
     speaker_levels: jax.Array  # [R, SPEAKER_TOP_K] float32
     speaker_tracks: jax.Array  # [R, SPEAKER_TOP_K] int32 — room-local track idx
@@ -174,6 +185,7 @@ def _room_tick(
     inp: TickInputs,
     audio_params: audio.AudioLevelParams,
     bwe_params: bwe.BWEParams,
+    egress_cap: int,
 ):
     """Tick for ONE room; every field has its leading R axis stripped."""
     T, K = inp.sn.shape
@@ -296,13 +308,28 @@ def _room_tick(
         bwe_state=bwe_state,
         layer_bytes_ema=ema,
     )
+    # ---- device-side egress compaction ---------------------------------
+    # Dense [T, K, S] grids → up to `egress_cap` (t, k, s) writes. Keeps the
+    # device→host transfer proportional to traffic, not tensor capacity.
+    flat_send = send.reshape(-1)
+    (idx,) = jnp.nonzero(flat_send, size=egress_cap, fill_value=-1)
+    safe = jnp.maximum(idx, 0)
+    hit = idx >= 0
+
+    def compact(x):
+        return jnp.where(hit, x.reshape(-1)[safe], 0)
+
+    n_sends = jnp.sum(flat_send, dtype=jnp.int32)
+    overflow = n_sends - jnp.sum(hit, dtype=jnp.int32)
+
     outputs = TickOutputs(
-        send=send,
-        out_sn=out_sn,
-        out_ts=out_ts,
-        out_pid=out_pid,
-        out_tl0=out_tl0,
-        out_keyidx=out_ki,
+        egress_idx=idx.astype(jnp.int32),
+        egress_sn=compact(out_sn),
+        egress_ts=compact(out_ts),
+        egress_pid=compact(out_pid),
+        egress_tl0=compact(out_tl0),
+        egress_keyidx=compact(out_ki),
+        egress_overflow=overflow,
         need_keyframe=need_kf,
         speaker_levels=spk_levels,
         speaker_tracks=spk_tracks,
@@ -314,20 +341,160 @@ def _room_tick(
     return new_state, outputs
 
 
+def default_egress_cap(dims: PlaneDims) -> int:
+    """Per-room egress capacity: every valid packet to up to 4 subscribers,
+    or the full grid if smaller (rounded up to a lane-friendly multiple)."""
+    full = dims.tracks * dims.pkts * dims.subs
+    cap = min(full, max(128, dims.tracks * dims.pkts * 4))
+    return -(-cap // 128) * 128 if cap < full else full
+
+
 def media_plane_tick(
     state: PlaneState,
     inp: TickInputs,
     audio_params: audio.AudioLevelParams = audio.AudioLevelParams(),
     bwe_params: bwe.BWEParams = bwe.BWEParams(),
+    egress_cap: int | None = None,
 ):
     """One tick of the full media plane, vmapped over the room axis.
 
-    jit this (donating `state`) and step it from the runtime loop. The [R]
-    axis is the mesh-sharded axis (see livekit_server_tpu.parallel.mesh).
+    jit this (donating `state`) and step it from the runtime loop;
+    `egress_cap` is static per compile. The [R] axis is the mesh-sharded
+    axis (see livekit_server_tpu.parallel.mesh).
     """
+    if egress_cap is None:
+        T, K, S = inp.sn.shape[1], inp.sn.shape[2], inp.estimate.shape[1]
+        egress_cap = default_egress_cap(PlaneDims(inp.sn.shape[0], T, K, S))
+
     # Scalars (tick_ms) broadcast; everything else has a leading R axis.
     def tick_one(st, i):
-        return _room_tick(st, i, audio_params, bwe_params)
+        return _room_tick(st, i, audio_params, bwe_params, egress_cap)
 
     inp_axes = TickInputs(**{f: 0 for f in TickInputs._fields})._replace(tick_ms=None)
     return jax.vmap(tick_one, in_axes=(0, inp_axes))(state, inp)
+
+
+# ---------------------------------------------------------------------------
+# Wire packing: one upload + one fetch per tick.
+#
+# A remote/tunneled device (and even PCIe) pays per-transfer latency, so the
+# runtime ships TickInputs as ONE stacked int32 array (+ one float32 feedback
+# array) and receives TickOutputs as ONE flat int32 buffer, unpacked by known
+# offsets on host. The reference has no analog — its packets stay in host
+# memory — this is the TPU build's host↔HBM DMA discipline (SURVEY.md §7
+# "double-buffered DMA").
+# ---------------------------------------------------------------------------
+
+PKT_FIELDS = (
+    "sn", "ts", "layer", "temporal", "keyframe", "layer_sync", "begin_pic",
+    "pid", "tl0", "keyidx", "size", "frame_ms", "audio_level", "arrival_rtp",
+    "valid",
+)
+_BOOL_FIELDS = {"keyframe", "layer_sync", "begin_pic", "valid"}
+
+
+def pack_tick_inputs(inp: TickInputs):
+    """Host-side: TickInputs → (pkt [F,R,T,K] i32, fb [3,R,S] f32, tick_ms)."""
+    import numpy as np
+
+    pkt = np.stack([np.asarray(getattr(inp, f)).astype(np.int32) for f in PKT_FIELDS])
+    fb = np.stack(
+        [
+            np.asarray(inp.estimate, np.float32),
+            np.asarray(inp.estimate_valid).astype(np.float32),
+            np.asarray(inp.nacks, np.float32),
+        ]
+    )
+    return pkt, fb, np.int32(inp.tick_ms)
+
+
+def unpack_tick_inputs(pkt: jax.Array, fb: jax.Array, tick_ms: jax.Array) -> TickInputs:
+    """Device-side (traced): stacked arrays → TickInputs."""
+    fields = {}
+    for i, name in enumerate(PKT_FIELDS):
+        x = pkt[i]
+        fields[name] = x.astype(jnp.bool_) if name in _BOOL_FIELDS else x
+    return TickInputs(
+        **fields,
+        estimate=fb[0],
+        estimate_valid=fb[1] > 0.5,
+        nacks=fb[2],
+        tick_ms=tick_ms,
+    )
+
+
+def pack_tick_outputs(out: TickOutputs) -> jax.Array:
+    """Device-side (traced): TickOutputs → one flat int32 buffer.
+
+    float32 leaves travel as bit patterns (bitcast), bools as 0/1.
+    """
+    def flat(x):
+        if x.dtype == jnp.float32:
+            x = jax.lax.bitcast_convert_type(x, jnp.int32)
+        return x.astype(jnp.int32).reshape(-1)
+
+    return jnp.concatenate([flat(getattr(out, f)) for f in TickOutputs._fields])
+
+
+def unpack_tick_outputs(buf, dims: PlaneDims, egress_cap: int) -> TickOutputs:
+    """Host-side: flat int32 numpy buffer → TickOutputs of numpy arrays."""
+    import numpy as np
+
+    R, T, K, S = dims
+    E = egress_cap
+    shapes = {
+        "egress_idx": (R, E), "egress_sn": (R, E), "egress_ts": (R, E),
+        "egress_pid": (R, E), "egress_tl0": (R, E), "egress_keyidx": (R, E),
+        "egress_overflow": (R,),
+        "need_keyframe": (R, T, S),
+        "speaker_levels": (R, SPEAKER_TOP_K),
+        "speaker_tracks": (R, SPEAKER_TOP_K),
+        "congested": (R, S),
+        "target_layers": (R, S, T),
+        "fwd_packets": (R,),
+        "fwd_bytes": (R,),
+    }
+    floats = {"speaker_levels"}
+    bools = {"need_keyframe", "congested"}
+    buf = np.asarray(buf)
+    pieces, off = {}, 0
+    for name in TickOutputs._fields:
+        n = int(np.prod(shapes[name]))
+        x = buf[off : off + n].reshape(shapes[name])
+        off += n
+        if name in floats:
+            x = x.view(np.float32)
+        elif name in bools:
+            x = x.astype(bool)
+        pieces[name] = x
+    return TickOutputs(**pieces)
+
+
+def egress_to_dense(out: TickOutputs, dims: PlaneDims):
+    """Reconstruct dense [R,T,K,S] grids from compacted egress (test/debug
+    helper; production consumers iterate the compact form directly)."""
+    import numpy as np
+
+    R, T, K, S = dims
+    send = np.zeros((R, T, K, S), bool)
+    grids = {
+        name: np.zeros((R, T, K, S), np.int32)
+        for name in ("sn", "ts", "pid", "tl0", "keyidx")
+    }
+    idx = np.asarray(out.egress_idx)
+    fields = {
+        "sn": np.asarray(out.egress_sn),
+        "ts": np.asarray(out.egress_ts),
+        "pid": np.asarray(out.egress_pid),
+        "tl0": np.asarray(out.egress_tl0),
+        "keyidx": np.asarray(out.egress_keyidx),
+    }
+    for r in range(R):
+        valid = idx[r] >= 0
+        flat = idx[r][valid]
+        t, rem = np.divmod(flat, K * S)
+        k, s = np.divmod(rem, S)
+        send[r, t, k, s] = True
+        for name in grids:
+            grids[name][r, t, k, s] = fields[name][r][valid]
+    return send, grids["sn"], grids["ts"], grids["pid"], grids["tl0"], grids["keyidx"]

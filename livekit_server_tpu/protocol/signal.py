@@ -64,6 +64,10 @@ RESPONSE_KINDS = frozenset(
         "subscription_response",
         "request_response",
         "track_subscribed",
+        # Data packets ride the signal socket in this build (the reference
+        # uses SCTP data channels; the seam is the same fan-out —
+        # room.go:1455).
+        "data_packet",
     }
 )
 

@@ -215,10 +215,19 @@ class KVRouter(LocalRouter):
             f"node_session:{node_id}",
             json.dumps({"room": room_name, "init": init.to_dict()}),
         )
+        # The RTC node publishes {"ready"} once it has subscribed to the
+        # request channel; holding requests until then closes the race where
+        # a fast first message (seq=1) is published before anyone listens
+        # and the seq check tears the session down.
+        ready = asyncio.Event()
 
         async def pump_requests():
             seq = 0
             try:
+                try:
+                    await asyncio.wait_for(ready.wait(), timeout=5.0)
+                except asyncio.TimeoutError:
+                    pass  # proceed; the RTC node may be older/acks-less
                 while True:
                     msg = await req.read_message()
                     seq += 1
@@ -233,6 +242,9 @@ class KVRouter(LocalRouter):
             try:
                 async for raw in resp_sub:
                     env = json.loads(raw)
+                    if env.get("ready"):
+                        ready.set()
+                        continue
                     if env.get("close"):
                         break
                     expect += 1
@@ -259,6 +271,8 @@ class KVRouter(LocalRouter):
             req = MessageChannel(connection_id=connection_id)
             resp = MessageChannel(connection_id=connection_id)
             req_sub = self.bus.subscribe(f"signal_req:{connection_id}")
+            # Ack: request channel is live — the signal node may now pump.
+            await self.bus.publish(f"signal_resp:{connection_id}", json.dumps({"ready": True}))
 
             async def pump_in(req_sub=req_sub, req=req):
                 expect = 0

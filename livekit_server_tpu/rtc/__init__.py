@@ -1,0 +1,15 @@
+"""Room/session control plane.
+
+Reference parity: pkg/rtc (SURVEY.md §2.4) — Room, ParticipantImpl, signal
+dispatch, subscription management, dynacast. Control stays host-side and
+thin; every media-affecting decision lands as a mask/state write into the
+PlaneRuntime host mirrors (runtime/plane_runtime.py), applied at the next
+tick boundary — the TPU replacement for the reference's lock-guarded
+object graph mutation.
+"""
+
+from livekit_server_tpu.rtc.participant import Participant, PublishedTrack
+from livekit_server_tpu.rtc.room import Room
+from livekit_server_tpu.rtc.signalhandler import handle_participant_signal
+
+__all__ = ["Participant", "PublishedTrack", "Room", "handle_participant_signal"]

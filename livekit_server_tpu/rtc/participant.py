@@ -1,0 +1,215 @@
+"""Participant: one connected client's session state.
+
+Reference parity: pkg/rtc/participant.go (ParticipantImpl — signal
+handling, track publication state machine, permissions, subscription
+intents) and pkg/rtc/uptrackmanager.go (published-track registry). The
+reference's two PCTransports + Pion plumbing collapse here into the media
+slot coordinates: a published track is a (room row, track col) in the
+plane tensor; a subscription is a True in the ctrl.subscribed mask; media
+I/O happens via the runtime's ingest/egress (packets are pushed by the
+transport layer with those coordinates).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import time
+from dataclasses import dataclass, field
+from typing import Any, Callable
+
+from livekit_server_tpu.protocol import models as pm
+from livekit_server_tpu.protocol.signal import SignalResponse, encode_signal_response
+from livekit_server_tpu.routing.messagechannel import ChannelClosed, ChannelFull, MessageChannel
+from livekit_server_tpu.utils import ids
+
+
+@dataclass
+class PublishedTrack:
+    """UpTrackManager entry: TrackInfo + tensor coordinates."""
+
+    info: pm.TrackInfo
+    track_col: int
+    cid: str = ""              # client's local id until published
+
+    @property
+    def is_video(self) -> bool:
+        return self.info.type == pm.TrackType.VIDEO
+
+
+class Participant:
+    """Control-plane participant (ParticipantImpl analog, host-side)."""
+
+    def __init__(
+        self,
+        identity: str,
+        room,                     # rtc.Room (avoid circular type import)
+        response_sink: MessageChannel | None = None,
+        grants: dict | None = None,
+        name: str = "",
+        auto_subscribe: bool = True,
+    ):
+        self.sid = ids.new_participant_id()
+        self.identity = identity
+        self.name = name
+        self.room = room
+        self.response_sink = response_sink
+        self.grants = grants or {}
+        self.auto_subscribe = auto_subscribe
+        self.state = pm.ParticipantState.JOINING
+        self.joined_at = int(time.time())
+        self.metadata = ""
+        self.attributes: dict[str, str] = {}
+        self.sub_col: int = -1          # subscriber column in the room row
+        self.permission = pm.ParticipantPermission()
+        self._apply_grant_permissions()
+        self.published: dict[str, PublishedTrack] = {}   # track sid → entry
+        self.pending_tracks: dict[str, pm.TrackInfo] = {}  # cid → info
+        self.subscribed_tracks: set[str] = set()         # track sids
+        self.disconnected = asyncio.Event()
+        self.close_reason = pm.DisconnectReason.UNKNOWN_REASON
+        self._media_out: Callable[[Any], None] | None = None
+        self.media_queue: asyncio.Queue | None = None  # set by the transport
+        # Bumped on every signal-sink swap (resume); a stale session worker
+        # compares its captured epoch before tearing the participant down.
+        self.session_epoch = 0
+        self.version = 0
+
+    # -- permissions (participant.go SetPermission / canPublishSource) ----
+    def _apply_grant_permissions(self) -> None:
+        video = self.grants.get("video", {}) if self.grants else {}
+        def tri(key, default=True):
+            v = video.get(key)
+            return default if v is None else bool(v)
+        self.permission = pm.ParticipantPermission(
+            can_subscribe=tri("canSubscribe"),
+            can_publish=tri("canPublish"),
+            can_publish_data=tri("canPublishData"),
+            hidden=bool(video.get("hidden", False)),
+            recorder=bool(video.get("recorder", False)),
+            can_update_metadata=tri("canUpdateOwnMetadata", False),
+            agent=bool(video.get("agent", False)),
+        )
+
+    def set_permission(self, perm: pm.ParticipantPermission) -> bool:
+        """Admin UpdateParticipant path; revoking publish closes tracks."""
+        old = self.permission
+        self.permission = perm
+        if old.can_publish and not perm.can_publish:
+            for sid in list(self.published):
+                self.unpublish_track(sid)
+        self.version += 1
+        return True
+
+    # -- signaling out ----------------------------------------------------
+    def send(self, kind: str, data: dict) -> None:
+        """Queue a SignalResponse; drop-on-overflow like the reference's
+        bounded signal sinks (a stuck client can't block the room)."""
+        if self.response_sink is None or self.response_sink.is_closed:
+            return
+        try:
+            self.response_sink.write_message(
+                encode_signal_response(SignalResponse(kind, data))
+            )
+        except (ChannelFull, ChannelClosed):
+            pass
+
+    def to_info(self) -> pm.ParticipantInfo:
+        return pm.ParticipantInfo(
+            sid=self.sid,
+            identity=self.identity,
+            state=self.state,
+            tracks=[t.info for t in self.published.values()],
+            metadata=self.metadata,
+            joined_at=self.joined_at,
+            name=self.name,
+            version=self.version,
+            permission=self.permission,
+            is_publisher=bool(self.published),
+            attributes=dict(self.attributes),
+        )
+
+    # -- publication state machine (participant.go AddTrack → addMediaTrack)
+    def add_track_request(self, req: dict) -> pm.TrackInfo | None:
+        """AddTrackRequest → pending track + track_published response."""
+        if not self.permission.can_publish:
+            return None
+        cid = req.get("cid", "")
+        if not cid or cid in self.pending_tracks:
+            return None
+        info = pm.TrackInfo(
+            sid=ids.new_track_id(),
+            type=pm.TrackType(req.get("type", 0)),
+            name=req.get("name", ""),
+            muted=req.get("muted", False),
+            width=req.get("width", 0),
+            height=req.get("height", 0),
+            simulcast=len(req.get("layers", [])) > 1,
+            source=pm.TrackSource(req.get("source", 0)),
+            layers=[
+                pm.SimulcastLayer(
+                    quality=pm.VideoQuality(l.get("quality", 0)),
+                    width=l.get("width", 0),
+                    height=l.get("height", 0),
+                )
+                for l in req.get("layers", [])
+            ],
+            mime_type=req.get("mime_type", ""),
+            stereo=req.get("stereo", False),
+            disable_red=req.get("disable_red", False),
+        )
+        self.pending_tracks[cid] = info
+        self.send("track_published", {"cid": cid, "track": info.to_dict()})
+        return info
+
+    def publish_pending(self, cid: str) -> PublishedTrack | None:
+        """Media arrived for a pending track (the reference's onMediaTrack
+        → mediaTrackReceived): allocate the tensor column, flip the mask."""
+        info = self.pending_tracks.pop(cid, None)
+        if info is None:
+            return None
+        track = self.room.publish_track(self, info)
+        if track is None:
+            self.pending_tracks[cid] = info  # no capacity; retry later
+            return None
+        track.cid = cid
+        self.published[info.sid] = track
+        self.state = pm.ParticipantState.ACTIVE
+        self.version += 1
+        return track
+
+    def unpublish_track(self, track_sid: str) -> None:
+        track = self.published.pop(track_sid, None)
+        if track is not None:
+            self.room.unpublish_track(self, track)
+            self.version += 1
+
+    def set_track_muted(self, track_sid: str, muted: bool) -> None:
+        track = self.published.get(track_sid)
+        if track is None:
+            # may still be pending (mute before media arrives)
+            for info in self.pending_tracks.values():
+                if info.sid == track_sid:
+                    info.muted = muted
+            return
+        track.info.muted = muted
+        self.room.set_track_muted(self, track, muted)
+        self.version += 1
+
+    # -- media egress hookup ---------------------------------------------
+    def on_media(self, cb: Callable[[Any], None]) -> None:
+        """Transport registers its egress writer (EgressPacket consumer)."""
+        self._media_out = cb
+
+    def deliver_media(self, pkt) -> None:
+        if self._media_out is not None:
+            self._media_out(pkt)
+
+    # -- teardown ---------------------------------------------------------
+    def close(self, reason: pm.DisconnectReason) -> None:
+        if self.state == pm.ParticipantState.DISCONNECTED:
+            return
+        self.state = pm.ParticipantState.DISCONNECTED
+        self.close_reason = reason
+        if self.response_sink is not None:
+            self.response_sink.close()
+        self.disconnected.set()

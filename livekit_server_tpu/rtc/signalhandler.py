@@ -1,0 +1,125 @@
+"""Signal dispatch: SignalRequest variants → participant/room operations.
+
+Reference parity: pkg/rtc/signalhandler.go:24-97 — the switch over the 14
+SignalRequest oneof arms. SDP offer/answer and ICE trickle are accepted
+and acknowledged at this layer (the media transport in this build binds
+publishers by token + slot coordinates rather than DTLS — see
+service/media once the UDP path lands); everything else maps 1:1 to the
+reference's behavior.
+"""
+
+from __future__ import annotations
+
+import time
+
+from livekit_server_tpu.protocol.signal import SignalRequest
+from livekit_server_tpu.protocol import models as pm
+from livekit_server_tpu.rtc.participant import Participant
+
+
+def handle_participant_signal(room, participant: Participant, req: SignalRequest) -> None:
+    """One inbound signal message (rtcSessionWorker loop body analog)."""
+    kind, data = req.kind, req.data
+
+    if kind == "offer":
+        # Publisher SDP: no DTLS negotiation in this build — reflect an
+        # answer so protocol-conformant clients proceed to media.
+        participant.send("answer", {"type": "answer", "sdp": data.get("sdp", "")})
+    elif kind == "answer":
+        pass  # subscriber-side answer: nothing to reconcile host-side
+    elif kind == "trickle":
+        pass  # ICE candidates are not used by the slot-addressed transport
+    elif kind == "add_track":
+        participant.add_track_request(data)
+    elif kind == "mute":
+        sid = data.get("sid", "")
+        participant.set_track_muted(sid, bool(data.get("muted", False)))
+        participant.send("mute", {"sid": sid, "muted": bool(data.get("muted", False))})
+    elif kind == "subscription":
+        for sid in data.get("track_sids", []):
+            if data.get("subscribe", True):
+                room.subscribe(participant, sid)
+            else:
+                room.unsubscribe(participant, sid)
+        for pt in data.get("participant_tracks", []):
+            for sid in pt.get("track_sids", []):
+                if data.get("subscribe", True):
+                    room.subscribe(participant, sid)
+                else:
+                    room.unsubscribe(participant, sid)
+    elif kind == "track_setting":
+        for sid in data.get("track_sids", []):
+            room.update_track_settings(participant, sid, data)
+    elif kind == "update_layers":
+        pass  # deprecated upstream; dynacast handles layer pausing
+    elif kind == "subscription_permission":
+        _handle_subscription_permission(room, participant, data)
+    elif kind == "sync_state":
+        _handle_sync_state(room, participant, data)
+    elif kind == "simulate":
+        _handle_simulate(room, participant, data)
+    elif kind == "ping":
+        participant.send(
+            "pong",
+            {"last_ping_timestamp": data.get("timestamp", 0), "timestamp": int(time.time() * 1000)},
+        )
+    elif kind == "update_metadata":
+        if participant.permission.can_update_metadata:
+            participant.metadata = data.get("metadata", participant.metadata)
+            participant.name = data.get("name", participant.name)
+            participant.attributes.update(data.get("attributes", {}))
+            participant.version += 1
+            room.broadcast_participant_state(participant)
+    elif kind == "leave":
+        room.remove_participant(participant, pm.DisconnectReason.CLIENT_INITIATED)
+
+
+def _handle_subscription_permission(room, participant: Participant, data: dict) -> None:
+    """UpdateSubscriptionPermission (uptrackmanager.go): restrict who may
+    subscribe to this publisher's tracks."""
+    # proto3 JSON omits false bools: a missing key means NOT all (the
+    # restrictive reading — matching livekit.SubscriptionPermission).
+    all_participants = bool(data.get("all_participants", False))
+    allowed = {tp.get("participant_sid") or tp.get("participant_identity")
+               for tp in data.get("track_permissions", [])}
+    for sid, (pub, track) in room.tracks.items():
+        if pub.sid != participant.sid:
+            continue
+        for p in room.participants.values():
+            if p.sid == pub.sid:
+                continue
+            ok = all_participants or p.sid in allowed or p.identity in allowed
+            if not ok and sid in p.subscribed_tracks:
+                room.unsubscribe(p, sid)
+                p.send("subscription_permission_update", {
+                    "participant_sid": pub.sid, "track_sid": sid, "allowed": False,
+                })
+            elif ok and p.auto_subscribe and sid not in p.subscribed_tracks:
+                room.subscribe(p, sid)
+
+
+def _handle_sync_state(room, participant: Participant, data: dict) -> None:
+    """Resume path (room.go:648): replay desired subscription state."""
+    sub = data.get("subscription", {})
+    for sid in sub.get("track_sids", []):
+        room.subscribe(participant, sid)
+    for pub_track in data.get("publish_tracks", []):
+        cid = pub_track.get("cid", "")
+        if cid and cid not in participant.pending_tracks:
+            participant.add_track_request(pub_track.get("track", {}) | {"cid": cid})
+
+
+def _handle_simulate(room, participant: Participant, data: dict) -> None:
+    """Fault injection (room.go:850-911 SimulateScenario)."""
+    if "speaker_update" in data:
+        pass  # speaker simulation handled by the audio path naturally
+    if data.get("node_failure"):
+        participant.close(pm.DisconnectReason.STATE_MISMATCH)
+    if data.get("server_leave"):
+        room.remove_participant(participant, pm.DisconnectReason.SERVER_SHUTDOWN)
+    if "subscriber_bandwidth" in data:
+        bw = float(data["subscriber_bandwidth"])
+        if participant.sub_col >= 0 and bw > 0:
+            room.runtime.ingest.push_feedback(
+                room.slots.row, participant.sub_col, estimate=bw
+            )

@@ -1,0 +1,20 @@
+"""Host runtime: the bridge between the control plane and the device plane.
+
+The reference's media plane is a web of goroutines per packet/track/
+subscriber (pkg/sfu). Here the media plane is one jitted JAX program
+stepped at a fixed tick; this package owns everything host-side that feeds
+and drains it:
+
+  - slots    — allocation of (room row, track col, subscriber col) tensor
+               coordinates to live control-plane objects
+  - ingest   — per-tick packing of received packets into TickInputs
+  - plane_runtime — the tick loop: apply control mutations, step the
+               sharded plane, fan out TickOutputs (egress, speakers,
+               keyframe requests, congestion)
+"""
+
+from livekit_server_tpu.runtime.slots import CapacityError, SlotAllocator
+from livekit_server_tpu.runtime.ingest import IngestBuffer
+from livekit_server_tpu.runtime.plane_runtime import PlaneRuntime
+
+__all__ = ["CapacityError", "IngestBuffer", "PlaneRuntime", "SlotAllocator"]

@@ -1,0 +1,149 @@
+"""Per-tick packet ingest: wire fields → TickInputs tensors.
+
+Reference parity: the ingest half of buffer.Buffer (pkg/sfu/buffer/
+buffer.go:268 Write → :417 calc — each arriving RTP packet is parsed and
+queued for the hot loop). Here arriving packets are staged into
+preallocated numpy arrays with per-(room, track) write cursors; at each
+tick boundary `drain()` hands the filled tensors (plus the valid mask) to
+the device step and resets the cursors. Overflow (more packets than K
+slots in one tick) drops-and-counts, mirroring the reference's bounded
+buffers; payload bytes are staged separately in a slab so the device only
+ever sees fixed-size header fields.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+import numpy as np
+
+from livekit_server_tpu.models import plane
+
+
+def _wrap_i32(x: int) -> int:
+    """uint32 bit pattern → int32 two's complement (numpy 2.x raises on
+    out-of-range np.int32(...) casts)."""
+    x &= 0xFFFFFFFF
+    return x - (1 << 32) if x >= (1 << 31) else x
+
+
+@dataclass
+class PacketIn:
+    """Parsed header fields of one media packet (ExtPacket analog)."""
+
+    room: int               # room row
+    track: int              # track col
+    sn: int
+    ts: int
+    size: int
+    payload: bytes = b""
+    layer: int = 0
+    temporal: int = 0
+    keyframe: bool = False
+    layer_sync: bool = False
+    begin_pic: bool = False
+    pid: int = 0
+    tl0: int = 0
+    keyidx: int = 0
+    frame_ms: int = 20
+    audio_level: int = 127
+    arrival_rtp: int = 0
+
+
+class IngestBuffer:
+    """Double-buffered staging area for one node's tick inputs."""
+
+    def __init__(self, dims: plane.PlaneDims, tick_ms: int):
+        self.dims = dims
+        self.tick_ms = tick_ms
+        R, T, K, S = dims
+        self._count = np.zeros((R, T), np.int32)
+        self.dropped = 0
+        self._i32 = lambda: np.zeros((R, T, K), np.int32)
+        self._bool = lambda: np.zeros((R, T, K), bool)
+        self._alloc_fields()
+        # Payload slab: list-of-lists indexed [r][t][k] — host-side only,
+        # egress rebuilds wire packets from it (PacketFactory analog).
+        self._payloads: dict[tuple[int, int, int], bytes] = {}
+        # Per-subscriber feedback staging.
+        self._estimate = np.zeros((R, S), np.float32)
+        self._estimate_valid = np.zeros((R, S), bool)
+        self._nacks = np.zeros((R, S), np.float32)
+
+    def _alloc_fields(self):
+        self.sn = self._i32()
+        self.ts = self._i32()
+        self.layer = self._i32()
+        self.temporal = self._i32()
+        self.keyframe = self._bool()
+        self.layer_sync = self._bool()
+        self.begin_pic = self._bool()
+        self.pid = self._i32()
+        self.tl0 = self._i32()
+        self.keyidx = self._i32()
+        self.size = self._i32()
+        self.frame_ms = self._i32()
+        self.audio_level = np.full(self.sn.shape, 127, np.int32)
+        self.arrival_rtp = self._i32()
+        self.valid = self._bool()
+
+    def push(self, pkt: PacketIn) -> bool:
+        """Stage one packet; False (and counted) if the tick is full."""
+        k = self._count[pkt.room, pkt.track]
+        if k >= self.dims.pkts:
+            self.dropped += 1
+            return False
+        r, t = pkt.room, pkt.track
+        self._count[r, t] = k + 1
+        self.sn[r, t, k] = pkt.sn & 0xFFFF
+        self.ts[r, t, k] = _wrap_i32(pkt.ts)
+        self.layer[r, t, k] = pkt.layer
+        self.temporal[r, t, k] = pkt.temporal
+        self.keyframe[r, t, k] = pkt.keyframe
+        self.layer_sync[r, t, k] = pkt.layer_sync
+        self.begin_pic[r, t, k] = pkt.begin_pic
+        self.pid[r, t, k] = pkt.pid
+        self.tl0[r, t, k] = pkt.tl0
+        self.keyidx[r, t, k] = pkt.keyidx
+        self.size[r, t, k] = pkt.size
+        self.frame_ms[r, t, k] = pkt.frame_ms
+        self.audio_level[r, t, k] = pkt.audio_level
+        self.arrival_rtp[r, t, k] = _wrap_i32(pkt.arrival_rtp)
+        self.valid[r, t, k] = True
+        if pkt.payload:
+            self._payloads[(r, t, int(k))] = pkt.payload
+        return True
+
+    def push_feedback(
+        self, room: int, sub: int, estimate: float | None = None, nacks: int = 0
+    ) -> None:
+        """Stage subscriber feedback (TWCC/REMB estimate sample, NACK count)."""
+        if estimate is not None:
+            self._estimate[room, sub] = estimate
+            self._estimate_valid[room, sub] = True
+        if nacks:
+            self._nacks[room, sub] += nacks
+
+    def drain(self) -> tuple[plane.TickInputs, dict[tuple[int, int, int], bytes]]:
+        """Snapshot this tick's tensors and reset for the next tick."""
+        inp = plane.TickInputs(
+            sn=self.sn.copy(), ts=self.ts.copy(), layer=self.layer.copy(),
+            temporal=self.temporal.copy(), keyframe=self.keyframe.copy(),
+            layer_sync=self.layer_sync.copy(), begin_pic=self.begin_pic.copy(),
+            pid=self.pid.copy(), tl0=self.tl0.copy(), keyidx=self.keyidx.copy(),
+            size=self.size.copy(), frame_ms=self.frame_ms.copy(),
+            audio_level=self.audio_level.copy(),
+            arrival_rtp=self.arrival_rtp.copy(), valid=self.valid.copy(),
+            estimate=self._estimate.copy(),
+            estimate_valid=self._estimate_valid.copy(),
+            nacks=self._nacks.copy(),
+            tick_ms=np.int32(self.tick_ms),
+        )
+        payloads = self._payloads
+        self._payloads = {}
+        self._count[:] = 0
+        self.valid[:] = False
+        self.audio_level[:] = 127
+        self._estimate_valid[:] = False
+        self._nacks[:] = 0.0
+        return inp, payloads

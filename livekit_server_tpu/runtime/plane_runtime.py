@@ -1,0 +1,330 @@
+"""The tick loop: control mutations in, device step, outputs fanned out.
+
+This is the TPU replacement for the reference's always-on goroutine mesh:
+where pkg/sfu runs one forwardRTP loop per (track, layer) plus per-
+subscriber allocator/transport loops, this runtime advances the ENTIRE
+node in one jitted call per tick (models/plane.media_plane_tick, room axis
+sharded over the mesh — parallel/mesh.py).
+
+Per tick:
+  1. apply queued control mutations to the host mirrors of TrackMeta /
+     SubControl (subscription churn lands at tick boundaries — the
+     reference serializes the same churn with locks + shadow slices,
+     downtrackspreader.go:110)
+  2. drain the IngestBuffer → TickInputs
+  3. step the device plane
+  4. fan out TickOutputs: egress writes (send mask × munged headers +
+     payload slab), speaker updates, keyframe/PLI requests, congestion →
+     registered async callbacks
+
+Checkpoint/resume (§5.4): snapshot()/restore() serialize the full device
+state tree — the analog of the reference's ForwarderState/RTPMungerState
+migration seeding (forwarder.go:340-376).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import functools
+import time
+from dataclasses import dataclass, field
+from typing import Any, Awaitable, Callable
+
+import jax
+import numpy as np
+
+from livekit_server_tpu.models import plane
+from livekit_server_tpu.runtime.ingest import IngestBuffer
+from livekit_server_tpu.runtime.slots import SlotAllocator
+
+
+@dataclass
+class EgressPacket:
+    """One packet to deliver to one subscriber (host egress unit)."""
+
+    room: int
+    track: int
+    sub: int
+    sn: int
+    ts: int
+    pid: int
+    tl0: int
+    keyidx: int
+    size: int
+    payload: bytes
+
+
+@dataclass
+class TickResult:
+    """Host-visible outputs of one tick."""
+
+    tick_index: int
+    egress: list[EgressPacket]
+    speakers: dict[int, list[tuple[int, float]]]     # room → [(track, level)]
+    need_keyframe: list[tuple[int, int, int]]        # (room, track, sub)
+    congested: dict[int, list[int]]                  # room → [sub]
+    fwd_packets: int
+    fwd_bytes: int
+    tick_s: float                                    # wall time of the step
+
+
+@functools.lru_cache(maxsize=None)
+def _build_step(audio_params, bwe_params, egress_cap):
+    """Packed-wire step: ONE input upload, ONE output fetch per tick
+    (plane.pack_tick_inputs / pack_tick_outputs)."""
+
+    def tick(state, pkt, fb, tick_ms):
+        inp = plane.unpack_tick_inputs(pkt, fb, tick_ms)
+        state, out = plane.media_plane_tick(
+            state, inp, audio_params, bwe_params, egress_cap=egress_cap
+        )
+        return state, plane.pack_tick_outputs(out)
+
+    return jax.jit(tick, donate_argnums=(0,))
+
+
+class PlaneRuntime:
+    """Owns the device plane state + the host mirrors and tick loop."""
+
+    def __init__(
+        self,
+        dims: plane.PlaneDims,
+        tick_ms: int = 10,
+        mesh=None,
+        audio_params=None,
+        bwe_params=None,
+        egress_cap: int | None = None,
+    ):
+        from livekit_server_tpu.ops import audio as audio_ops, bwe as bwe_ops
+
+        self.dims = dims
+        self.tick_ms = tick_ms
+        self.egress_cap = egress_cap or plane.default_egress_cap(dims)
+        self.slots = SlotAllocator(dims.rooms, dims.tracks, dims.subs)
+        self.ingest = IngestBuffer(dims, tick_ms)
+        self.tick_index = 0
+        self._ap = audio_params or audio_ops.AudioLevelParams()
+        self._bp = bwe_params or bwe_ops.BWEParams()
+
+        R, T, S = dims.rooms, dims.tracks, dims.subs
+        # Host mirrors of control tensors; mutated by the control plane,
+        # uploaded at tick boundaries when dirty.
+        self.meta = plane.TrackMeta(
+            is_video=np.zeros((R, T), bool),
+            published=np.zeros((R, T), bool),
+            pub_muted=np.zeros((R, T), bool),
+        )
+        self.ctrl = plane.SubControl(
+            subscribed=np.zeros((R, T, S), bool),
+            sub_muted=np.zeros((R, T, S), bool),
+            max_spatial=np.full((R, T, S), plane.MAX_LAYERS - 1, np.int32),
+            max_temporal=np.full((R, T, S), 3, np.int32),
+        )
+        self._ctrl_dirty = True
+
+        self.state = plane.init_state(dims)
+        self._mesh = mesh
+        if mesh is not None:
+            from livekit_server_tpu.parallel import make_sharded_tick, shard_tree
+
+            self.state = shard_tree(self.state, mesh)
+            self._step = make_sharded_tick(
+                mesh, self._ap, self._bp, donate=True, egress_cap=self.egress_cap
+            )
+        else:
+            # Shared across PlaneRuntime instances with identical params so
+            # repeated construction (tests, restarts) reuses the XLA
+            # compilation cache instead of re-tracing a fresh closure.
+            self._step = _build_step(self._ap, self._bp, self.egress_cap)
+
+        self._task: asyncio.Task | None = None
+        self._on_tick: list[Callable[[TickResult], Awaitable[None] | None]] = []
+        self.stats = {"ticks": 0, "fwd_packets": 0, "fwd_bytes": 0, "late_ticks": 0}
+        # Single worker: device steps are strictly ordered (donated state).
+        from concurrent.futures import ThreadPoolExecutor
+
+        self._executor = ThreadPoolExecutor(max_workers=1, thread_name_prefix="plane")
+
+    # -- control-plane mutation API (host mirrors; applied at tick edge) --
+    def set_track(self, room: int, track: int, *, published: bool, is_video: bool,
+                  pub_muted: bool = False) -> None:
+        self.meta.published[room, track] = published
+        self.meta.is_video[room, track] = is_video
+        self.meta.pub_muted[room, track] = pub_muted
+        if not published:
+            # Free the columns' subscriber state implicitly: masks go false.
+            self.ctrl.subscribed[room, track, :] = False
+        self._ctrl_dirty = True
+
+    def set_subscription(self, room: int, track: int, sub: int, *,
+                         subscribed: bool, sub_muted: bool = False) -> None:
+        self.ctrl.subscribed[room, track, sub] = subscribed
+        self.ctrl.sub_muted[room, track, sub] = sub_muted
+        self._ctrl_dirty = True
+
+    def set_layer_caps(self, room: int, track: int, sub: int,
+                       max_spatial: int, max_temporal: int = 3) -> None:
+        self.ctrl.max_spatial[room, track, sub] = max_spatial
+        self.ctrl.max_temporal[room, track, sub] = max_temporal
+        self._ctrl_dirty = True
+
+    def clear_room(self, room: int) -> None:
+        self.meta.published[room, :] = False
+        self.meta.pub_muted[room, :] = False
+        self.ctrl.subscribed[room, :, :] = False
+        self._ctrl_dirty = True
+
+    def on_tick(self, cb: Callable[[TickResult], Awaitable[None] | None]) -> None:
+        self._on_tick.append(cb)
+
+    # -- tick ------------------------------------------------------------
+    def _upload_ctrl(self) -> None:
+        import jax.numpy as jnp
+
+        if self._mesh is None:
+            put = jnp.asarray
+        else:
+            from livekit_server_tpu.parallel.mesh import room_sharding
+
+            sharding = room_sharding(self._mesh)
+            put = lambda x: jax.device_put(jnp.asarray(x), sharding)
+        self.state = self.state._replace(
+            meta=jax.tree.map(lambda x: put(x.copy()), plane.TrackMeta(*self.meta)),
+            ctrl=jax.tree.map(lambda x: put(x.copy()), plane.SubControl(*self.ctrl)),
+        )
+        self._ctrl_dirty = False
+
+    def _device_step(self, inp):
+        """The blocking device round trip; runs off the event loop."""
+        if self._mesh is not None:
+            self.state, out = self._step(self.state, inp)
+            return jax.tree.map(np.asarray, out)
+        pkt, fb, tick_ms = plane.pack_tick_inputs(inp)
+        self.state, buf = self._step(self.state, pkt, fb, tick_ms)
+        return plane.unpack_tick_outputs(np.asarray(buf), self.dims, self.egress_cap)
+
+    async def step_once(self) -> TickResult:
+        """One tick; the device round trip runs in a worker thread so the
+        event loop (signal sessions) never blocks on HBM/tunnel latency."""
+        t0 = time.perf_counter()
+        if self._ctrl_dirty:
+            self._upload_ctrl()
+        inp, payloads = self.ingest.drain()
+        loop = asyncio.get_running_loop()
+        out = await loop.run_in_executor(self._executor, self._device_step, inp)
+        result = self._fan_out(out, payloads, time.perf_counter() - t0)
+        self.tick_index += 1
+        self.stats["ticks"] += 1
+        self.stats["fwd_packets"] += result.fwd_packets
+        self.stats["fwd_bytes"] += result.fwd_bytes
+        for cb in self._on_tick:
+            r = cb(result)
+            if asyncio.iscoroutine(r):
+                await r
+        return result
+
+    def _fan_out(self, out, payloads, tick_s: float) -> TickResult:
+        # Compacted egress: [R, E] index lists (see plane.TickOutputs).
+        K, S = self.dims.pkts, self.dims.subs
+        idx = out.egress_idx
+        rr, ee = np.nonzero(idx >= 0)
+        flat = idx[rr, ee]
+        tt, rem = np.divmod(flat, K * S)
+        kk, ss = np.divmod(rem, S)
+        sn = out.egress_sn[rr, ee]
+        ts = out.egress_ts[rr, ee]
+        pid = out.egress_pid[rr, ee]
+        tl0 = out.egress_tl0[rr, ee]
+        kidx = out.egress_keyidx[rr, ee]
+        egress: list[EgressPacket] = []
+        for i in range(len(rr)):
+            r, t, k = int(rr[i]), int(tt[i]), int(kk[i])
+            payload = payloads.get((r, t, k), b"")
+            egress.append(
+                EgressPacket(
+                    room=r, track=t, sub=int(ss[i]),
+                    sn=int(sn[i]) & 0xFFFF,
+                    ts=int(ts[i]) & 0xFFFFFFFF,
+                    pid=int(pid[i]),
+                    tl0=int(tl0[i]),
+                    keyidx=int(kidx[i]),
+                    size=len(payload),
+                    payload=payload,
+                )
+            )
+        overflow = int(out.egress_overflow.sum())
+        if overflow:
+            self.stats["egress_overflow"] = self.stats.get("egress_overflow", 0) + overflow
+        speakers: dict[int, list[tuple[int, float]]] = {}
+        lv, tr = out.speaker_levels, out.speaker_tracks
+        for r in range(lv.shape[0]):
+            row = [
+                (int(tr[r, i]), float(lv[r, i]))
+                for i in range(lv.shape[1])
+                if tr[r, i] >= 0 and lv[r, i] > 0
+            ]
+            if row:
+                speakers[r] = row
+        nk = [
+            (int(r), int(t), int(s))
+            for r, t, s in zip(*np.nonzero(out.need_keyframe))
+        ]
+        congested: dict[int, list[int]] = {}
+        for r, s in zip(*np.nonzero(out.congested)):
+            congested.setdefault(int(r), []).append(int(s))
+        return TickResult(
+            tick_index=self.tick_index,
+            egress=egress,
+            speakers=speakers,
+            need_keyframe=nk,
+            congested=congested,
+            fwd_packets=int(out.fwd_packets.sum()),
+            fwd_bytes=int(out.fwd_bytes.sum()),
+            tick_s=tick_s,
+        )
+
+    # -- loop ------------------------------------------------------------
+    def start(self) -> None:
+        if self._task is None:
+            self._task = asyncio.ensure_future(self._run())
+
+    async def _run(self) -> None:
+        period = self.tick_ms / 1000.0
+        next_at = time.perf_counter() + period
+        while True:
+            await asyncio.sleep(max(0.0, next_at - time.perf_counter()))
+            res = await self.step_once()
+            if res.tick_s > period:
+                self.stats["late_ticks"] += 1
+            next_at += period
+            if next_at < time.perf_counter() - 5 * period:
+                next_at = time.perf_counter() + period  # resync after stall
+
+    async def stop(self) -> None:
+        if self._task is not None:
+            self._task.cancel()
+            try:
+                await self._task
+            except asyncio.CancelledError:
+                pass
+            self._task = None
+
+    # -- checkpoint / resume (§5.4) --------------------------------------
+    def snapshot(self) -> dict[str, Any]:
+        """Serializable device-state snapshot (migration seeding analog)."""
+        flat, treedef = jax.tree.flatten(self.state)
+        return {
+            "tick_index": self.tick_index,
+            "arrays": [np.asarray(x) for x in flat],
+        }
+
+    def restore(self, snap: dict[str, Any]) -> None:
+        flat, treedef = jax.tree.flatten(self.state)
+        assert len(flat) == len(snap["arrays"])
+        self.state = jax.tree.unflatten(treedef, [a for a in snap["arrays"]])
+        if self._mesh is not None:
+            from livekit_server_tpu.parallel import shard_tree
+
+            self.state = shard_tree(self.state, self._mesh)
+        self.tick_index = snap["tick_index"]
+        self._ctrl_dirty = True

@@ -1,0 +1,16 @@
+"""Service layer: HTTP/WS APIs, room management, server assembly.
+
+Reference parity: pkg/service (SURVEY.md §2.2) — LivekitServer (HTTP mux +
+lifecycle), RTCService (/rtc WebSocket), RoomManager (per-node room
+registry + session workers), RoomService (Twirp admin API), object stores,
+webhooks. The media-plane difference: RoomManager owns ONE PlaneRuntime
+for the node, and a tick dispatcher fans TickResults out to rooms — the
+reference instead wires per-room BufferFactories into Pion
+(roommanager.go:350).
+"""
+
+from livekit_server_tpu.service.roommanager import RoomManager
+from livekit_server_tpu.service.server import LivekitServer, create_server
+from livekit_server_tpu.service.store import LocalStore, ObjectStore
+
+__all__ = ["LivekitServer", "LocalStore", "ObjectStore", "RoomManager", "create_server"]

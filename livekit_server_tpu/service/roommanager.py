@@ -1,0 +1,257 @@
+"""RoomManager: per-node room registry + participant session workers.
+
+Reference parity: pkg/service/roommanager.go (StartSession :236-496,
+getOrCreateRoom :499-577, rtcSessionWorker :580-634, admin ops :655-761)
+plus the idle-room reaper (server.go backgroundWorker :367). The node's
+single PlaneRuntime is owned here; a tick dispatcher routes TickResults to
+each room's handlers (speakers, egress, keyframe requests) — replacing the
+reference's per-room worker goroutines (room.go:1278-1396).
+"""
+
+from __future__ import annotations
+
+import asyncio
+from typing import Callable
+
+from livekit_server_tpu.config.config import Config
+from livekit_server_tpu.models import plane
+from livekit_server_tpu.ops import audio as audio_ops, bwe as bwe_ops
+from livekit_server_tpu.protocol import models as pm
+from livekit_server_tpu.protocol.signal import decode_signal_request
+from livekit_server_tpu.routing.messagechannel import ChannelClosed, MessageChannel
+from livekit_server_tpu.routing.router import Router
+from livekit_server_tpu.rtc import Participant, Room, handle_participant_signal
+from livekit_server_tpu.runtime import PlaneRuntime
+from livekit_server_tpu.runtime.plane_runtime import TickResult
+from livekit_server_tpu.service.store import ObjectStore
+from livekit_server_tpu.utils import ids
+
+
+class RoomManager:
+    def __init__(
+        self,
+        config: Config,
+        router: Router,
+        store: ObjectStore,
+        mesh=None,
+        telemetry=None,
+    ):
+        self.config = config
+        self.router = router
+        self.store = store
+        self.telemetry = telemetry
+        p = config.plane
+        self.runtime = PlaneRuntime(
+            plane.PlaneDims(p.rooms, p.tracks_per_room, p.pkts_per_track, p.subs_per_room),
+            tick_ms=p.tick_ms,
+            mesh=mesh,
+            audio_params=audio_ops.AudioLevelParams(
+                active_level=config.audio.active_level,
+                min_percentile=config.audio.min_percentile,
+                observe_interval_ms=config.audio.update_interval_ms,
+                smooth_intervals=config.audio.smooth_intervals,
+            ),
+            bwe_params=bwe_ops.BWEParams(
+                nack_ratio_threshold=config.rtc.congestion_control.nack_ratio_threshold,
+                nack_window_min_packets=config.rtc.congestion_control.nack_window_min_packets,
+                estimate_required_downgrades=config.rtc.congestion_control.estimate_required_downgrades,
+                congested_min_estimate=config.rtc.congestion_control.min_channel_capacity,
+            ),
+        )
+        self.rooms: dict[str, Room] = {}
+        self._row_to_room: dict[int, Room] = {}
+        self.runtime.on_tick(self._dispatch_tick)
+        self._reaper_task: asyncio.Task | None = None
+        router.on_new_session(self.start_session)
+        self._update_node_stats()
+
+    # -- room lifecycle ---------------------------------------------------
+    async def get_or_create_room(self, name: str, info: pm.RoomInfo | None = None) -> Room:
+        room = self.rooms.get(name)
+        if room is not None:
+            return room
+        stored = await self.store.load_room(name)
+        room = Room(name, self.runtime, info=info or stored)
+        if info is None and stored is None:
+            room.info.empty_timeout = self.config.room.empty_timeout_s
+            room.info.departure_timeout = self.config.room.departure_timeout_s
+            room.info.max_participants = self.config.room.max_participants
+        self.rooms[name] = room
+        self._row_to_room[room.slots.row] = room
+        await self.store.store_room(room.info)
+        await self.router.set_node_for_room(name, self.router.local_node.node_id)
+        self._update_node_stats()
+        self._notify("room_started", room=room.info.to_dict())
+        return room
+
+    async def delete_room(self, name: str) -> None:
+        room = self.rooms.pop(name, None)
+        if room is not None:
+            self._row_to_room.pop(room.slots.row, None)
+            room.close(pm.DisconnectReason.ROOM_DELETED)
+            self._notify("room_finished", room=room.info.to_dict())
+        await self.store.delete_room(name)
+        await self.router.clear_room_state(name)
+        self._update_node_stats()
+
+    # -- session handling (roommanager.go StartSession) -------------------
+    async def start_session(
+        self,
+        room_name: str,
+        init: dict,
+        request_source: MessageChannel,
+        response_sink: MessageChannel,
+    ) -> None:
+        room = await self.get_or_create_room(room_name)
+        identity = init.get("identity", "")
+
+        existing = room.participants.get(identity)
+        if existing is not None and init.get("reconnect"):
+            # resume: swap the signal sinks onto the live participant
+            # (roommanager.go:266-316); bump the epoch so the OLD worker's
+            # teardown becomes a no-op when its socket finally closes.
+            existing.session_epoch += 1
+            existing.response_sink = response_sink
+            existing.send("reconnect", {})
+            await self._session_worker(room, existing, request_source)
+            return
+
+        participant = Participant(
+            identity,
+            room,
+            response_sink=response_sink,
+            grants=init.get("grants"),
+            name=init.get("name", ""),
+            auto_subscribe=init.get("auto_subscribe", True),
+        )
+        self._attach_media_queue(room, participant)
+        max_p = room.info.max_participants
+        if max_p and len(room.participants) >= max_p:
+            participant.send("leave", {"reason": int(pm.DisconnectReason.JOIN_FAILURE)})
+            response_sink.close()
+            return
+        join = room.join(participant)
+        participant.send("join", join)
+        await self.store.store_participant(room_name, participant.to_info())
+        self._update_node_stats()
+        self._notify(
+            "participant_joined",
+            room=room.info.to_dict(),
+            participant=participant.to_info().to_dict(),
+        )
+        await self._session_worker(room, participant, request_source)
+
+    async def _session_worker(
+        self, room: Room, participant: Participant, request_source: MessageChannel
+    ) -> None:
+        """Per-participant signal loop (rtcSessionWorker :580)."""
+        epoch = participant.session_epoch
+        try:
+            while not participant.disconnected.is_set():
+                raw = await request_source.read_message()
+                try:
+                    req = decode_signal_request(raw)
+                except ValueError:
+                    continue  # unknown/garbage frame: skip (reference logs)
+                handle_participant_signal(room, participant, req)
+        except ChannelClosed:
+            pass
+        finally:
+            # A stale worker (its session was resumed, or its identity was
+            # replaced by a newer connection) must not tear down the live
+            # participant or its store record.
+            cur = room.participants.get(participant.identity)
+            stale = participant.session_epoch != epoch or (
+                cur is not None and cur is not participant
+            )
+            if not stale:
+                if not participant.disconnected.is_set():
+                    room.remove_participant(participant, pm.DisconnectReason.SIGNAL_CLOSE)
+                await self.store.delete_participant(room.name, participant.identity)
+                self._update_node_stats()
+                self._notify(
+                    "participant_left",
+                    room=room.info.to_dict(),
+                    participant=participant.to_info().to_dict(),
+                )
+
+    def _attach_media_queue(self, room: Room, participant: Participant) -> None:
+        """Subscriber egress → bounded msgpack queue drained by the WS pump
+        (the transport half of DownTrack.WriteRTP → pacer → wire)."""
+        import msgpack
+
+        q: asyncio.Queue = asyncio.Queue(maxsize=512)
+        participant.media_queue = q
+
+        def media_out(pkt, room=room, q=q):
+            data = msgpack.packb(
+                {
+                    "track_sid": room.col_to_sid.get(pkt.track, ""),
+                    "sn": pkt.sn,
+                    "ts": pkt.ts,
+                    "pid": pkt.pid,
+                    "tl0": pkt.tl0,
+                    "keyidx": pkt.keyidx,
+                    "payload": pkt.payload,
+                }
+            )
+            try:
+                q.put_nowait(data)
+            except asyncio.QueueFull:
+                pass  # slow subscriber: drop (pacer/leaky-bucket analog)
+
+        participant.on_media(media_out)
+
+    # -- tick fan-out -----------------------------------------------------
+    def _dispatch_tick(self, res: TickResult) -> None:
+        for pkt in res.egress:
+            room = self._row_to_room.get(pkt.room)
+            if room is not None:
+                room.deliver_egress(pkt)
+        for row, speakers in res.speakers.items():
+            room = self._row_to_room.get(row)
+            if room is not None:
+                room.handle_speakers(speakers)
+        seen = set()
+        for row, track_col, _sub in res.need_keyframe:
+            if (row, track_col) in seen:
+                continue  # PLI throttle: one per track per tick
+            seen.add((row, track_col))
+            room = self._row_to_room.get(row)
+            if room is not None:
+                room.handle_keyframe_request(track_col)
+        if self.telemetry is not None:
+            self.telemetry.observe_plane(self.runtime.stats)
+
+    # -- periodic reaping (server.go backgroundWorker) --------------------
+    def start(self) -> None:
+        self.runtime.start()
+        if self._reaper_task is None:
+            self._reaper_task = asyncio.ensure_future(self._reaper())
+
+    async def _reaper(self) -> None:
+        while True:
+            await asyncio.sleep(1.0)
+            for name in [n for n, r in self.rooms.items() if r.should_close()]:
+                await self.delete_room(name)
+
+    async def stop(self) -> None:
+        if self._reaper_task is not None:
+            self._reaper_task.cancel()
+            self._reaper_task = None
+        await self.runtime.stop()
+        for name in list(self.rooms):
+            await self.delete_room(name)
+
+    # -- helpers ----------------------------------------------------------
+    def _update_node_stats(self) -> None:
+        st = self.router.local_node.stats
+        st.num_rooms = len(self.rooms)
+        st.num_clients = sum(len(r.participants) for r in self.rooms.values())
+        st.num_tracks_in = sum(len(r.tracks) for r in self.rooms.values())
+        st.plane_rooms_used = self.runtime.slots.rooms_used
+        st.plane_rooms_capacity = self.runtime.slots.capacity
+
+    def _notify(self, event: str, **payload) -> None:
+        if self.telemetry is not None:
+            self.telemetry.notify(event, **payload)

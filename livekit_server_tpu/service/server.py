@@ -1,0 +1,180 @@
+"""Server assembly + lifecycle.
+
+Reference parity: pkg/service/server.go (LivekitServer :46-61, Start
+:170-293, Stop :295-316, health :351-364) and the Wire DI graph
+(wire_gen.go:38-138) — here plain constructor wiring in create_server().
+Endpoints: /rtc (WS signal+media), /twirp/livekit.RoomService/* (admin),
+/ (health), /metrics (prometheus text format), /debug/rooms.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import time
+
+from aiohttp import web
+
+from livekit_server_tpu.config.config import Config
+from livekit_server_tpu.routing import (
+    LocalNode,
+    MemoryBus,
+    NodeState,
+    create_router,
+    create_selector,
+)
+from livekit_server_tpu.routing.node import sample_system_stats
+from livekit_server_tpu.routing.selector import NoNodesAvailable
+from livekit_server_tpu.service.roommanager import RoomManager
+from livekit_server_tpu.service.roomservice import RoomServiceAPI
+from livekit_server_tpu.service.rtcservice import RTCService
+from livekit_server_tpu.service.store import KVStore, LocalStore
+from livekit_server_tpu.telemetry import TelemetryService
+from livekit_server_tpu.version import __version__
+
+
+class LivekitServer:
+    def __init__(self, config: Config, router, store, room_manager, telemetry):
+        self.config = config
+        self.router = router
+        self.store = store
+        self.room_manager: RoomManager = room_manager
+        self.telemetry: TelemetryService = telemetry
+        self.rtc_service = RTCService(self)
+        self.room_api = RoomServiceAPI(self)
+        self.app = web.Application()
+        self.app.router.add_get("/", self.health)
+        self.app.router.add_get("/rtc", self.rtc_service.handle)
+        self.app.router.add_get("/rtc/validate", self.validate)
+        self.app.router.add_post(
+            "/twirp/livekit.RoomService/{method}", self.room_api.handle
+        )
+        self.app.router.add_get("/metrics", self.metrics)
+        self.app.router.add_get("/debug/rooms", self.debug_rooms)
+        self._runner: web.AppRunner | None = None
+        self._sites: list[web.TCPSite] = []
+        self._stats_task: asyncio.Task | None = None
+        self.started_at = 0.0
+
+    # -- selector ---------------------------------------------------------
+    def select_node(self) -> LocalNode | None:
+        """Pick an RTC node for a new room (roomallocator.go)."""
+        nodes = getattr(self, "_node_cache", None) or [self.router.local_node]
+        try:
+            return self._selector.select_node(nodes)
+        except NoNodesAvailable:
+            return None
+
+    async def _refresh_nodes(self) -> None:
+        while True:
+            self._node_cache = await self.router.list_nodes()
+            sample_system_stats(self.router.local_node.stats)
+            await asyncio.sleep(2.0)
+
+    def room_manager_media_queue(self, room_name: str, identity: str):
+        room = self.room_manager.rooms.get(room_name)
+        if room is None:
+            return None
+        p = room.participants.get(identity)
+        return getattr(p, "media_queue", None) if p else None
+
+    # -- endpoints --------------------------------------------------------
+    async def health(self, request: web.Request) -> web.Response:
+        # server.go:351 — 406 when node stats are stale
+        age = time.time() - self.router.local_node.stats.updated_at
+        if age > 4.0 and self.started_at and time.time() - self.started_at > 4.0:
+            return web.Response(status=406, text=f"node stats stale ({age:.1f}s)")
+        return web.Response(text="OK")
+
+    async def validate(self, request: web.Request) -> web.Response:
+        """rtcservice.go validate — join preflight without upgrading."""
+        from livekit_server_tpu.auth import TokenError, verify_token
+
+        token = request.query.get("access_token", "")
+        try:
+            claims = verify_token(token, self.config.keys)
+        except TokenError as e:
+            return web.Response(status=401, text=str(e))
+        if not claims.video.room_join:
+            return web.Response(status=401, text="token lacks roomJoin")
+        return web.Response(text="success")
+
+    async def metrics(self, request: web.Request) -> web.Response:
+        return web.Response(
+            text=self.telemetry.prometheus_text(), content_type="text/plain"
+        )
+
+    async def debug_rooms(self, request: web.Request) -> web.Response:
+        rm = self.room_manager
+        return web.json_response(
+            {
+                "node": self.router.local_node.node_id,
+                "version": __version__,
+                "rooms": {
+                    name: {
+                        "row": r.slots.row,
+                        "participants": list(r.participants),
+                        "tracks": list(r.tracks),
+                    }
+                    for name, r in rm.rooms.items()
+                },
+                "plane": rm.runtime.stats,
+                "ingest_dropped": rm.runtime.ingest.dropped,
+            }
+        )
+
+    # -- lifecycle --------------------------------------------------------
+    async def start(self) -> None:
+        await self.router.register_node()
+        if hasattr(self.router, "remove_dead_nodes"):
+            await self.router.remove_dead_nodes()
+        # Warm-compile the media-plane step before accepting traffic so the
+        # first tick doesn't stall the event loop mid-session (XLA compiles
+        # once per (shapes, params); later ticks hit the cache).
+        await self.room_manager.runtime.step_once()
+        self.room_manager.start()
+        self._stats_task = asyncio.ensure_future(self._refresh_nodes())
+        self._runner = web.AppRunner(self.app)
+        await self._runner.setup()
+        for addr in self.config.bind_addresses:
+            site = web.TCPSite(self._runner, addr, self.config.port)
+            await site.start()
+            self._sites.append(site)
+        self.started_at = time.time()
+
+    async def stop(self, force: bool = False) -> None:
+        self.router.local_node.state = NodeState.SHUTTING_DOWN
+        await self.router.drain()
+        if not force:
+            # graceful: wait briefly for participants to drain (server.go:295)
+            for _ in range(50):
+                if not any(r.participants for r in self.room_manager.rooms.values()):
+                    break
+                await asyncio.sleep(0.1)
+        if self._stats_task:
+            self._stats_task.cancel()
+        await self.room_manager.stop()
+        await self.router.unregister_node()
+        if self._runner is not None:
+            await self._runner.cleanup()
+
+    @property
+    def port(self) -> int:
+        return self.config.port
+
+
+def create_server(config: Config, bus=None, mesh=None) -> LivekitServer:
+    """The Wire graph (wire_gen.go InitializeServer) as explicit wiring."""
+    node = LocalNode(region=config.region)
+    sample_system_stats(node.stats)
+    if bus is None and config.kv.kind == "memory":
+        router = create_router(node, None)
+        store = LocalStore()
+    else:
+        bus = bus if bus is not None else MemoryBus()
+        router = create_router(node, bus)
+        store = KVStore(bus)
+    telemetry = TelemetryService(config)
+    rm = RoomManager(config, router, store, mesh=mesh, telemetry=telemetry)
+    server = LivekitServer(config, router, store, rm, telemetry)
+    server._selector = create_selector(config.node_selector, config.region)
+    return server

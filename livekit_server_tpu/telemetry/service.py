@@ -1,0 +1,85 @@
+"""Event fan-in + metrics registry.
+
+Reference parity: pkg/telemetry/telemetryservice.go:29-200 (single
+consumer queue of room/participant/track lifecycle events), events.go
+(the ~30 event constructors), prometheus/*.go counters. Events fan out to
+the webhook notifier (webhook.go) and increment counters; `prometheus_text`
+renders the registry in the exposition format served at /metrics.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import time
+from collections import defaultdict
+from typing import Any
+
+from livekit_server_tpu.config.config import Config
+from livekit_server_tpu.telemetry.webhook import WebhookNotifier
+
+# Event names follow the reference's webhook event strings
+# (webhook.go EventRoomStarted etc.).
+EVENTS = {
+    "room_started",
+    "room_finished",
+    "participant_joined",
+    "participant_left",
+    "track_published",
+    "track_unpublished",
+    "egress_started",
+    "egress_ended",
+    "ingress_started",
+    "ingress_ended",
+}
+
+
+class TelemetryService:
+    def __init__(self, config: Config):
+        self.config = config
+        self.counters: dict[str, float] = defaultdict(float)
+        self.gauges: dict[str, float] = {}
+        self.events: list[dict[str, Any]] = []  # ring of recent events
+        self.webhook = WebhookNotifier(config)
+
+    # -- events (events.go) ----------------------------------------------
+    def notify(self, event: str, **payload: Any) -> None:
+        if event not in EVENTS:
+            return
+        self.counters[f"livekit_events_total{{event=\"{event}\"}}"] += 1
+        record = {"event": event, "created_at": int(time.time()), **payload}
+        self.events.append(record)
+        if len(self.events) > 1000:
+            del self.events[: len(self.events) - 1000]
+        self.webhook.queue(record)
+
+    # -- counters (prometheus/packets.go naming) -------------------------
+    def add(self, name: str, value: float = 1.0, **labels: str) -> None:
+        self.counters[_key(name, labels)] += value
+
+    def set_gauge(self, name: str, value: float, **labels: str) -> None:
+        self.gauges[_key(name, labels)] = value
+
+    def observe_plane(self, stats: dict[str, Any]) -> None:
+        """Per-tick media-plane stats → node counters (statsworker.go)."""
+        self.set_gauge("livekit_plane_ticks_total", stats.get("ticks", 0))
+        self.set_gauge("livekit_packets_forwarded_total", stats.get("fwd_packets", 0))
+        self.set_gauge("livekit_bytes_forwarded_total", stats.get("fwd_bytes", 0))
+        self.set_gauge("livekit_plane_late_ticks_total", stats.get("late_ticks", 0))
+
+    def prometheus_text(self) -> str:
+        lines = []
+        for key, v in sorted(self.counters.items()):
+            lines.append(f"{key} {v:g}")
+        for key, v in sorted(self.gauges.items()):
+            lines.append(f"{key} {v:g}")
+        return "\n".join(lines) + "\n"
+
+    async def close(self) -> None:
+        await self.webhook.close()
+
+
+def _key(name: str, labels: dict[str, str]) -> str:
+    if not labels:
+        return name
+    inner = ",".join(f'{k}="{v}"' for k, v in sorted(labels.items()))
+    return f"{name}{{{inner}}}"

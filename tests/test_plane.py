@@ -13,6 +13,26 @@ from livekit_server_tpu.models import plane
 from livekit_server_tpu.ops import audio
 
 
+class DenseOut:
+    """Adapter: view compacted egress as the dense grids the assertions use."""
+
+    def __init__(self, out, dims):
+        self.raw = out
+        (self.send, self.out_sn, self.out_ts, self.out_pid, self.out_tl0,
+         self.out_keyidx) = plane.egress_to_dense(out, dims)
+        for f in ("need_keyframe", "speaker_levels", "speaker_tracks",
+                  "congested", "target_layers", "fwd_packets", "fwd_bytes",
+                  "egress_overflow"):
+            setattr(self, f, getattr(out, f))
+
+
+def dense_step(step, dims):
+    def run(st, inp):
+        st, out = step(st, inp)
+        return st, DenseOut(out, dims)
+    return run
+
+
 def make_inputs(dims: plane.PlaneDims, **over):
     R, T, K, S = dims
     z = lambda dt: jnp.zeros((R, T, K), dt)
@@ -50,7 +70,7 @@ def two_party_audio_state():
 
 def test_two_party_audio_forwarding():
     dims, st = two_party_audio_state()
-    step = jax.jit(plane.media_plane_tick)
+    step = dense_step(jax.jit(plane.media_plane_tick), dims)
     sn = 1000
     for i in range(5):
         inp = make_inputs(
@@ -74,7 +94,7 @@ def test_two_party_audio_forwarding():
 
 def test_two_party_active_speaker():
     dims, st = two_party_audio_state()
-    step = jax.jit(plane.media_plane_tick)
+    step = dense_step(jax.jit(plane.media_plane_tick), dims)
     # 30 ticks × 20 ms = 600 ms > 500 ms window ⇒ speaker ranking updates.
     for i in range(30):
         inp = make_inputs(
@@ -95,7 +115,7 @@ def test_two_party_active_speaker():
 def test_unsubscribed_not_forwarded():
     dims, st = two_party_audio_state()
     st = st._replace(ctrl=st.ctrl._replace(subscribed=jnp.zeros((1, 2, 2), jnp.bool_)))
-    step = jax.jit(plane.media_plane_tick)
+    step = dense_step(jax.jit(plane.media_plane_tick), dims)
     inp = make_inputs(
         dims,
         valid=jnp.ones((1, 2, 1), jnp.bool_),
@@ -109,7 +129,7 @@ def test_unsubscribed_not_forwarded():
 def test_pub_mute_stops_forwarding():
     dims, st = two_party_audio_state()
     st = st._replace(meta=st.meta._replace(pub_muted=jnp.asarray([[True, False]])))
-    step = jax.jit(plane.media_plane_tick)
+    step = dense_step(jax.jit(plane.media_plane_tick), dims)
     inp = make_inputs(
         dims, valid=jnp.ones((1, 2, 1), jnp.bool_), size=jnp.full((1, 2, 1), 100, jnp.int32)
     )
@@ -144,7 +164,7 @@ def test_simulcast_keyframe_lockon_and_munge():
     # Pin allocator caps so per-tick allocation preserves the intent.
     ctrl = st.ctrl._replace(max_spatial=jnp.asarray([[[0, 2, 2]]], jnp.int32))
     st = st._replace(sel=sel, ctrl=ctrl)
-    step = jax.jit(plane.media_plane_tick)
+    step = dense_step(jax.jit(plane.media_plane_tick), dims)
 
     # Tick 1: keyframes on all three layers (one packet per layer).
     inp = make_inputs(
@@ -194,7 +214,7 @@ def test_multi_room_vmap_isolation():
         meta=st.meta._replace(published=pub),
         ctrl=st.ctrl._replace(subscribed=jnp.asarray(subd)),
     )
-    step = jax.jit(plane.media_plane_tick)
+    step = dense_step(jax.jit(plane.media_plane_tick), dims)
     inp = make_inputs(
         dims, valid=jnp.ones((2, 1, 1), jnp.bool_), size=jnp.full((2, 1, 1), 99, jnp.int32)
     )

@@ -1,0 +1,254 @@
+"""In-process end-to-end: control plane → device plane → egress.
+
+Mirrors the reference's integration tier (test/singlenode_test.go
+TestSinglePublisher :140 — the behavioral spec of BASELINE.md config 1):
+participants join a room through signal messages, publish tracks, media
+packets flow through the batched plane, and subscribers receive munged
+packets. No network; signal goes through MessageChannels, media through
+IngestBuffer — the seams the WS/UDP transports plug into.
+"""
+
+import asyncio
+import json
+
+import pytest
+
+from livekit_server_tpu.models import plane
+from livekit_server_tpu.protocol import decode_signal_response
+from livekit_server_tpu.protocol import models as pm
+from livekit_server_tpu.protocol.signal import SignalRequest
+from livekit_server_tpu.routing.messagechannel import MessageChannel
+from livekit_server_tpu.rtc import Participant, Room, handle_participant_signal
+from livekit_server_tpu.runtime import PlaneRuntime
+from livekit_server_tpu.runtime.ingest import PacketIn
+
+
+DIMS = plane.PlaneDims(rooms=2, tracks=4, pkts=4, subs=4)
+
+
+def make_participant(room, identity, **kw):
+    sink = MessageChannel(size=500)
+    p = Participant(identity, room, response_sink=sink, **kw)
+    return p, sink
+
+
+def drain_sink(sink):
+    out = []
+    while True:
+        try:
+            out.append(decode_signal_response(sink._q.get_nowait()))
+        except asyncio.QueueEmpty:
+            return out
+        except Exception:
+            return out
+
+
+def publish_audio(room, p, cid="mic1"):
+    handle_participant_signal(room, p, SignalRequest("add_track", {"cid": cid, "type": 0, "name": "mic"}))
+    track = p.publish_pending(cid)
+    assert track is not None
+    return track
+
+
+@pytest.fixture
+def runtime():
+    return PlaneRuntime(DIMS, tick_ms=20)
+
+
+async def test_two_party_audio_end_to_end(runtime):
+    room = Room("lobby", runtime)
+    alice, a_sink = make_participant(room, "alice")
+    bob, b_sink = make_participant(room, "bob")
+    join_a = room.join(alice)
+    join_b = room.join(bob)
+    assert join_a["room"]["name"] == "lobby"
+    assert join_b["other_participants"][0]["identity"] == "alice"
+
+    track = publish_audio(room, alice)
+    # track_published went to alice; bob got auto-subscribed
+    kinds_a = [m.kind for m in drain_sink(a_sink)]
+    assert "track_published" in kinds_a
+    kinds_b = [m.kind for m in drain_sink(b_sink)]
+    assert "track_subscribed" in kinds_b
+
+    # media: bob registers egress, alice publishes 3 loud packets
+    got = []
+    bob.on_media(got.append)
+    for i in range(3):
+        runtime.ingest.push(
+            PacketIn(
+                room=room.slots.row, track=track.track_col,
+                sn=7000 + i, ts=960 * i, size=120, payload=bytes([i]) * 10,
+                audio_level=18, frame_ms=20,
+            )
+        )
+        res = await runtime.step_once()
+        for pkt in res.egress:
+            room.deliver_egress(pkt)
+    assert [p.sn for p in got] == [7000, 7001, 7002]
+    assert got[0].payload == b"\x00" * 10
+    assert all(p.sub == bob.sub_col for p in got)
+
+
+async def test_active_speaker_broadcast(runtime):
+    room = Room("spk", runtime)
+    alice, a_sink = make_participant(room, "alice")
+    bob, b_sink = make_participant(room, "bob")
+    room.join(alice)
+    room.join(bob)
+    track = publish_audio(room, alice)
+    # 600 ms of loud audio from alice (30 ticks × 20 ms)
+    for i in range(30):
+        runtime.ingest.push(
+            PacketIn(room=room.slots.row, track=track.track_col,
+                     sn=i, ts=960 * i, size=100, audio_level=15, frame_ms=20)
+        )
+        res = await runtime.step_once()
+        if room.slots.row in res.speakers:
+            room.handle_speakers(res.speakers[room.slots.row])
+    msgs = [m for m in drain_sink(b_sink) if m.kind == "speakers_changed"]
+    assert msgs, "no speakers_changed broadcast"
+    assert msgs[-1].data["speakers"][0]["sid"] == alice.sid
+
+
+async def test_mute_stops_forwarding(runtime):
+    room = Room("mute", runtime)
+    alice, _ = make_participant(room, "alice")
+    bob, _ = make_participant(room, "bob")
+    room.join(alice)
+    room.join(bob)
+    track = publish_audio(room, alice)
+    got = []
+    bob.on_media(got.append)
+
+    handle_participant_signal(room, alice, SignalRequest("mute", {"sid": track.info.sid, "muted": True}))
+    runtime.ingest.push(
+        PacketIn(room=room.slots.row, track=track.track_col, sn=1, ts=0, size=50)
+    )
+    res = await runtime.step_once()
+    for pkt in res.egress:
+        room.deliver_egress(pkt)
+    assert got == []
+
+    handle_participant_signal(room, alice, SignalRequest("mute", {"sid": track.info.sid, "muted": False}))
+    runtime.ingest.push(
+        PacketIn(room=room.slots.row, track=track.track_col, sn=2, ts=960, size=50)
+    )
+    res = await runtime.step_once()
+    for pkt in res.egress:
+        room.deliver_egress(pkt)
+    assert [p.sn for p in got] == [2]
+
+
+async def test_unsubscribe_and_permissions(runtime):
+    room = Room("perm", runtime)
+    alice, _ = make_participant(room, "alice")
+    bob, b_sink = make_participant(room, "bob")
+    room.join(alice)
+    room.join(bob)
+    track = publish_audio(room, alice)
+    # bob explicitly unsubscribes
+    handle_participant_signal(
+        room, bob, SignalRequest("subscription", {"track_sids": [track.info.sid], "subscribe": False})
+    )
+    got = []
+    bob.on_media(got.append)
+    runtime.ingest.push(PacketIn(room=room.slots.row, track=track.track_col, sn=1, ts=0, size=50))
+    res = await runtime.step_once()
+    for pkt in res.egress:
+        room.deliver_egress(pkt)
+    assert got == []
+
+    # a participant without can_subscribe is refused
+    carol, c_sink = make_participant(
+        room, "carol", grants={"video": {"canSubscribe": False}}
+    )
+    room.join(carol)
+    assert not room.subscribe(carol, track.info.sid)
+    kinds = [m.kind for m in drain_sink(c_sink)]
+    assert "subscription_response" in kinds
+
+
+async def test_duplicate_identity_kicks_old(runtime):
+    room = Room("dup", runtime)
+    a1, s1 = make_participant(room, "alice")
+    room.join(a1)
+    a2, s2 = make_participant(room, "alice")
+    room.join(a2)
+    assert a1.state == pm.ParticipantState.DISCONNECTED
+    assert a1.close_reason == pm.DisconnectReason.DUPLICATE_IDENTITY
+    assert room.participants["alice"] is a2
+    assert len(room.participants) == 1
+
+
+async def test_leave_and_idle_close(runtime):
+    room = Room("bye", runtime)
+    room.info.empty_timeout = 0
+    alice, _ = make_participant(room, "alice")
+    room.join(alice)
+    handle_participant_signal(room, alice, SignalRequest("leave", {}))
+    assert room.is_empty
+    import time
+    assert room.should_close(now=time.time() + 1)
+    room.close()
+    assert runtime.slots.get("bye") is None
+    # row is reusable
+    room2 = Room("bye2", runtime)
+    assert room2.slots.row == room.slots.row
+
+
+async def test_data_broadcast(runtime):
+    room = Room("data", runtime)
+    alice, _ = make_participant(room, "alice")
+    bob, b_sink = make_participant(room, "bob")
+    room.join(alice)
+    room.join(bob)
+    room.broadcast_data(alice, payload="aGVsbG8=", kind=1, topic="chat")
+    msgs = [m for m in drain_sink(b_sink) if m.kind == "data_packet"]
+    assert msgs and msgs[0].data["payload"] == "aGVsbG8="
+    assert msgs[0].data["topic"] == "chat"
+
+
+async def test_ping_pong_and_metadata(runtime):
+    room = Room("misc", runtime)
+    alice, a_sink = make_participant(
+        room, "alice", grants={"video": {"canUpdateOwnMetadata": True}}
+    )
+    room.join(alice)
+    handle_participant_signal(room, alice, SignalRequest("ping", {"timestamp": 123}))
+    msgs = drain_sink(a_sink)
+    pongs = [m for m in msgs if m.kind == "pong"]
+    assert pongs and pongs[0].data["last_ping_timestamp"] == 123
+
+    handle_participant_signal(
+        room, alice, SignalRequest("update_metadata", {"metadata": "m2", "name": "Alice"})
+    )
+    assert alice.metadata == "m2" and alice.name == "Alice"
+
+
+async def test_checkpoint_restore_mid_stream(runtime):
+    """Munger state survives snapshot/restore (migration seeding, §5.4)."""
+    room = Room("ckpt", runtime)
+    alice, _ = make_participant(room, "alice")
+    bob, _ = make_participant(room, "bob")
+    room.join(alice)
+    room.join(bob)
+    track = publish_audio(room, alice)
+    got = []
+    bob.on_media(got.append)
+    for i in range(3):
+        runtime.ingest.push(
+            PacketIn(room=room.slots.row, track=track.track_col, sn=100 + i, ts=960 * i, size=50)
+        )
+        res = await runtime.step_once()
+        for pkt in res.egress:
+            room.deliver_egress(pkt)
+    snap = runtime.snapshot()
+    runtime.restore(snap)
+    runtime.ingest.push(
+        PacketIn(room=room.slots.row, track=track.track_col, sn=103, ts=960 * 3, size=50)
+    )
+    res = await runtime.step_once()
+    for pkt in res.egress:
+        room.deliver_egress(pkt)
+    assert [p.sn for p in got] == [100, 101, 102, 103]

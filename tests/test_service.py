@@ -1,0 +1,292 @@
+"""Service-layer integration tests over real HTTP + WebSocket.
+
+Reference parity: test/ integration tier (integration_helpers.go
+createSingleNodeServer → real server + real WS clients;
+singlenode_test.go scenarios: connect, duplicate identity, publisher +
+subscriber media, permissions) and roomservice_test.go (admin API).
+The in-process server binds a real port; clients are aiohttp WS sessions
+speaking the JSON signal protocol + msgpack media frames.
+"""
+
+import asyncio
+import json
+
+import aiohttp
+import msgpack
+import pytest
+
+from livekit_server_tpu.auth import AccessToken, VideoGrant
+from livekit_server_tpu.config import load_config
+from livekit_server_tpu.service.server import create_server
+
+API_KEY, API_SECRET = "testkey", "testsecret"
+
+
+def make_config(port: int, **plane_overrides):
+    plane = {"rooms": 4, "tracks_per_room": 4, "pkts_per_track": 4, "subs_per_room": 4,
+             "tick_ms": 10} | plane_overrides
+    return load_config(
+        yaml_text=json.dumps(
+            {
+                "keys": {API_KEY: API_SECRET},
+                "port": port,
+                "bind_addresses": ["127.0.0.1"],
+                "plane": plane,
+                "room": {"empty_timeout_s": 2},
+            }
+        )
+    )
+
+
+def token(identity: str, room: str, **grant_kw) -> str:
+    t = AccessToken(API_KEY, API_SECRET)
+    t.identity = identity
+    t.grant = VideoGrant(room_join=True, room=room, **grant_kw)
+    return t.to_jwt()
+
+
+def admin_token() -> str:
+    t = AccessToken(API_KEY, API_SECRET)
+    t.identity = "admin"
+    t.grant = VideoGrant(room_admin=True, room_create=True, room_list=True)
+    return t.to_jwt()
+
+
+class SignalClient:
+    """Minimal test client (test/client/client.go RTCClient analog)."""
+
+    def __init__(self, session: aiohttp.ClientSession, port: int):
+        self.session = session
+        self.port = port
+        self.ws = None
+        self.signals: list = []
+        self.media: list = []
+        self._reader: asyncio.Task | None = None
+
+    async def connect(self, room: str, identity: str, **grant_kw):
+        self.ws = await self.session.ws_connect(
+            f"ws://127.0.0.1:{self.port}/rtc?access_token={token(identity, room, **grant_kw)}"
+        )
+        self._reader = asyncio.ensure_future(self._read())
+        join = await self.wait_for("join")
+        return join
+
+    async def _read(self):
+        async for msg in self.ws:
+            if msg.type == aiohttp.WSMsgType.TEXT:
+                self.signals.append(json.loads(msg.data))
+            elif msg.type == aiohttp.WSMsgType.BINARY:
+                self.media.append(msgpack.unpackb(msg.data, raw=False))
+
+    async def wait_for(self, kind: str, timeout: float = 3.0):
+        deadline = asyncio.get_event_loop().time() + timeout
+        while asyncio.get_event_loop().time() < deadline:
+            for m in self.signals:
+                if kind in m:
+                    return m[kind]
+            await asyncio.sleep(0.01)
+        raise TimeoutError(f"no {kind!r} in {self.signals}")
+
+    async def wait_media(self, n: int = 1, timeout: float = 3.0):
+        deadline = asyncio.get_event_loop().time() + timeout
+        while asyncio.get_event_loop().time() < deadline:
+            if len(self.media) >= n:
+                return self.media
+            await asyncio.sleep(0.01)
+        raise TimeoutError(f"only {len(self.media)} media frames")
+
+    async def send_signal(self, kind: str, data: dict):
+        await self.ws.send_str(json.dumps({kind: data}))
+
+    async def send_media(self, **frame):
+        await self.ws.send_bytes(msgpack.packb(frame))
+
+    async def close(self):
+        if self._reader:
+            self._reader.cancel()
+        if self.ws is not None:
+            await self.ws.close()
+
+
+import contextlib
+import socket
+
+
+@contextlib.asynccontextmanager
+async def running_server(**plane_overrides):
+    """In-process server on a free port (createSingleNodeServer analog).
+
+    An async context manager rather than a pytest fixture: the conftest
+    async shim runs coroutine *tests*, not async fixtures.
+    """
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    srv = create_server(make_config(port, **plane_overrides))
+    await srv.start()
+    try:
+        yield srv
+    finally:
+        await srv.stop(force=True)
+
+
+async def test_health_and_validate():
+    async with running_server() as server:
+        async with aiohttp.ClientSession() as s:
+            async with s.get(f"http://127.0.0.1:{server.port}/") as r:
+                assert r.status == 200
+            async with s.get(
+                f"http://127.0.0.1:{server.port}/rtc/validate?access_token={token('a', 'r')}"
+            ) as r:
+                assert r.status == 200
+            async with s.get(
+                f"http://127.0.0.1:{server.port}/rtc/validate?access_token=garbage"
+            ) as r:
+                assert r.status == 401
+
+
+async def test_rtc_rejects_bad_tokens():
+    async with running_server() as server:
+        async with aiohttp.ClientSession() as s:
+            async with s.get(f"http://127.0.0.1:{server.port}/rtc") as r:
+                assert r.status == 401
+            t = AccessToken(API_KEY, API_SECRET)
+            t.identity = "x"
+            t.grant = VideoGrant(room_list=True)  # no roomJoin
+            async with s.get(
+                f"http://127.0.0.1:{server.port}/rtc?access_token={t.to_jwt()}"
+            ) as r:
+                assert r.status == 401
+
+
+async def test_join_publish_subscribe_media():
+    """The TestSinglePublisher flow end-to-end over the wire."""
+    async with running_server() as server:
+        async with aiohttp.ClientSession() as s:
+            alice = SignalClient(s, server.port)
+            bob = SignalClient(s, server.port)
+            join_a = await alice.connect("lobby", "alice")
+            assert join_a["participant"]["identity"] == "alice"
+            join_b = await bob.connect("lobby", "bob")
+            assert [p["identity"] for p in join_b["other_participants"]] == ["alice"]
+
+            # alice announces + publishes an audio track
+            await alice.send_signal("add_track", {"cid": "mic", "type": 0, "name": "mic"})
+            tp = await alice.wait_for("track_published")
+            track_sid = tp["track"]["sid"]
+
+            # first media frame binds the pending track (the reference's
+            # OnTrack moment); bob then auto-subscribes
+            await alice.send_media(
+                cid="mic", sn=99, ts=0, payload=b"bind", audio_level=20, frame_ms=20
+            )
+            await bob.wait_for("track_subscribed")
+
+            # alice streams 5 packets; bob receives them munged+payload intact
+            for i in range(5):
+                await alice.send_media(
+                    cid="mic", sn=100 + i, ts=960 * i, payload=b"opus" + bytes([i]),
+                    audio_level=20, frame_ms=20,
+                )
+                await asyncio.sleep(0.03)
+            media = await bob.wait_media(5)
+            sns = [m["sn"] for m in media]
+            assert [s for s in sns if s >= 100][:5] == [100, 101, 102, 103, 104]
+            first = next(m for m in media if m["sn"] == 100)
+            assert first["payload"] == b"opus\x00"
+            assert first["track_sid"] == track_sid
+
+            # speakers fire eventually (alice is loud)
+            for i in range(5, 40):
+                await alice.send_media(
+                    cid="mic", sn=100 + i, ts=960 * i, payload=b"x", audio_level=18,
+                    frame_ms=20,
+                )
+                await asyncio.sleep(0.012)
+            spk = await bob.wait_for("speakers_changed", timeout=5)
+            assert spk["speakers"][0]["sid"] == join_a["participant"]["sid"]
+
+            await alice.close()
+            await bob.close()
+
+
+async def test_room_service_api():
+    async with running_server() as server:
+        async with aiohttp.ClientSession() as s:
+            hdr = {"Authorization": f"Bearer {admin_token()}"}
+            base = f"http://127.0.0.1:{server.port}/twirp/livekit.RoomService"
+
+            async with s.post(f"{base}/CreateRoom", json={"name": "api-room"}, headers=hdr) as r:
+                assert r.status == 200
+                room = await r.json()
+                assert room["name"] == "api-room"
+
+            async with s.post(f"{base}/ListRooms", json={}, headers=hdr) as r:
+                rooms = (await r.json())["rooms"]
+                assert "api-room" in [x["name"] for x in rooms]
+
+            # join someone, then admin ops on them
+            alice = SignalClient(s, server.port)
+            await alice.connect("api-room", "alice")
+            async with s.post(
+                f"{base}/ListParticipants", json={"room": "api-room"}, headers=hdr
+            ) as r:
+                parts = (await r.json())["participants"]
+                assert [p["identity"] for p in parts] == ["alice"]
+
+            async with s.post(
+                f"{base}/UpdateRoomMetadata",
+                json={"room": "api-room", "metadata": "hello"},
+                headers=hdr,
+            ) as r:
+                assert (await r.json())["metadata"] == "hello"
+            await alice.wait_for("room_update")
+
+            async with s.post(
+                f"{base}/RemoveParticipant",
+                json={"room": "api-room", "identity": "alice"},
+                headers=hdr,
+            ) as r:
+                assert r.status == 200
+            await alice.wait_for("leave")
+
+            async with s.post(f"{base}/DeleteRoom", json={"room": "api-room"}, headers=hdr) as r:
+                assert r.status == 200
+            await alice.close()
+
+            # non-admin token refused
+            async with s.post(
+                f"{base}/DeleteRoom",
+                json={"room": "x"},
+                headers={"Authorization": f"Bearer {token('u', 'x')}"},
+            ) as r:
+                assert r.status == 403
+
+
+async def test_duplicate_identity_over_wire():
+    async with running_server() as server:
+        async with aiohttp.ClientSession() as s:
+            c1 = SignalClient(s, server.port)
+            await c1.connect("dup", "alice")
+            c2 = SignalClient(s, server.port)
+            await c2.connect("dup", "alice")
+            leave = await c1.wait_for("leave")
+            assert leave["reason"] == 2  # DUPLICATE_IDENTITY
+            await c1.close()
+            await c2.close()
+
+
+async def test_metrics_and_debug():
+    async with running_server() as server:
+        async with aiohttp.ClientSession() as s:
+            alice = SignalClient(s, server.port)
+            await alice.connect("m", "alice")
+            async with s.get(f"http://127.0.0.1:{server.port}/metrics") as r:
+                text = await r.text()
+                assert "livekit_events_total" in text
+            async with s.get(f"http://127.0.0.1:{server.port}/debug/rooms") as r:
+                dbg = await r.json()
+                assert "m" in dbg["rooms"]
+                assert dbg["rooms"]["m"]["participants"] == ["alice"]
+            await alice.close()
