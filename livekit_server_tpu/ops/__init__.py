@@ -7,10 +7,16 @@ pure-logic hot-path components (SURVEY.md §7 step 2):
   - rtpmunger   — SN/TS rewrite with gap compaction (pkg/sfu/rtpmunger.go)
   - vp8         — VP8 payload-descriptor rewriting (pkg/sfu/codecmunger/vp8.go)
   - audio       — RFC6464 active-speaker levels (pkg/sfu/audio/audiolevel.go)
-  - selector    — simulcast/SVC layer selection (pkg/sfu/videolayerselector)
+  - selector    — simulcast/temporal layer selection (pkg/sfu/videolayerselector)
+  - svc         — VP9 SVC onion + dependency-descriptor decode targets
+                  (videolayerselector/vp9.go, dependencydescriptor.go)
   - allocation  — forwarder bandwidth-allocation algebra (pkg/sfu/forwarder.go)
   - bwe         — trend detection / channel observation (pkg/sfu/streamallocator)
   - quality     — E-model connection-quality scoring (pkg/sfu/connectionquality)
+  - streamtracker — per-layer liveness/bitrate windows (pkg/sfu/streamtracker)
+  - sequencer   — NACK/RTX replay metadata rings (pkg/sfu/sequencer.go)
+  - red         — RFC 2198 Opus redundancy planning (pkg/sfu/redreceiver.go)
+  - pacer       — per-subscriber leaky-bucket egress pacing (pkg/sfu/pacer)
 
 Everything here is functional: `update(state, inputs) -> (state, outputs)`,
 jit/vmap/shard_map-friendly, static shapes, int32 modular arithmetic (no x64).

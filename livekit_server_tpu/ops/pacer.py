@@ -1,0 +1,65 @@
+"""Batched egress pacing: per-subscriber leaky bucket.
+
+Reference parity: pkg/sfu/pacer — PassThrough (direct), NoQueue
+(sequential worker), LeakyBucket (leaky_bucket.go:47-200: per-interval
+byte budget from target bitrate, queue drains at the paced rate). The
+reference runs one pacer goroutine per participant; here every
+subscriber's bucket updates in one elementwise op per tick, and the host
+egress sends `allowed` bytes worth of queued packets per subscriber this
+tick (ordering within a subscriber stays FIFO on the host).
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+
+
+class PacerParams(NamedTuple):
+    burst_ms: int = 100       # bucket depth in ms of target rate
+    min_rate_bps: float = 64_000.0
+
+
+class PacerState(NamedTuple):
+    """Per-subscriber buckets, fields [..., S] float32."""
+
+    tokens: jax.Array      # byte allowance accumulated
+    rate_bps: jax.Array    # paced rate (committed channel capacity)
+    queued: jax.Array      # bytes waiting host-side
+
+
+def init_state(num_subscribers: int, initial_rate: float = 7_000_000.0) -> PacerState:
+    s = (num_subscribers,)
+    return PacerState(
+        tokens=jnp.zeros(s, jnp.float32),
+        rate_bps=jnp.full(s, initial_rate, jnp.float32),
+        queued=jnp.zeros(s, jnp.float32),
+    )
+
+
+def update_tick(
+    state: PacerState,
+    params: PacerParams,
+    enqueued_bytes: jax.Array,   # [..., S] float32 — new egress this tick
+    rate_bps: jax.Array,         # [..., S] float32 — allocator's committed rate
+    tick_ms: jax.Array,          # scalar int32
+):
+    """Returns (state, allowed_bytes [S], backlog_bytes [S]).
+
+    `allowed_bytes` is how much each subscriber's transport may write this
+    tick; the remainder stays queued (leaky_bucket.go's interval drain).
+    """
+    rate = jnp.maximum(rate_bps, params.min_rate_bps)
+    dt_s = jnp.maximum(jnp.asarray(tick_ms, jnp.float32), 1.0) / 1000.0
+    cap = rate * (params.burst_ms / 1000.0) / 8.0      # bucket depth, bytes
+    tokens = jnp.minimum(state.tokens + rate * dt_s / 8.0, cap)
+    queued = state.queued + enqueued_bytes
+    allowed = jnp.minimum(queued, tokens)
+    new_state = PacerState(
+        tokens=tokens - allowed,
+        rate_bps=rate,
+        queued=queued - allowed,
+    )
+    return new_state, allowed, queued - allowed

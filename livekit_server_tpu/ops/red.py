@@ -1,0 +1,101 @@
+"""Batched RED (RFC 2198) encode planning for Opus redundancy.
+
+Reference parity: pkg/sfu/redreceiver.go (~230 LoC, encapsulate primary →
+RED with up to 2 redundant blocks) and redprimaryreceiver.go (~260 LoC,
+decapsulate RED → primary for non-RED subscribers). The reference builds
+RED payloads inline per packet; byte assembly stays host/C++ here, and the
+device computes the per-packet *plan*: which previous packets to attach,
+their 14-bit timestamp offsets, and whether they fit the offset field.
+
+A RED block header carries (block PT, 14-bit TS offset, 10-bit length);
+a primary can carry redundancy only for packets ≤ 16383 TS units back
+(redreceiver.go's distance checks).
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+
+MAX_TS_OFFSET = (1 << 14) - 1
+MAX_BLOCK_LEN = (1 << 10) - 1
+RED_DISTANCE = 2   # redundancy depth (redreceiver.go maxRedCount)
+
+
+class REDState(NamedTuple):
+    """Per-track history of the last RED_DISTANCE packets, [..., T, D]."""
+
+    hist_sn: jax.Array    # int32 — SN of historical packet (-1 empty)
+    hist_ts: jax.Array    # int32
+    hist_len: jax.Array   # int32 — payload length
+
+
+def init_state(num_tracks: int) -> REDState:
+    shape = (num_tracks, RED_DISTANCE)
+    return REDState(
+        hist_sn=jnp.full(shape, -1, jnp.int32),
+        hist_ts=jnp.zeros(shape, jnp.int32),
+        hist_len=jnp.zeros(shape, jnp.int32),
+    )
+
+
+def encode_plan_tick(
+    state: REDState,
+    sn: jax.Array,      # [T, K] int32
+    ts: jax.Array,      # [T, K] int32
+    length: jax.Array,  # [T, K] int32 — payload bytes
+    valid: jax.Array,   # [T, K] bool
+):
+    """Per-packet RED plan for one tick.
+
+    Returns (state, red_sn [T,K,D], red_offset [T,K,D], red_len [T,K,D],
+    red_ok [T,K,D]): for packet (t,k), the D candidate redundancy blocks
+    (most recent first), their TS offsets, lengths, and whether each fits
+    RFC 2198 field limits. The host/C++ egress assembles bytes for
+    subscribers that negotiated RED and strips for those that didn't
+    (RedPrimaryReceiver path is the identity here — primaries are staged
+    unmodified).
+    """
+    T, K = sn.shape
+    D = RED_DISTANCE
+
+    def per_track(hist, xs):
+        h_sn, h_ts, h_len = hist
+
+        def step(carry, x):
+            c_sn, c_ts, c_len = carry
+            p_sn, p_ts, p_len, p_valid = x
+            # Candidates: current history, most recent first.
+            off = p_ts - c_ts
+            ok = (
+                (c_sn >= 0)
+                & p_valid
+                & (off > 0)
+                & (off <= MAX_TS_OFFSET)
+                & (c_len <= MAX_BLOCK_LEN)
+                # redundancy must be the immediately preceding SNs
+                & ((p_sn - c_sn) & 0xFFFF <= D)
+            )
+            out = (c_sn, off, c_len, ok)
+            # Shift history: new packet enters slot 0.
+            n_sn = jnp.where(p_valid, jnp.concatenate([p_sn[None], c_sn[:-1]]), c_sn)
+            n_ts = jnp.where(p_valid, jnp.concatenate([p_ts[None], c_ts[:-1]]), c_ts)
+            n_len = jnp.where(p_valid, jnp.concatenate([p_len[None], c_len[:-1]]), c_len)
+            return (n_sn, n_ts, n_len), out
+
+        (h_sn, h_ts, h_len), outs = jax.lax.scan(step, (h_sn, h_ts, h_len), xs)
+        return (h_sn, h_ts, h_len), outs
+
+    def run_one(h_sn, h_ts, h_len, t_sn, t_ts, t_len, t_valid):
+        (n_sn, n_ts, n_len), (r_sn, r_off, r_len, r_ok) = per_track(
+            (h_sn, h_ts, h_len), (t_sn, t_ts, t_len, t_valid)
+        )
+        return n_sn, n_ts, n_len, r_sn, r_off, r_len, r_ok
+
+    n_sn, n_ts, n_len, r_sn, r_off, r_len, r_ok = jax.vmap(run_one)(
+        state.hist_sn, state.hist_ts, state.hist_len, sn, ts, length, valid
+    )
+    new_state = REDState(hist_sn=n_sn, hist_ts=n_ts, hist_len=n_len)
+    return new_state, r_sn, r_off, r_len, r_ok

@@ -1,0 +1,103 @@
+"""Batched retransmission (NACK/RTX) metadata ring.
+
+Reference parity: pkg/sfu/sequencer.go:82-370 — per-DownTrack ring mapping
+munged SN → (original packet reference, layer, codec state) for NACK
+replay (`getExtPacketMetas` :263), with RTT gating so a packet isn't
+re-sent twice within one round trip.
+
+TPU-first re-design: one ring per subscriber, all subscribers updated in a
+single scatter per tick. The ring stores the *slab key* of the original
+payload ((track<<16 | pkt_slot) of the tick it was sent in is not stable
+across ticks, so the host passes a monotonically increasing slab id) —
+lookup returns that key for the host/C++ egress to replay bytes from its
+payload history.
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+
+RING_BITS = 9               # 512 entries ≈ reference's default window
+RING = 1 << RING_BITS
+
+
+class SequencerState(NamedTuple):
+    """Per-subscriber rings, fields [..., S, RING]."""
+
+    slab_key: jax.Array      # int32 — host payload-history key (-1 empty)
+    sent_sn: jax.Array       # int32 — munged SN stored at this slot
+    sent_at_ms: jax.Array    # int32 — send time (for RTT gating)
+    last_nack_ms: jax.Array  # int32 — last replay time
+
+
+def init_state(num_subscribers: int) -> SequencerState:
+    shape = (num_subscribers, RING)
+    return SequencerState(
+        slab_key=jnp.full(shape, -1, jnp.int32),
+        sent_sn=jnp.full(shape, -1, jnp.int32),
+        sent_at_ms=jnp.zeros(shape, jnp.int32),
+        last_nack_ms=jnp.full(shape, -(1 << 30), jnp.int32),
+    )
+
+
+def push_tick(
+    state: SequencerState,
+    out_sn: jax.Array,     # [P, S] int32 — munged SNs sent this tick
+    sent: jax.Array,       # [P, S] bool — send mask
+    slab_key: jax.Array,   # [P] int32 — host payload-history keys
+    now_ms: jax.Array,     # scalar int32
+) -> SequencerState:
+    """Record this tick's sends into each subscriber's ring (sequencer.push)."""
+    P, S = out_sn.shape
+    slot = out_sn & (RING - 1)                        # [P, S]
+    sub = jnp.broadcast_to(jnp.arange(S, dtype=jnp.int32)[None, :], (P, S))
+    keys = jnp.broadcast_to(slab_key[:, None], (P, S))
+
+    # Masked scatter: unsent entries write to a scratch slot we discard.
+    flat_idx = jnp.where(sent, sub * RING + slot, S * RING)  # [P,S]
+
+    def scatter(buf, vals):
+        padded = jnp.concatenate([buf.reshape(-1), jnp.zeros((1,), buf.dtype)])
+        padded = padded.at[flat_idx.reshape(-1)].set(vals.reshape(-1))
+        return padded[:-1].reshape(buf.shape)
+
+    return SequencerState(
+        slab_key=scatter(state.slab_key, keys),
+        sent_sn=scatter(state.sent_sn, jnp.where(sent, out_sn, -1)),
+        sent_at_ms=scatter(state.sent_at_ms, jnp.full((P, S), now_ms, jnp.int32)),
+        last_nack_ms=state.last_nack_ms,
+    )
+
+
+def lookup_nacks(
+    state: SequencerState,
+    nacked_sn: jax.Array,   # [S, M] int32 — munged SNs the subs NACKed (-1 pad)
+    now_ms: jax.Array,      # scalar int32
+    rtt_ms: jax.Array,      # [S] int32 — per-sub RTT (replay throttle)
+):
+    """Resolve NACKs → slab keys (getExtPacketMetas + RTT gate).
+
+    Returns (state, slab_key [S, M], ok [S, M]); `ok` is False for unknown/
+    evicted SNs and for SNs replayed within the last RTT.
+    """
+    S, M = nacked_sn.shape
+    slot = nacked_sn & (RING - 1)
+    sub = jnp.arange(S, dtype=jnp.int32)[:, None]
+    hit = (jnp.take_along_axis(state.sent_sn, slot, axis=-1) == nacked_sn) & (
+        nacked_sn >= 0
+    )
+    key = jnp.take_along_axis(state.slab_key, slot, axis=-1)
+    last = jnp.take_along_axis(state.last_nack_ms, slot, axis=-1)
+    throttled = (now_ms - last) < jnp.maximum(rtt_ms[:, None], 1)
+    ok = hit & ~throttled & (key >= 0)
+
+    # Stamp replay time on the slots we are re-sending.
+    flat = jnp.where(ok, sub * RING + slot, S * RING)
+    padded = jnp.concatenate([state.last_nack_ms.reshape(-1), jnp.zeros((1,), jnp.int32)])
+    padded = padded.at[flat.reshape(-1)].set(jnp.full((S * M,), now_ms, jnp.int32))
+    new_last = padded[:-1].reshape(state.last_nack_ms.shape)
+
+    return state._replace(last_nack_ms=new_last), jnp.where(ok, key, -1), ok

@@ -1,0 +1,104 @@
+"""Batched per-layer stream liveness + bitrate tracking.
+
+Reference parity: pkg/sfu/streamtracker (streamtracker.go:57-300 packet-
+count cycles, streamtracker_packet.go) and StreamTrackerManager's available-
+layer + Bitrates reporting (streamtrackermanager.go:60-732). The reference
+runs one tracker goroutine per (track, layer) with sample windows; here one
+row per (track, layer) stream updates every tick with pure elementwise ops.
+
+Semantics kept:
+  - a layer goes LIVE after >= `min_pkts` packets within a cycle window
+  - a layer goes STOPPED after `stop_ms` without any packet
+  - per-layer bitrate is an EMA over per-tick byte counts, reported as bps
+    (feeds the allocator's [4][4] Bitrates matrix — receiver.go:49)
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+
+STOPPED = 0
+LIVE = 1
+
+
+class TrackerParams(NamedTuple):
+    """config StreamTrackersConfig (config.go) equivalents."""
+
+    cycle_ms: int = 500        # samplesRequired window (streamtracker.go)
+    min_pkts: int = 5          # packets per cycle to declare live
+    stop_ms: int = 1000        # silence to declare stopped
+    bitrate_alpha: float = 0.3  # per-cycle EMA weight
+
+
+class TrackerState(NamedTuple):
+    """Per-stream rows [..., N] (N = tracks × layers)."""
+
+    status: jax.Array        # int32 — STOPPED / LIVE
+    cycle_pkts: jax.Array    # int32 — packets in current cycle
+    cycle_ms: jax.Array      # int32 — elapsed ms in cycle
+    silent_ms: jax.Array     # int32 — ms since last packet
+    cycle_bytes: jax.Array   # float32 — bytes in current cycle
+    bitrate_bps: jax.Array   # float32 — smoothed bitrate
+
+
+def init_state(num_streams: int) -> TrackerState:
+    z = lambda dt: jnp.zeros((num_streams,), dt)
+    return TrackerState(
+        status=z(jnp.int32),
+        cycle_pkts=z(jnp.int32),
+        cycle_ms=z(jnp.int32),
+        silent_ms=z(jnp.int32),
+        cycle_bytes=z(jnp.float32),
+        bitrate_bps=z(jnp.float32),
+    )
+
+
+def update_tick(
+    state: TrackerState,
+    params: TrackerParams,
+    pkts: jax.Array,      # [..., N] int32 — packets observed this tick
+    byts: jax.Array,      # [..., N] int32 — bytes observed this tick
+    tick_ms: jax.Array,   # scalar int32
+):
+    """Returns (state, status [N], changed [N] bool, bitrate_bps [N])."""
+    tick_ms = jnp.asarray(tick_ms, jnp.int32)
+    got = pkts > 0
+    silent_ms = jnp.where(got, 0, state.silent_ms + tick_ms)
+    cycle_pkts = state.cycle_pkts + pkts
+    cycle_bytes = state.cycle_bytes + byts.astype(jnp.float32)
+    cycle_ms = state.cycle_ms + tick_ms
+
+    cycle_done = cycle_ms >= params.cycle_ms
+    went_live = cycle_done & (cycle_pkts >= params.min_pkts)
+    went_dead = silent_ms >= params.stop_ms
+
+    status = state.status
+    status = jnp.where(went_live, LIVE, status)
+    status = jnp.where(went_dead, STOPPED, status)
+    changed = status != state.status
+
+    # Bitrate: commit the cycle's byte count into the EMA at cycle end.
+    cycle_s = jnp.maximum(cycle_ms.astype(jnp.float32), 1.0) / 1000.0
+    inst_bps = cycle_bytes * 8.0 / cycle_s
+    a = jnp.float32(params.bitrate_alpha)
+    bitrate = jnp.where(
+        cycle_done,
+        jnp.where(
+            state.bitrate_bps > 0, state.bitrate_bps * (1 - a) + inst_bps * a, inst_bps
+        ),
+        state.bitrate_bps,
+    )
+    bitrate = jnp.where(status == STOPPED, 0.0, bitrate)
+
+    new_state = TrackerState(
+        status=status,
+        cycle_pkts=jnp.where(cycle_done, 0, cycle_pkts),
+        cycle_ms=jnp.where(cycle_done, 0, cycle_ms),
+        silent_ms=silent_ms,
+        cycle_bytes=jnp.where(cycle_done, 0.0, cycle_bytes),
+        bitrate_bps=bitrate,
+    )
+    return new_state, status, changed, bitrate

@@ -1,0 +1,184 @@
+"""Batched SVC layer selection: VP9 onion layering + dependency descriptor.
+
+Reference parity:
+  - pkg/sfu/videolayerselector/vp9.go:43 — VP9 SVC: one stream carries all
+    spatial layers; a subscriber at spatial s needs every spatial layer
+    <= s of each picture; spatial upswitch gated on a non-inter-predicted
+    frame of the new layer, temporal upswitch on switching-up points.
+  - pkg/sfu/videolayerselector/dependencydescriptor.go:65-430 — AV1 (and
+    any-codec) dependency descriptor: packets carry per-decode-target
+    indications (DTIs); the selector pins an active decode target and
+    forwards packets whose DTI != not-present, switching at packets whose
+    template marks a switch indication.
+
+TPU-first re-design: the host RTP parser (or the C++ shim) reduces each
+packet's DD/VP9 header to small ints — spatial sid, temporal tid, flags,
+and for DD a 32-bit `dti_mask` (bit d = packet required for decode target
+d) and `switch_mask` (bit d = safe switch point for d). Selection is then
+pure mask algebra over [P] packets × [S] subscribers, scanned over the
+packet axis like ops.selector.
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+
+INVALID = jnp.int32(-1)
+
+
+class SVCSelectorState(NamedTuple):
+    """Per-subscriber SVC selection state, fields [..., S] int32."""
+
+    current_spatial: jax.Array   # top spatial layer being forwarded
+    current_temporal: jax.Array
+    target_spatial: jax.Array
+    target_temporal: jax.Array
+
+
+def init_state(num_subscribers: int, target_spatial: int = 2, target_temporal: int = 3) -> SVCSelectorState:
+    s = jnp.full((num_subscribers,), INVALID, jnp.int32)
+    return SVCSelectorState(
+        current_spatial=s,
+        current_temporal=s,
+        target_spatial=jnp.full((num_subscribers,), target_spatial, jnp.int32),
+        target_temporal=jnp.full((num_subscribers,), target_temporal, jnp.int32),
+    )
+
+
+def select_tick(
+    state: SVCSelectorState,
+    pkt_spatial: jax.Array,      # [P] int32 — sid of this packet
+    pkt_temporal: jax.Array,     # [P] int32 — tid
+    pkt_keyframe: jax.Array,     # [P] bool — non-inter-predicted picture
+    pkt_switch_up: jax.Array,    # [P] bool — temporal switching-up point
+    pkt_end_of_frame: jax.Array, # [P] bool — last packet of the frame
+    pkt_valid: jax.Array,        # [P] bool
+):
+    """VP9-style onion SVC selection for one track.
+
+    Unlike simulcast (ops.selector), a subscriber needs ALL spatial layers
+    <= current_spatial, so `forward = sid <= cur_sp & tid <= cur_tp`.
+    Downswitch applies at end-of-frame (vp9.go: wait for frame completion);
+    upswitch at a keyframe carrying the target layer.
+    """
+
+    def step(carry: SVCSelectorState, xs):
+        sid, tid, kf, sw_up, eof, valid = xs
+
+        want_up = (carry.target_spatial > carry.current_spatial)
+        up = valid & kf & want_up & (sid <= carry.target_spatial)
+        cur_sp = jnp.where(up, carry.target_spatial, carry.current_spatial)
+
+        # Downswitch once the current frame finishes (no mid-frame cuts).
+        want_down = (carry.target_spatial >= 0) & (carry.target_spatial < cur_sp)
+        down = valid & eof & want_down
+        cur_sp_next = jnp.where(down, carry.target_spatial, cur_sp)
+
+        on_stream = valid & (cur_sp >= 0)
+        # Temporal: upgrade at switching-up points, downgrade immediately.
+        cur_tp = carry.current_temporal
+        cur_tp = jnp.where(up, carry.target_temporal, cur_tp)
+        can_up = on_stream & sw_up & (tid <= carry.target_temporal) & (tid > cur_tp)
+        cur_tp = jnp.where(can_up, tid, cur_tp)
+        cur_tp = jnp.where(
+            on_stream & (carry.target_temporal < cur_tp), carry.target_temporal, cur_tp
+        )
+
+        fwd = on_stream & (sid <= cur_sp) & (tid <= cur_tp)
+        paused = carry.target_spatial < 0
+        fwd = fwd & ~paused
+        drp = on_stream & ~fwd
+
+        new_carry = SVCSelectorState(
+            current_spatial=jnp.where(paused, INVALID, cur_sp_next),
+            current_temporal=cur_tp,
+            target_spatial=carry.target_spatial,
+            target_temporal=carry.target_temporal,
+        )
+        return new_carry, (fwd, drp, up)
+
+    xs = (pkt_spatial, pkt_temporal, pkt_keyframe, pkt_switch_up,
+          pkt_end_of_frame, pkt_valid)
+    new_state, (fwd, drp, up) = jax.lax.scan(step, state, xs)
+    need_keyframe = (new_state.target_spatial >= 0) & (
+        new_state.target_spatial > new_state.current_spatial
+    )
+    return new_state, fwd, drp, up, need_keyframe
+
+
+class DDSelectorState(NamedTuple):
+    """Dependency-descriptor selection state, fields [..., S] int32."""
+
+    active_dt: jax.Array      # current decode target index (-1 = none)
+    target_dt: jax.Array      # allocator-desired decode target
+    last_frame: jax.Array     # last forwarded frame number (chain check)
+
+
+def init_dd_state(num_subscribers: int, target_dt: int = 0) -> DDSelectorState:
+    s = jnp.full((num_subscribers,), INVALID, jnp.int32)
+    return DDSelectorState(
+        active_dt=s,
+        target_dt=jnp.full((num_subscribers,), target_dt, jnp.int32),
+        last_frame=s,
+    )
+
+
+def dd_select_tick(
+    state: DDSelectorState,
+    pkt_dti_mask: jax.Array,    # [P] int32 — bit d: packet present for dt d
+    pkt_switch_mask: jax.Array, # [P] int32 — bit d: switch indication for d
+    pkt_frame: jax.Array,       # [P] int32 — frame number (monotonic)
+    pkt_keyframe: jax.Array,    # [P] bool — chain reset point
+    pkt_valid: jax.Array,       # [P] bool
+):
+    """Decode-target selection (dependencydescriptor.go Select).
+
+    Returns (state, forward [P,S], drop [P,S], broken [S]). `broken` means
+    a frame the active decode target depends on was never forwarded (a
+    frame-number gap on the chain) — the host responds with a PLI, standing
+    in for the reference's chain-tracking frame diffs.
+    """
+
+    def bit(mask, d):
+        return ((mask >> jnp.maximum(d, 0)) & 1).astype(jnp.bool_) & (d >= 0)
+
+    def step(carry: DDSelectorState, xs):
+        dti, sw_mask, frame, kf, valid = xs
+
+        # Switch to the target at a switch-indication packet (or keyframe).
+        want = (carry.target_dt != carry.active_dt) & (carry.target_dt >= 0)
+        can_switch = valid & want & (bit(sw_mask, carry.target_dt) | kf)
+        active = jnp.where(can_switch, carry.target_dt, carry.active_dt)
+
+        fwd = valid & bit(dti, active)
+        paused = carry.target_dt < 0
+        fwd = fwd & ~paused
+        drp = valid & ~fwd & (active >= 0)
+
+        # Chain integrity: forwarded frames must be contiguous-or-forward;
+        # a gap of > 1 frame since the last forwarded frame breaks decode.
+        gap = fwd & (carry.last_frame >= 0) & (frame - carry.last_frame > 1) & ~kf
+        last = jnp.where(fwd, frame, carry.last_frame)
+        last = jnp.where(kf & valid, frame, last)
+
+        new_carry = DDSelectorState(
+            active_dt=jnp.where(paused, INVALID, active),
+            target_dt=carry.target_dt,
+            last_frame=last,
+        )
+        return new_carry, (fwd, drp, gap)
+
+    xs = (pkt_dti_mask, pkt_switch_mask, pkt_frame, pkt_keyframe, pkt_valid)
+    new_state, (fwd, drp, gap) = jax.lax.scan(step, state, xs)
+    broken = jnp.any(gap, axis=0)
+    return new_state, fwd, drp, broken
+
+
+def set_target(state, target):
+    """Apply allocator decision (decode target / spatial-temporal pair)."""
+    if isinstance(state, DDSelectorState):
+        return state._replace(target_dt=jnp.asarray(target, jnp.int32))
+    raise TypeError("use svc.SVCSelectorState._replace for spatial/temporal targets")

@@ -30,6 +30,7 @@ class PublishedTrack:
     info: pm.TrackInfo
     track_col: int
     cid: str = ""              # client's local id until published
+    ssrc: int = 0              # UDP-transport media binding (0 = WS media)
 
     @property
     def is_video(self) -> bool:
@@ -97,6 +98,7 @@ class Participant:
         if old.can_publish and not perm.can_publish:
             for sid in list(self.published):
                 self.unpublish_track(sid)
+            self.pending_tracks.clear()  # announced-but-unbound tracks too
         self.version += 1
         return True
 
@@ -136,15 +138,20 @@ class Participant:
         cid = req.get("cid", "")
         if not cid or cid in self.pending_tracks:
             return None
+        try:
+            track_type = pm.TrackType(int(req.get("type", 0)))
+            source = pm.TrackSource(int(req.get("source", 0)))
+        except (ValueError, TypeError):
+            return None  # malformed enum from client: reject, don't crash
         info = pm.TrackInfo(
             sid=ids.new_track_id(),
-            type=pm.TrackType(req.get("type", 0)),
+            type=track_type,
             name=req.get("name", ""),
             muted=req.get("muted", False),
             width=req.get("width", 0),
             height=req.get("height", 0),
             simulcast=len(req.get("layers", [])) > 1,
-            source=pm.TrackSource(req.get("source", 0)),
+            source=source,
             layers=[
                 pm.SimulcastLayer(
                     quality=pm.VideoQuality(l.get("quality", 0)),
@@ -164,6 +171,10 @@ class Participant:
     def publish_pending(self, cid: str) -> PublishedTrack | None:
         """Media arrived for a pending track (the reference's onMediaTrack
         → mediaTrackReceived): allocate the tensor column, flip the mask."""
+        if not self.permission.can_publish:
+            # Permission may have been revoked between announce and media.
+            self.pending_tracks.pop(cid, None)
+            return None
         info = self.pending_tracks.pop(cid, None)
         if info is None:
             return None

@@ -43,6 +43,7 @@ class Room:
         self.created_at = time.time()
         self.last_left_at = 0.0
         self.closed = False
+        self.udp = None  # UDPMediaTransport when the node serves UDP media
         # Incremental indexes for the per-tick hot path (no per-packet
         # dict rebuilds): sub col → participant, track col → track sid.
         self.sub_index: dict[int, Participant] = {}
@@ -101,6 +102,8 @@ class Room:
                 )
             self.slots.release_sub(p.sid)
             self.sub_index.pop(p.sub_col, None)
+            if self.udp is not None:
+                self.udp.release_subscriber(self.slots.row, p.sub_col)
         del self.participants[p.identity]
         self.by_sid.pop(p.sid, None)
         self.info.num_participants = len(self.participants)
@@ -127,6 +130,8 @@ class Room:
             is_video=info.type == pm.TrackType.VIDEO,
             pub_muted=info.muted,
         )
+        if self.udp is not None:
+            self.udp.set_track_kind(self.slots.row, col, info.type == pm.TrackType.VIDEO)
         # Count distinct publishers from the track registry (the caller's
         # published dict is updated only after this returns).
         self.info.num_publishers = len({pub.sid for pub, _t in self.tracks.values()})
@@ -148,6 +153,10 @@ class Room:
         self.runtime.set_track(
             self.slots.row, track.track_col, published=False, is_video=track.is_video
         )
+        if self.udp is not None:
+            if track.ssrc:
+                self.udp.release_ssrc(track.ssrc)
+            self.udp.track_kind.pop((self.slots.row, track.track_col), None)
         self.slots.release_track(sid)
         for p in self.participants.values():
             p.subscribed_tracks.discard(sid)
@@ -313,14 +322,17 @@ class Room:
         return not self.participants
 
     def should_close(self, now: float | None = None) -> bool:
-        """Idle-room reaping (server.go backgroundWorker + CloseIdleRooms)."""
+        """Idle-room reaping (server.go backgroundWorker + CloseIdleRooms):
+        empty_timeout applies to rooms nobody ever joined; once the last
+        participant departs, the (much shorter) departure_timeout governs."""
         now = now or time.time()
         if self.closed:
             return True
         if not self.is_empty:
             return False
-        ref = max(self.last_left_at, self.created_at)
-        return now - ref > self.info.empty_timeout
+        if self.last_left_at:
+            return now - self.last_left_at > self.info.departure_timeout
+        return now - self.created_at > self.info.empty_timeout
 
     def on_close(self, cb: Callable[[], None]) -> None:
         self._on_close.append(cb)
@@ -331,6 +343,8 @@ class Room:
         self.closed = True
         for p in list(self.participants.values()):
             self.remove_participant(p, reason)
+        if self.udp is not None:
+            self.udp.release_room(self.slots.row)
         self.runtime.clear_room(self.slots.row)
         self.runtime.slots.release_room(self.name)
         for cb in self._on_close:

@@ -30,12 +30,29 @@ def handle_participant_signal(room, participant: Participant, req: SignalRequest
     elif kind == "trickle":
         pass  # ICE candidates are not used by the slot-addressed transport
     elif kind == "add_track":
-        participant.add_track_request(data)
+        info = participant.add_track_request(data)
+        # UDP media: bind the tensor slot now and hand the client an SSRC
+        # (the WS-media path instead binds on first BINARY frame).
+        udp = getattr(room, "udp", None)
+        if info is not None and data.get("transport") == "udp" and udp is not None:
+            track = participant.publish_pending(data.get("cid", ""))
+            if track is not None:
+                track.ssrc = udp.assign_ssrc(
+                    room.slots.row, track.track_col, track.is_video
+                )
+                participant.send(
+                    "request_response",
+                    {"udp_media": {"track_sid": track.info.sid, "ssrc": track.ssrc}},
+                )
     elif kind == "mute":
         sid = data.get("sid", "")
         participant.set_track_muted(sid, bool(data.get("muted", False)))
         participant.send("mute", {"sid": sid, "muted": bool(data.get("muted", False))})
     elif kind == "subscription":
+        udp = getattr(room, "udp", None)
+        if udp is not None and data.get("udp_addr") and participant.sub_col >= 0:
+            host, port_ = data["udp_addr"]
+            udp.register_subscriber(room.slots.row, participant.sub_col, (host, int(port_)))
         for sid in data.get("track_sids", []):
             if data.get("subscribe", True):
                 room.subscribe(participant, sid)

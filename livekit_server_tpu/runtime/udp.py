@@ -1,0 +1,176 @@
+"""UDP media transport: plain RTP in, rewritten RTP out.
+
+Reference parity: the reference's media path is Pion WebRTC over
+ICE/DTLS/SRTP on the UDP mux (pkg/rtc/config.go UDPMux, rtcconfig). This
+build's native path is deliberately simpler wire-wise — plain RTP over
+UDP with SSRC-based session binding (the `add_track` signal response
+carries the SSRC the server assigned; E2EE payloads pass through
+untouched, matching the reference's encryption passthrough stance) — but
+occupies the same architectural seat: socket → native batch parse
+(livekit_server_tpu.native.rtp) → IngestBuffer, and egress →
+native header rewrite → socket.
+
+A client's source address latches on first packet per SSRC (ICE-lite-ish
+latching, like the reference's UDP mux address learning).
+"""
+
+from __future__ import annotations
+
+import asyncio
+from dataclasses import dataclass
+
+import numpy as np
+
+from livekit_server_tpu.native import rtp
+from livekit_server_tpu.runtime.ingest import IngestBuffer, PacketIn
+
+VP8_PT = 96
+OPUS_PT = 111
+AUDIO_LEVEL_EXT_ID = 1
+
+
+@dataclass
+class SSRCBinding:
+    room: int            # room row
+    track: int           # track col
+    is_video: bool
+    sub_keys: list       # (room, participant) for reverse lookup / teardown
+
+
+class UDPMediaTransport(asyncio.DatagramProtocol):
+    """One socket for the whole node (the reference's single-port UDPMux)."""
+
+    def __init__(self, ingest: IngestBuffer):
+        self.ingest = ingest
+        self.transport: asyncio.DatagramTransport | None = None
+        self.bindings: dict[int, SSRCBinding] = {}       # ssrc → coords
+        self.addrs: dict[int, tuple] = {}                # ssrc → latched addr
+        self.sub_addrs: dict[tuple, tuple] = {}          # (room,sub) → addr
+        self.sub_ssrc: dict[tuple, dict[int, int]] = {}  # (room,sub) → {track: ssrc}
+        self.track_kind: dict[tuple, bool] = {}          # (room,track) → is_video
+        self.stats = {"rx": 0, "tx": 0, "unknown_ssrc": 0, "parse_errors": 0}
+        self._next_ssrc = 0x10000
+
+    # -- control-plane API ------------------------------------------------
+    def assign_ssrc(self, room: int, track: int, is_video: bool) -> int:
+        """Bind a fresh SSRC to a published track (sent back in signal)."""
+        self._next_ssrc += 1
+        ssrc = self._next_ssrc
+        self.bindings[ssrc] = SSRCBinding(room, track, is_video, [])
+        self.track_kind[(room, track)] = is_video
+        return ssrc
+
+    def release_ssrc(self, ssrc: int) -> None:
+        self.bindings.pop(ssrc, None)
+        self.addrs.pop(ssrc, None)
+
+    def set_track_kind(self, room: int, track: int, is_video: bool) -> None:
+        """Record media kind for egress PT selection (any transport)."""
+        self.track_kind[(room, track)] = is_video
+
+    def register_subscriber(self, room: int, sub: int, addr: tuple) -> None:
+        """Tell egress where a subscriber receives media (from signal or
+        latched from its own publishing socket)."""
+        self.sub_addrs[(room, sub)] = addr
+
+    def release_subscriber(self, room: int, sub: int) -> None:
+        """Subscriber left: stop egress and free its SSRC map (prevents
+        media leaking to a stale address once the sub col is reused)."""
+        self.sub_addrs.pop((room, sub), None)
+        self.sub_ssrc.pop((room, sub), None)
+
+    def release_room(self, room: int) -> None:
+        """Room closed: drop every binding on its row."""
+        for ssrc in [s for s, b in self.bindings.items() if b.room == room]:
+            self.release_ssrc(ssrc)
+        for key in [k for k in self.sub_addrs if k[0] == room]:
+            del self.sub_addrs[key]
+        for key in [k for k in self.sub_ssrc if k[0] == room]:
+            del self.sub_ssrc[key]
+        for key in [k for k in self.track_kind if k[0] == room]:
+            del self.track_kind[key]
+
+    def subscriber_ssrc(self, room: int, sub: int, track: int) -> int:
+        """Per-(subscriber, track) egress SSRC (DownTrack's own SSRC)."""
+        m = self.sub_ssrc.setdefault((room, sub), {})
+        if track not in m:
+            self._next_ssrc += 1
+            m[track] = self._next_ssrc
+        return m[track]
+
+    # -- datagram path ----------------------------------------------------
+    def connection_made(self, transport) -> None:
+        self.transport = transport
+
+    def datagram_received(self, data: bytes, addr) -> None:
+        self.stats["rx"] += 1
+        parsed = rtp.parse_batch(
+            data, np.asarray([0], np.int32), np.asarray([len(data)], np.int32),
+            audio_level_ext=AUDIO_LEVEL_EXT_ID, vp8_pts={VP8_PT},
+        )[0]
+        if int(parsed["payload_len"]) < 0:
+            self.stats["parse_errors"] += 1
+            return
+        ssrc = int(parsed["ssrc"])
+        binding = self.bindings.get(ssrc)
+        if binding is None:
+            self.stats["unknown_ssrc"] += 1
+            return
+        self.addrs[ssrc] = addr
+        off, ln = int(parsed["payload_off"]), int(parsed["payload_len"])
+        self.ingest.push(
+            PacketIn(
+                room=binding.room,
+                track=binding.track,
+                sn=int(parsed["sn"]),
+                ts=int(parsed["ts"]),
+                size=ln,
+                payload=data[off : off + ln],
+                layer=0,  # simulcast layers arrive as distinct SSRCs; host maps
+                temporal=int(parsed["tid"]),
+                keyframe=bool(parsed["keyframe"]),
+                layer_sync=bool(parsed["layer_sync"]) or bool(parsed["keyframe"]),
+                begin_pic=bool(parsed["begin_pic"]),
+                pid=max(int(parsed["picture_id"]), 0),
+                tl0=max(int(parsed["tl0picidx"]), 0),
+                keyidx=max(int(parsed["keyidx"]), 0),
+                frame_ms=20 if not binding.is_video else 0,
+                audio_level=int(parsed["audio_level"]),
+                arrival_rtp=int(parsed["ts"]),
+            )
+        )
+
+    def send_egress(self, packets) -> None:
+        """Rewrite + send a tick's EgressPackets (DownTrack.WriteRTP's
+        header-rewrite half, batched through the native library)."""
+        if self.transport is None:
+            return
+        for pkt in packets:
+            addr = self.sub_addrs.get((pkt.room, pkt.sub))
+            if addr is None or not pkt.payload:
+                continue
+            ssrc = self.subscriber_ssrc(pkt.room, pkt.sub, pkt.track)
+            # 12-byte header + payload; PT from the track's actual kind.
+            header = bytearray(12)
+            header[0] = 0x80
+            is_video = self.track_kind.get((pkt.room, pkt.track), False)
+            header[1] = VP8_PT if is_video else OPUS_PT
+            buf = bytearray(bytes(header) + pkt.payload)
+            rtp.rewrite_batch(
+                buf, np.asarray([0], np.int32),
+                np.asarray([pkt.sn], np.uint16),
+                np.asarray([pkt.ts], np.uint32),
+                np.asarray([ssrc], np.uint32),
+            )
+            self.transport.sendto(bytes(buf), addr)
+            self.stats["tx"] += 1
+
+
+async def start_udp_transport(
+    ingest: IngestBuffer, host: str = "0.0.0.0", port: int = 7882
+) -> UDPMediaTransport:
+    loop = asyncio.get_running_loop()
+    transport, protocol = await loop.create_datagram_endpoint(
+        lambda: UDPMediaTransport(ingest), local_addr=(host, port)
+    )
+    return protocol

@@ -60,6 +60,7 @@ class RoomManager:
         )
         self.rooms: dict[str, Room] = {}
         self._row_to_room: dict[int, Room] = {}
+        self.udp = None  # UDPMediaTransport, attached by the server at start
         self.runtime.on_tick(self._dispatch_tick)
         self._reaper_task: asyncio.Task | None = None
         router.on_new_session(self.start_session)
@@ -72,6 +73,7 @@ class RoomManager:
             return room
         stored = await self.store.load_room(name)
         room = Room(name, self.runtime, info=info or stored)
+        room.udp = self.udp
         if info is None and stored is None:
             room.info.empty_timeout = self.config.room.empty_timeout_s
             room.info.departure_timeout = self.config.room.departure_timeout_s
@@ -112,6 +114,10 @@ class RoomManager:
             # teardown becomes a no-op when its socket finally closes.
             existing.session_epoch += 1
             existing.response_sink = response_sink
+            # Fresh media queue: the old connection's pump may still hold a
+            # pending get() on the previous queue — re-attaching reroutes
+            # egress to this connection instead of splitting frames.
+            self._attach_media_queue(room, existing)
             existing.send("reconnect", {})
             await self._session_worker(room, existing, request_source)
             return
@@ -153,7 +159,11 @@ class RoomManager:
                     req = decode_signal_request(raw)
                 except ValueError:
                     continue  # unknown/garbage frame: skip (reference logs)
-                handle_participant_signal(room, participant, req)
+                try:
+                    handle_participant_signal(room, participant, req)
+                except Exception:  # noqa: BLE001 — a malformed payload must
+                    # not tear down the session (reference logs and skips)
+                    pass
         except ChannelClosed:
             pass
         finally:
@@ -204,7 +214,12 @@ class RoomManager:
 
     # -- tick fan-out -----------------------------------------------------
     def _dispatch_tick(self, res: TickResult) -> None:
+        udp_subs = self.udp.sub_addrs if self.udp is not None else {}
+        if self.udp is not None:
+            self.udp.send_egress(res.egress)
         for pkt in res.egress:
+            if (pkt.room, pkt.sub) in udp_subs:
+                continue  # delivered over UDP; don't double-send on WS
             room = self._row_to_room.get(pkt.room)
             if room is not None:
                 room.deliver_egress(pkt)

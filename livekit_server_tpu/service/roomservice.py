@@ -172,7 +172,9 @@ class RoomServiceAPI:
         room = self._room(body)
         if room is None:
             return _err(404, "room not found")
-        room.info.metadata = body.get("metadata", "")
+        if "metadata" not in body:
+            return _err(400, "metadata required")
+        room.info.metadata = body["metadata"]
         await self.server.store.store_room(room.info)
         for p in room.participants.values():
             p.send("room_update", {"room": room.info.to_dict()})

@@ -131,6 +131,20 @@ class LivekitServer:
         # first tick doesn't stall the event loop mid-session (XLA compiles
         # once per (shapes, params); later ticks hit the cache).
         await self.room_manager.runtime.step_once()
+        # Native UDP media transport on the RTC port (rtc/config.go UDPMux).
+        if self.config.rtc.udp_port:
+            from livekit_server_tpu.runtime.udp import start_udp_transport
+
+            try:
+                self.room_manager.udp = await start_udp_transport(
+                    self.room_manager.runtime.ingest,
+                    self.config.bind_addresses[0],
+                    self.config.rtc.udp_port,
+                )
+                for room in self.room_manager.rooms.values():
+                    room.udp = self.room_manager.udp
+            except OSError:
+                pass  # port busy: WS media path still works
         self.room_manager.start()
         self._stats_task = asyncio.ensure_future(self._refresh_nodes())
         self._runner = web.AppRunner(self.app)
@@ -152,6 +166,8 @@ class LivekitServer:
                 await asyncio.sleep(0.1)
         if self._stats_task:
             self._stats_task.cancel()
+        if self.room_manager.udp is not None and self.room_manager.udp.transport:
+            self.room_manager.udp.transport.close()
         await self.room_manager.stop()
         await self.router.unregister_node()
         if self._runner is not None:

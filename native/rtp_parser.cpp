@@ -1,0 +1,175 @@
+// Batch RTP parser — the native half of the ingest path.
+//
+// Reference parity: the parsing the reference does per packet in Go inside
+// buffer.Buffer.calc (pkg/sfu/buffer/buffer.go:417-491: header fields,
+// RFC 8285 one-byte header extensions incl. RFC 6464 audio level, VP8
+// payload descriptor via buffer/vp8.go). Here it is a C++ batch routine:
+// the UDP receiver hands a packed buffer of N datagrams and gets back
+// column arrays ready to memcpy into the IngestBuffer's numpy tensors —
+// one native call per receive batch instead of per-packet Go allocations.
+//
+// Build: g++ -O2 -shared -fPIC -o librtp_parser.so rtp_parser.cpp
+// ABI: plain C, loaded via ctypes (no pybind11 in this image).
+
+#include <cstdint>
+#include <cstring>
+
+extern "C" {
+
+// One parsed packet's fixed-width fields (keep in sync with native/__init__.py).
+struct ParsedPacket {
+  uint32_t ssrc;
+  uint16_t sn;
+  uint8_t pt;
+  uint8_t marker;
+  uint32_t ts;
+  int32_t payload_off;   // offset of payload within the datagram
+  int32_t payload_len;   // -1 on parse error
+  uint8_t audio_level;   // RFC 6464 dBov (127 if absent)
+  uint8_t voice;         // RFC 6464 V bit
+  // VP8 payload descriptor (valid when is_vp8 != 0):
+  uint8_t is_vp8;
+  uint8_t keyframe;      // P bit == 0 on first payload byte & begin_pic
+  uint8_t begin_pic;     // S bit & PID==0
+  uint8_t tid;           // temporal id
+  uint8_t layer_sync;    // Y bit
+  int32_t picture_id;    // -1 if absent
+  int32_t tl0picidx;     // -1 if absent
+  int32_t keyidx;        // -1 if absent
+};
+
+// Parse `n` datagrams packed back-to-back in `buf`; `offsets`/`lengths`
+// give each datagram's position. `audio_level_ext` is the negotiated
+// RFC 8285 id for the audio-level extension (0 = disabled); packets whose
+// PT is in `vp8_pts` (bitmask over 0..127) get VP8 descriptor parsing.
+// Returns the number of successfully parsed packets.
+int parse_rtp_batch(const uint8_t* buf, const int32_t* offsets,
+                    const int32_t* lengths, int n, int audio_level_ext,
+                    const uint8_t* vp8_pt_mask, ParsedPacket* out) {
+  int ok = 0;
+  for (int i = 0; i < n; i++) {
+    const uint8_t* p = buf + offsets[i];
+    int len = lengths[i];
+    ParsedPacket& o = out[i];
+    std::memset(&o, 0, sizeof(o));
+    o.audio_level = 127;
+    o.picture_id = -1;
+    o.tl0picidx = -1;
+    o.keyidx = -1;
+    o.payload_len = -1;
+    if (len < 12) continue;
+    uint8_t v = p[0] >> 6;
+    if (v != 2) continue;
+    int cc = p[0] & 0x0F;
+    bool has_ext = (p[0] >> 4) & 1;
+    bool has_pad = (p[0] >> 5) & 1;
+    o.marker = p[1] >> 7;
+    o.pt = p[1] & 0x7F;
+    o.sn = (uint16_t)((p[2] << 8) | p[3]);
+    o.ts = ((uint32_t)p[4] << 24) | ((uint32_t)p[5] << 16) |
+           ((uint32_t)p[6] << 8) | p[7];
+    o.ssrc = ((uint32_t)p[8] << 24) | ((uint32_t)p[9] << 16) |
+             ((uint32_t)p[10] << 8) | p[11];
+    int off = 12 + cc * 4;
+    if (off > len) continue;
+
+    if (has_ext) {
+      if (off + 4 > len) continue;
+      uint16_t profile = (uint16_t)((p[off] << 8) | p[off + 1]);
+      int ext_words = (p[off + 2] << 8) | p[off + 3];
+      int ext_len = ext_words * 4;
+      int ext_off = off + 4;
+      if (ext_off + ext_len > len) continue;
+      if (profile == 0xBEDE && audio_level_ext > 0) {
+        // RFC 8285 one-byte header extensions.
+        int q = ext_off;
+        int end = ext_off + ext_len;
+        while (q < end) {
+          uint8_t b = p[q];
+          if (b == 0) { q++; continue; }  // padding
+          int id = b >> 4;
+          int elen = (b & 0x0F) + 1;
+          if (id == 15) break;
+          if (q + 1 + elen > end) break;
+          if (id == audio_level_ext && elen >= 1) {
+            o.voice = p[q + 1] >> 7;
+            o.audio_level = p[q + 1] & 0x7F;
+          }
+          q += 1 + elen;
+        }
+      }
+      off = ext_off + ext_len;
+    }
+
+    int pad = 0;
+    if (has_pad && len > off) pad = p[len - 1];
+    int payload_len = len - off - pad;
+    if (payload_len < 0) continue;
+    o.payload_off = off;
+    o.payload_len = payload_len;
+
+    // VP8 payload descriptor (RFC 7741; buffer/vp8.go Unmarshal).
+    if (vp8_pt_mask[o.pt >> 3] & (1 << (o.pt & 7))) {
+      const uint8_t* d = p + off;
+      int dl = payload_len;
+      if (dl < 1) continue;
+      o.is_vp8 = 1;
+      int q = 0;
+      uint8_t b0 = d[q++];
+      bool X = b0 & 0x80;
+      bool S = (b0 >> 4) & 1;
+      uint8_t pid3 = b0 & 0x07;
+      o.begin_pic = (S && pid3 == 0) ? 1 : 0;
+      if (X) {
+        if (q >= dl) continue;
+        uint8_t xb = d[q++];
+        bool I = xb & 0x80, L = xb & 0x40, T = xb & 0x20, K = xb & 0x10;
+        if (I) {
+          if (q >= dl) continue;
+          uint8_t pb = d[q++];
+          if (pb & 0x80) {  // 15-bit picture id
+            if (q >= dl) continue;
+            o.picture_id = ((pb & 0x7F) << 8) | d[q++];
+          } else {
+            o.picture_id = pb & 0x7F;
+          }
+        }
+        if (L) {
+          if (q >= dl) continue;
+          o.tl0picidx = d[q++];
+        }
+        if (T || K) {
+          if (q >= dl) continue;
+          uint8_t tk = d[q++];
+          o.tid = tk >> 6;
+          o.layer_sync = (tk >> 5) & 1;
+          o.keyidx = tk & 0x1F;
+        }
+      }
+      // Keyframe: P bit of the first VP8 payload byte (after descriptor),
+      // only meaningful on the first packet of the picture.
+      if (o.begin_pic && q < dl) o.keyframe = (d[q] & 0x01) == 0 ? 1 : 0;
+    }
+    ok++;
+  }
+  return ok;
+}
+
+// Batch header rewrite for egress: patch SN/TS/SSRC in-place in the
+// outgoing datagram buffer (the write half of the reference's
+// DownTrack.WriteRTP header rewrite before pacing).
+void rewrite_rtp_batch(uint8_t* buf, const int32_t* offsets, int n,
+                       const uint16_t* sns, const uint32_t* tss,
+                       const uint32_t* ssrcs) {
+  for (int i = 0; i < n; i++) {
+    uint8_t* p = buf + offsets[i];
+    p[2] = sns[i] >> 8;
+    p[3] = sns[i] & 0xFF;
+    p[4] = tss[i] >> 24; p[5] = (tss[i] >> 16) & 0xFF;
+    p[6] = (tss[i] >> 8) & 0xFF; p[7] = tss[i] & 0xFF;
+    p[8] = ssrcs[i] >> 24; p[9] = (ssrcs[i] >> 16) & 0xFF;
+    p[10] = (ssrcs[i] >> 8) & 0xFF; p[11] = ssrcs[i] & 0xFF;
+  }
+}
+
+}  // extern "C"

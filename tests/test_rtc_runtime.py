@@ -184,6 +184,7 @@ async def test_duplicate_identity_kicks_old(runtime):
 async def test_leave_and_idle_close(runtime):
     room = Room("bye", runtime)
     room.info.empty_timeout = 0
+    room.info.departure_timeout = 0  # post-departure reaping governs here
     alice, _ = make_participant(room, "alice")
     room.join(alice)
     handle_participant_signal(room, alice, SignalRequest("leave", {}))

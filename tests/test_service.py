@@ -33,6 +33,7 @@ def make_config(port: int, **plane_overrides):
                 "bind_addresses": ["127.0.0.1"],
                 "plane": plane,
                 "room": {"empty_timeout_s": 2},
+                "rtc": {"udp_port": port + 1},  # avoid cross-test collisions
             }
         )
     )
@@ -290,3 +291,81 @@ async def test_metrics_and_debug():
                 assert "m" in dbg["rooms"]
                 assert dbg["rooms"]["m"]["participants"] == ["alice"]
             await alice.close()
+
+
+async def test_udp_media_through_full_server():
+    """Publisher announces a UDP track via signal, streams plain RTP to the
+    node's UDP port; subscriber registers its UDP addr and receives
+    rewritten RTP (the native-transport version of TestSinglePublisher)."""
+    import socket
+
+    from tests.test_native import rtp_packet
+
+    async with running_server() as server:
+        udp_port = server.config.rtc.udp_port
+        async with aiohttp.ClientSession() as s:
+            alice = SignalClient(s, server.port)
+            bob = SignalClient(s, server.port)
+            await alice.connect("udp-room", "alice")
+            await bob.connect("udp-room", "bob")
+
+            await alice.send_signal(
+                "add_track", {"cid": "mic", "type": 0, "name": "m", "transport": "udp"}
+            )
+            rr = await alice.wait_for("request_response")
+            ssrc = rr["udp_media"]["ssrc"]
+            track_sid = rr["udp_media"]["track_sid"]
+            await bob.wait_for("track_subscribed")
+
+            sub_sock = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
+            sub_sock.bind(("127.0.0.1", 0))
+            sub_sock.setblocking(False)
+            await bob.send_signal(
+                "subscription",
+                {"track_sids": [track_sid], "subscribe": True,
+                 "udp_addr": ["127.0.0.1", sub_sock.getsockname()[1]]},
+            )
+            await asyncio.sleep(0.05)
+
+            pub_sock = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
+            got = []
+            for i in range(8):
+                pub_sock.sendto(
+                    rtp_packet(sn=900 + i, ts=960 * i, ssrc=ssrc, audio_level=25,
+                               payload=b"udp-opus" + bytes([i])),
+                    ("127.0.0.1", udp_port),
+                )
+                await asyncio.sleep(0.03)
+                while True:
+                    try:
+                        data, _ = sub_sock.recvfrom(2048)
+                        got.append(data)
+                    except BlockingIOError:
+                        break
+            deadline = asyncio.get_event_loop().time() + 3
+            while len(got) < 8 and asyncio.get_event_loop().time() < deadline:
+                await asyncio.sleep(0.02)
+                while True:
+                    try:
+                        data, _ = sub_sock.recvfrom(2048)
+                        got.append(data)
+                    except BlockingIOError:
+                        break
+            assert len(got) == 8, f"got {len(got)} packets"
+            import numpy as np
+
+            from livekit_server_tpu.native import rtp as parser
+
+            sns = []
+            for data in got:
+                out = parser.parse_batch(
+                    data, np.asarray([0], np.int32), np.asarray([len(data)], np.int32)
+                )[0]
+                sns.append(int(out["sn"]))
+                off, ln = int(out["payload_off"]), int(out["payload_len"])
+                assert data[off : off + ln].startswith(b"udp-opus")
+            assert sns == list(range(900, 908))
+            pub_sock.close()
+            sub_sock.close()
+            await alice.close()
+            await bob.close()

@@ -1,0 +1,135 @@
+"""Stream tracker, sequencer/NACK, RED, and pacer op tests.
+
+Reference parity: streamtracker_packet_test.go shapes (live/stop cycles),
+sequencer.go NACK replay semantics, redreceiver encode limits,
+pacer/leaky_bucket drain behavior.
+"""
+
+import jax.numpy as jnp
+import numpy as np
+
+from livekit_server_tpu.ops import pacer, red, sequencer, streamtracker
+
+
+# ---- stream tracker ---------------------------------------------------
+
+def test_tracker_live_and_stop_cycle():
+    p = streamtracker.TrackerParams(cycle_ms=100, min_pkts=3, stop_ms=200)
+    st = streamtracker.init_state(2)
+    # stream 0 gets 2 pkts/tick, stream 1 silent.
+    for _ in range(2):
+        st, status, changed, bps = streamtracker.update_tick(
+            st, p, jnp.asarray([2, 0]), jnp.asarray([2400, 0]), 50
+        )
+    assert status.tolist() == [streamtracker.LIVE, streamtracker.STOPPED]
+    assert float(bps[0]) > 0
+    # silence stops it after stop_ms
+    for _ in range(4):
+        st, status, changed, bps = streamtracker.update_tick(
+            st, p, jnp.asarray([0, 0]), jnp.asarray([0, 0]), 50
+        )
+    assert status.tolist() == [streamtracker.STOPPED, streamtracker.STOPPED]
+    assert float(bps[0]) == 0.0
+
+
+def test_tracker_bitrate_tracks_input():
+    p = streamtracker.TrackerParams(cycle_ms=100, min_pkts=1, bitrate_alpha=1.0)
+    st = streamtracker.init_state(1)
+    st, _, _, bps = streamtracker.update_tick(st, p, jnp.asarray([10]), jnp.asarray([12500]), 100)
+    # 12500 B over 100 ms = 1 Mbps
+    assert abs(float(bps[0]) - 1_000_000) < 1e-3
+
+
+# ---- sequencer / NACK -------------------------------------------------
+
+def test_sequencer_push_and_nack_replay():
+    st = sequencer.init_state(2)
+    out_sn = jnp.asarray([[100, 200], [101, 201]], jnp.int32)  # [P=2, S=2]
+    sent = jnp.asarray([[True, True], [True, False]])
+    st = sequencer.push_tick(st, out_sn, sent, jnp.asarray([7, 8], jnp.int32), 1000)
+
+    nacks = jnp.asarray([[100, 101], [200, 201]], jnp.int32)
+    st, key, ok = sequencer.lookup_nacks(st, nacks, 1100, jnp.asarray([50, 50], jnp.int32))
+    assert ok.tolist() == [[True, True], [True, False]]  # 201 never sent to sub1
+    assert key.tolist() == [[7, 8], [7, -1]]
+
+
+def test_sequencer_rtt_throttle():
+    st = sequencer.init_state(1)
+    st = sequencer.push_tick(
+        st, jnp.asarray([[500]], jnp.int32), jnp.asarray([[True]]), jnp.asarray([3], jnp.int32), 0
+    )
+    nack = jnp.asarray([[500]], jnp.int32)
+    st, key, ok = sequencer.lookup_nacks(st, nack, 10, jnp.asarray([100], jnp.int32))
+    assert bool(ok[0, 0])
+    # immediate repeat within RTT → throttled
+    st, key, ok = sequencer.lookup_nacks(st, nack, 50, jnp.asarray([100], jnp.int32))
+    assert not bool(ok[0, 0])
+    # after RTT → replayable again
+    st, key, ok = sequencer.lookup_nacks(st, nack, 200, jnp.asarray([100], jnp.int32))
+    assert bool(ok[0, 0])
+
+
+def test_sequencer_unknown_sn_rejected():
+    st = sequencer.init_state(1)
+    st, key, ok = sequencer.lookup_nacks(
+        st, jnp.asarray([[12345]], jnp.int32), 0, jnp.asarray([0], jnp.int32)
+    )
+    assert not bool(ok[0, 0]) and int(key[0, 0]) == -1
+
+
+# ---- RED --------------------------------------------------------------
+
+def test_red_plan_attaches_previous_packets():
+    st = red.init_state(1)
+    sn = jnp.asarray([[10, 11, 12]], jnp.int32)
+    ts = jnp.asarray([[960, 1920, 2880]], jnp.int32)
+    ln = jnp.asarray([[100, 100, 100]], jnp.int32)
+    valid = jnp.ones((1, 3), bool)
+    st, r_sn, r_off, r_len, r_ok = red.encode_plan_tick(st, sn, ts, ln, valid)
+    # pkt 0 has no history; pkt 1 carries pkt 0; pkt 2 carries 1 and 0.
+    assert not bool(r_ok[0, 0].any())
+    assert bool(r_ok[0, 1, 0]) and int(r_sn[0, 1, 0]) == 10 and int(r_off[0, 1, 0]) == 960
+    assert r_ok[0, 2].tolist() == [True, True]
+    assert int(r_sn[0, 2, 1]) == 10 and int(r_off[0, 2, 1]) == 1920
+
+
+def test_red_offset_limit():
+    st = red.init_state(1)
+    # Huge TS gap: redundancy no longer expressible in 14 bits.
+    st, *_ = red.encode_plan_tick(
+        st, jnp.asarray([[1]], jnp.int32), jnp.asarray([[0]], jnp.int32),
+        jnp.asarray([[50]], jnp.int32), jnp.ones((1, 1), bool),
+    )
+    st, r_sn, r_off, r_len, r_ok = red.encode_plan_tick(
+        st, jnp.asarray([[2]], jnp.int32), jnp.asarray([[20000]], jnp.int32),
+        jnp.asarray([[50]], jnp.int32), jnp.ones((1, 1), bool),
+    )
+    assert not bool(r_ok[0, 0, 0])
+
+
+# ---- pacer ------------------------------------------------------------
+
+def test_pacer_drains_at_rate():
+    p = pacer.PacerParams(burst_ms=100)
+    st = pacer.init_state(1, initial_rate=800_000.0)  # 100 KB/s
+    rate = jnp.asarray([800_000.0], jnp.float32)
+    # enqueue 30 KB; at 100 KB/s and 100 ms ticks → 10 KB allowed per tick
+    st, allowed, backlog = pacer.update_tick(st, p, jnp.asarray([30_000.0]), rate, 100)
+    assert abs(float(allowed[0]) - 10_000) < 1
+    assert abs(float(backlog[0]) - 20_000) < 1
+    st, allowed, backlog = pacer.update_tick(st, p, jnp.asarray([0.0]), rate, 100)
+    assert abs(float(allowed[0]) - 10_000) < 1
+    st, allowed, backlog = pacer.update_tick(st, p, jnp.asarray([0.0]), rate, 100)
+    assert abs(float(backlog[0])) < 1  # fully drained
+
+
+def test_pacer_burst_cap():
+    p = pacer.PacerParams(burst_ms=100)
+    st = pacer.init_state(1, initial_rate=800_000.0)
+    rate = jnp.asarray([800_000.0], jnp.float32)
+    # long idle: tokens cap at burst depth (10 KB), not unbounded
+    for _ in range(20):
+        st, _, _ = pacer.update_tick(st, p, jnp.asarray([0.0]), rate, 100)
+    st, allowed, _ = pacer.update_tick(st, p, jnp.asarray([50_000.0]), rate, 100)
+    assert float(allowed[0]) <= 10_000 + 1

@@ -1,0 +1,116 @@
+"""SVC + dependency-descriptor selection tests.
+
+Reference parity: pkg/sfu/videolayerselector vp9.go / dependency-
+descriptor.go behaviors — onion forwarding, keyframe-gated upswitch,
+end-of-frame downswitch, decode-target switching, chain-break detection.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from livekit_server_tpu.ops import svc
+
+
+def run_svc(state, pkts):
+    """pkts: list of (sid, tid, kf, sw_up, eof)."""
+    P = len(pkts)
+    a = lambda i, dt=jnp.int32: jnp.asarray([p[i] for p in pkts], dt)
+    return svc.select_tick(
+        state, a(0), a(1), a(2, jnp.bool_), a(3, jnp.bool_), a(4, jnp.bool_),
+        jnp.ones((P,), jnp.bool_),
+    )
+
+
+def test_svc_onion_forwarding():
+    # One subscriber targeting spatial 1, temporal 3.
+    st = svc.init_state(1, target_spatial=1, target_temporal=3)
+    # Keyframe picture with layers 0..2 → locks on, forwards sid<=1 only.
+    st, fwd, drp, up, nk = run_svc(
+        st, [(0, 0, True, True, False), (1, 0, True, True, False), (2, 0, True, True, True)]
+    )
+    fwd = np.asarray(fwd)[:, 0]
+    assert fwd.tolist() == [True, True, False]
+    assert not bool(np.asarray(nk)[0])
+    # Delta frames keep the onion flowing.
+    st, fwd, _, _, _ = run_svc(
+        st, [(0, 0, False, False, False), (1, 0, False, False, True), (2, 0, False, False, True)]
+    )
+    assert np.asarray(fwd)[:, 0].tolist() == [True, True, False]
+
+
+def test_svc_upswitch_waits_for_keyframe():
+    st = svc.init_state(1, target_spatial=0, target_temporal=3)
+    st, fwd, *_ = run_svc(st, [(0, 0, True, True, True)])
+    assert np.asarray(fwd)[0, 0]
+    # Raise target to 2: delta frames keep old layer; needs keyframe.
+    st = st._replace(target_spatial=jnp.asarray([2], jnp.int32))
+    st, fwd, _, _, nk = run_svc(st, [(0, 0, False, False, False), (1, 0, False, False, False), (2, 0, False, False, True)])
+    assert np.asarray(fwd)[:, 0].tolist() == [True, False, False]
+    assert bool(np.asarray(nk)[0])
+    # Keyframe arrives → full onion up to 2.
+    st, fwd, _, _, nk = run_svc(st, [(0, 0, True, True, False), (1, 0, True, True, False), (2, 0, True, True, True)])
+    assert np.asarray(fwd)[:, 0].tolist() == [True, True, True]
+    assert not bool(np.asarray(nk)[0])
+
+
+def test_svc_downswitch_at_end_of_frame():
+    st = svc.init_state(1, target_spatial=2, target_temporal=3)
+    st, fwd, *_ = run_svc(st, [(0, 0, True, True, False), (1, 0, True, True, False), (2, 0, True, True, True)])
+    st = st._replace(target_spatial=jnp.asarray([0], jnp.int32))
+    # Mid-frame packets still forward the old onion; after eof, next frame drops.
+    st, fwd, *_ = run_svc(st, [(0, 0, False, False, False), (2, 0, False, False, True)])
+    assert np.asarray(fwd)[:, 0].tolist() == [True, True]
+    st, fwd, *_ = run_svc(st, [(0, 0, False, False, False), (2, 0, False, False, True)])
+    assert np.asarray(fwd)[:, 0].tolist() == [True, False]
+
+
+def test_svc_pause():
+    st = svc.init_state(1, target_spatial=2)
+    st, *_ = run_svc(st, [(0, 0, True, True, True)])
+    st = st._replace(target_spatial=jnp.asarray([-1], jnp.int32))
+    st, fwd, *_ = run_svc(st, [(0, 0, False, False, True)])
+    assert not np.asarray(fwd).any()
+    assert int(st.current_spatial[0]) == -1
+
+
+def run_dd(state, pkts):
+    """pkts: (dti_mask, switch_mask, frame, kf)."""
+    P = len(pkts)
+    a = lambda i, dt=jnp.int32: jnp.asarray([p[i] for p in pkts], dt)
+    return svc.dd_select_tick(
+        state, a(0), a(1), a(2), a(3, jnp.bool_), jnp.ones((P,), jnp.bool_)
+    )
+
+
+def test_dd_decode_target_selection():
+    # 3 decode targets; packet needed for targets via bitmask.
+    st = svc.init_dd_state(1, target_dt=2)
+    # keyframe present for all targets (mask 0b111), switchable everywhere
+    st, fwd, drp, broken = run_dd(st, [(0b111, 0b111, 1, True), (0b100, 0b100, 2, False), (0b001, 0b000, 3, False)])
+    assert np.asarray(fwd)[:, 0].tolist() == [True, True, False]
+    assert not bool(np.asarray(broken)[0])
+
+
+def test_dd_switch_waits_for_indication():
+    st = svc.init_dd_state(1, target_dt=0)
+    st, fwd, *_ = run_dd(st, [(0b111, 0b111, 1, True)])
+    st = st._replace(target_dt=jnp.asarray([2], jnp.int32))
+    # no switch indication for dt2 → stays on dt0 selection
+    st, fwd, drp, _ = run_dd(st, [(0b001, 0b000, 2, False), (0b100, 0b000, 3, False)])
+    assert np.asarray(fwd)[:, 0].tolist() == [True, False]
+    # switch point arrives
+    st, fwd, _, _ = run_dd(st, [(0b100, 0b100, 4, False)])
+    assert np.asarray(fwd)[0, 0]
+    assert int(st.active_dt[0]) == 2
+
+
+def test_dd_chain_break_detection():
+    st = svc.init_dd_state(1, target_dt=0)
+    st, *_ = run_dd(st, [(0b1, 0b1, 1, True)])
+    # frame 2 lost; frame 3 arrives → chain broken
+    st, fwd, _, broken = run_dd(st, [(0b1, 0b0, 3, False)])
+    assert bool(np.asarray(broken)[0])
+    # keyframe resets the chain
+    st, _, _, broken = run_dd(st, [(0b1, 0b1, 9, True)])
+    assert not bool(np.asarray(broken)[0])
