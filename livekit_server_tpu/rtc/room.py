@@ -48,6 +48,9 @@ class Room:
         # dict rebuilds): sub col → participant, track col → track sid.
         self.sub_index: dict[int, Participant] = {}
         self.col_to_sid: dict[int, str] = {}
+        # Hooks fired on publish (room.go onTrackPublished callbacks —
+        # used for publisher agent jobs and track egress launch).
+        self.on_track_published: list[Callable] = []
         self._on_close: list[Callable[[], None]] = []
         self._active_speakers: list[dict] = []
 
@@ -142,6 +145,8 @@ class Room:
             if p.auto_subscribe and p.permission.can_subscribe:
                 self.subscribe(p, info.sid)
         self.broadcast_participant_state(publisher)
+        for cb in self.on_track_published:
+            cb(publisher, track)
         return track
 
     def unpublish_track(self, publisher: Participant, track: PublishedTrack) -> None:
@@ -154,9 +159,7 @@ class Room:
             self.slots.row, track.track_col, published=False, is_video=track.is_video
         )
         if self.udp is not None:
-            if track.ssrc:
-                self.udp.release_ssrc(track.ssrc)
-            self.udp.track_kind.pop((self.slots.row, track.track_col), None)
+            self.udp.release_track(self.slots.row, track.track_col)
         self.slots.release_track(sid)
         for p in self.participants.values():
             p.subscribed_tracks.discard(sid)

@@ -37,12 +37,23 @@ def handle_participant_signal(room, participant: Participant, req: SignalRequest
         if info is not None and data.get("transport") == "udp" and udp is not None:
             track = participant.publish_pending(data.get("cid", ""))
             if track is not None:
-                track.ssrc = udp.assign_ssrc(
-                    room.slots.row, track.track_col, track.is_video
-                )
+                # One SSRC per simulcast spatial layer (mediatrack.go layer
+                # SSRC bookkeeping); single-layer tracks get exactly one.
+                n_layers = max(1, len(track.info.layers)) if track.is_video else 1
+                layer_ssrcs = [
+                    udp.assign_ssrc(room.slots.row, track.track_col, track.is_video, layer=l)
+                    for l in range(n_layers)
+                ]
+                track.ssrc = layer_ssrcs[0]
                 participant.send(
                     "request_response",
-                    {"udp_media": {"track_sid": track.info.sid, "ssrc": track.ssrc}},
+                    {
+                        "udp_media": {
+                            "track_sid": track.info.sid,
+                            "ssrc": layer_ssrcs[0],
+                            "layer_ssrcs": layer_ssrcs,
+                        }
+                    },
                 )
     elif kind == "mute":
         sid = data.get("sid", "")

@@ -37,6 +37,7 @@ class PacketIn:
     ts: int
     size: int
     payload: bytes = b""
+    marker: bool = False    # RTP M bit (frame delimiter; egress restores it)
     layer: int = 0
     temporal: int = 0
     keyframe: bool = False
@@ -62,9 +63,10 @@ class IngestBuffer:
         self._i32 = lambda: np.zeros((R, T, K), np.int32)
         self._bool = lambda: np.zeros((R, T, K), bool)
         self._alloc_fields()
-        # Payload slab: list-of-lists indexed [r][t][k] — host-side only,
-        # egress rebuilds wire packets from it (PacketFactory analog).
-        self._payloads: dict[tuple[int, int, int], bytes] = {}
+        # Payload slab indexed (r, t, k) — host-side only; egress rebuilds
+        # wire packets from (payload bytes, marker bit) (PacketFactory
+        # analog; the marker never crosses to the device).
+        self._payloads: dict[tuple[int, int, int], tuple[bytes, bool]] = {}
         # Per-subscriber feedback staging.
         self._estimate = np.zeros((R, S), np.float32)
         self._estimate_valid = np.zeros((R, S), bool)
@@ -111,7 +113,7 @@ class IngestBuffer:
         self.arrival_rtp[r, t, k] = _wrap_i32(pkt.arrival_rtp)
         self.valid[r, t, k] = True
         if pkt.payload:
-            self._payloads[(r, t, int(k))] = pkt.payload
+            self._payloads[(r, t, int(k))] = (pkt.payload, pkt.marker)
         return True
 
     def push_feedback(

@@ -52,6 +52,7 @@ class EgressPacket:
     keyidx: int
     size: int
     payload: bytes
+    marker: bool = False
 
 
 @dataclass
@@ -239,7 +240,7 @@ class PlaneRuntime:
         egress: list[EgressPacket] = []
         for i in range(len(rr)):
             r, t, k = int(rr[i]), int(tt[i]), int(kk[i])
-            payload = payloads.get((r, t, k), b"")
+            payload, marker = payloads.get((r, t, k), (b"", False))
             egress.append(
                 EgressPacket(
                     room=r, track=t, sub=int(ss[i]),
@@ -250,6 +251,7 @@ class PlaneRuntime:
                     keyidx=int(kidx[i]),
                     size=len(payload),
                     payload=payload,
+                    marker=marker,
                 )
             )
         overflow = int(out.egress_overflow.sum())

@@ -34,7 +34,7 @@ class SSRCBinding:
     room: int            # room row
     track: int           # track col
     is_video: bool
-    sub_keys: list       # (room, participant) for reverse lookup / teardown
+    layer: int = 0       # simulcast spatial layer carried by this SSRC
 
 
 class UDPMediaTransport(asyncio.DatagramProtocol):
@@ -48,21 +48,42 @@ class UDPMediaTransport(asyncio.DatagramProtocol):
         self.sub_addrs: dict[tuple, tuple] = {}          # (room,sub) → addr
         self.sub_ssrc: dict[tuple, dict[int, int]] = {}  # (room,sub) → {track: ssrc}
         self.track_kind: dict[tuple, bool] = {}          # (room,track) → is_video
-        self.stats = {"rx": 0, "tx": 0, "unknown_ssrc": 0, "parse_errors": 0}
-        self._next_ssrc = 0x10000
+        self.stats = {
+            "rx": 0, "tx": 0, "unknown_ssrc": 0, "parse_errors": 0,
+            "addr_mismatch": 0,
+        }
 
     # -- control-plane API ------------------------------------------------
-    def assign_ssrc(self, room: int, track: int, is_video: bool) -> int:
-        """Bind a fresh SSRC to a published track (sent back in signal)."""
-        self._next_ssrc += 1
-        ssrc = self._next_ssrc
-        self.bindings[ssrc] = SSRCBinding(room, track, is_video, [])
+    def _new_ssrc(self) -> int:
+        """Random 32-bit SSRC (unguessable — a sequential counter would let
+        an off-path sender inject media into live tracks)."""
+        import secrets
+
+        while True:
+            ssrc = secrets.randbits(32) | 0x10000
+            if ssrc not in self.bindings:
+                return ssrc
+
+    def assign_ssrc(self, room: int, track: int, is_video: bool, layer: int = 0) -> int:
+        """Bind a fresh SSRC to one (track, simulcast layer); sent back in
+        signal. Simulcast publishers get one SSRC per layer, matching the
+        reference's per-layer SSRCs (mediatrack.go layer SSRC bookkeeping)."""
+        ssrc = self._new_ssrc()
+        self.bindings[ssrc] = SSRCBinding(room, track, is_video, layer)
         self.track_kind[(room, track)] = is_video
         return ssrc
 
     def release_ssrc(self, ssrc: int) -> None:
         self.bindings.pop(ssrc, None)
         self.addrs.pop(ssrc, None)
+
+    def release_track(self, room: int, track: int) -> None:
+        """Track unpublished: drop its kind entry + every layer SSRC."""
+        self.track_kind.pop((room, track), None)
+        for ssrc in [
+            s for s, b in self.bindings.items() if b.room == room and b.track == track
+        ]:
+            self.release_ssrc(ssrc)
 
     def set_track_kind(self, room: int, track: int, is_video: bool) -> None:
         """Record media kind for egress PT selection (any transport)."""
@@ -94,8 +115,7 @@ class UDPMediaTransport(asyncio.DatagramProtocol):
         """Per-(subscriber, track) egress SSRC (DownTrack's own SSRC)."""
         m = self.sub_ssrc.setdefault((room, sub), {})
         if track not in m:
-            self._next_ssrc += 1
-            m[track] = self._next_ssrc
+            m[track] = self._new_ssrc()
         return m[track]
 
     # -- datagram path ----------------------------------------------------
@@ -116,7 +136,13 @@ class UDPMediaTransport(asyncio.DatagramProtocol):
         if binding is None:
             self.stats["unknown_ssrc"] += 1
             return
-        self.addrs[ssrc] = addr
+        # First packet latches the source address; later packets from a
+        # different address are dropped (UDP-mux address learning — without
+        # this, anyone who learns an SSRC could inject media).
+        latched = self.addrs.setdefault(ssrc, addr)
+        if latched != addr:
+            self.stats["addr_mismatch"] += 1
+            return
         off, ln = int(parsed["payload_off"]), int(parsed["payload_len"])
         self.ingest.push(
             PacketIn(
@@ -126,7 +152,8 @@ class UDPMediaTransport(asyncio.DatagramProtocol):
                 ts=int(parsed["ts"]),
                 size=ln,
                 payload=data[off : off + ln],
-                layer=0,  # simulcast layers arrive as distinct SSRCs; host maps
+                marker=bool(parsed["marker"]),
+                layer=binding.layer,
                 temporal=int(parsed["tid"]),
                 keyframe=bool(parsed["keyframe"]),
                 layer_sync=bool(parsed["layer_sync"]) or bool(parsed["keyframe"]),
@@ -141,28 +168,45 @@ class UDPMediaTransport(asyncio.DatagramProtocol):
         )
 
     def send_egress(self, packets) -> None:
-        """Rewrite + send a tick's EgressPackets (DownTrack.WriteRTP's
-        header-rewrite half, batched through the native library)."""
+        """Rewrite + send a tick's EgressPackets: assemble all datagrams in
+        one buffer, ONE native rewrite_batch call, then sendto per datagram
+        (the batched write half of DownTrack.WriteRTP + pacer)."""
         if self.transport is None:
             return
+        buf = bytearray()
+        offsets: list[int] = []
+        lengths: list[int] = []
+        sns: list[int] = []
+        tss: list[int] = []
+        ssrcs: list[int] = []
+        addrs: list[tuple] = []
         for pkt in packets:
             addr = self.sub_addrs.get((pkt.room, pkt.sub))
             if addr is None or not pkt.payload:
                 continue
-            ssrc = self.subscriber_ssrc(pkt.room, pkt.sub, pkt.track)
-            # 12-byte header + payload; PT from the track's actual kind.
+            is_video = self.track_kind.get((pkt.room, pkt.track), False)
             header = bytearray(12)
             header[0] = 0x80
-            is_video = self.track_kind.get((pkt.room, pkt.track), False)
-            header[1] = VP8_PT if is_video else OPUS_PT
-            buf = bytearray(bytes(header) + pkt.payload)
-            rtp.rewrite_batch(
-                buf, np.asarray([0], np.int32),
-                np.asarray([pkt.sn], np.uint16),
-                np.asarray([pkt.ts], np.uint32),
-                np.asarray([ssrc], np.uint32),
-            )
-            self.transport.sendto(bytes(buf), addr)
+            header[1] = (0x80 if pkt.marker else 0) | (VP8_PT if is_video else OPUS_PT)
+            offsets.append(len(buf))
+            buf += header + pkt.payload
+            lengths.append(12 + len(pkt.payload))
+            sns.append(pkt.sn)
+            tss.append(pkt.ts)
+            ssrcs.append(self.subscriber_ssrc(pkt.room, pkt.sub, pkt.track))
+            addrs.append(addr)
+        if not offsets:
+            return
+        rtp.rewrite_batch(
+            buf,
+            np.asarray(offsets, np.int32),
+            np.asarray(sns, np.uint16),
+            np.asarray(tss, np.uint32),
+            np.asarray(ssrcs, np.uint32),
+        )
+        view = memoryview(buf)
+        for off, ln, addr in zip(offsets, lengths, addrs):
+            self.transport.sendto(bytes(view[off : off + ln]), addr)
             self.stats["tx"] += 1
 
 

@@ -60,7 +60,8 @@ class RoomManager:
         )
         self.rooms: dict[str, Room] = {}
         self._row_to_room: dict[int, Room] = {}
-        self.udp = None  # UDPMediaTransport, attached by the server at start
+        self.udp = None     # UDPMediaTransport, attached by the server at start
+        self.agents = None  # AgentService; room/publisher job dispatch
         self.runtime.on_tick(self._dispatch_tick)
         self._reaper_task: asyncio.Task | None = None
         router.on_new_session(self.start_session)
@@ -84,6 +85,18 @@ class RoomManager:
         await self.router.set_node_for_room(name, self.router.local_node.node_id)
         self._update_node_stats()
         self._notify("room_started", room=room.info.to_dict())
+        if self.agents is not None:
+            # room agent job on room start; publisher job on first publish
+            # (roommanager.go / rtc/agentclient.go launch points)
+            asyncio.ensure_future(self.agents.launch_room_job(name))
+
+            def on_publish(pub, _track, room_name=name):
+                if not pub.published:  # first track → becoming a publisher
+                    asyncio.ensure_future(
+                        self.agents.launch_publisher_job(room_name, pub.identity)
+                    )
+
+            room.on_track_published.append(on_publish)
         return room
 
     async def delete_room(self, name: str) -> None:

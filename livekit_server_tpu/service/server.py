@@ -39,15 +39,29 @@ class LivekitServer:
         self.store = store
         self.room_manager: RoomManager = room_manager
         self.telemetry: TelemetryService = telemetry
+        from livekit_server_tpu.service.agents import AgentService
+        from livekit_server_tpu.service.egress import EgressService
+        from livekit_server_tpu.service.ingress import IngressService
+        from livekit_server_tpu.service.sip import SIPService
+
         self.rtc_service = RTCService(self)
         self.room_api = RoomServiceAPI(self)
+        self.egress = EgressService(self)
+        self.ingress = IngressService(self)
+        self.sip = SIPService(self)
+        self.agents = AgentService(self)
+        room_manager.agents = self.agents
         self.app = web.Application()
         self.app.router.add_get("/", self.health)
         self.app.router.add_get("/rtc", self.rtc_service.handle)
         self.app.router.add_get("/rtc/validate", self.validate)
+        self.app.router.add_get("/agent", self.agents.handle)
         self.app.router.add_post(
             "/twirp/livekit.RoomService/{method}", self.room_api.handle
         )
+        self.app.router.add_post("/twirp/livekit.Egress/{method}", self.egress.handle)
+        self.app.router.add_post("/twirp/livekit.Ingress/{method}", self.ingress.handle)
+        self.app.router.add_post("/twirp/livekit.SIP/{method}", self.sip.handle)
         self.app.router.add_get("/metrics", self.metrics)
         self.app.router.add_get("/debug/rooms", self.debug_rooms)
         self._runner: web.AppRunner | None = None
@@ -145,6 +159,8 @@ class LivekitServer:
                     room.udp = self.room_manager.udp
             except OSError:
                 pass  # port busy: WS media path still works
+        await self.egress.start()
+        await self.ingress.start()
         self.room_manager.start()
         self._stats_task = asyncio.ensure_future(self._refresh_nodes())
         self._runner = web.AppRunner(self.app)
@@ -168,6 +184,8 @@ class LivekitServer:
             self._stats_task.cancel()
         if self.room_manager.udp is not None and self.room_manager.udp.transport:
             self.room_manager.udp.transport.close()
+        await self.egress.stop()
+        await self.ingress.stop()
         await self.room_manager.stop()
         await self.router.unregister_node()
         if self._runner is not None:
