@@ -11,7 +11,23 @@ from livekit_server_tpu.auth.token import (
     ClaimGrants,
     TokenError,
     VideoGrant,
+    ensure_admin_permission,
+    ensure_create_permission,
+    ensure_ingress_admin_permission,
+    ensure_list_permission,
+    ensure_record_permission,
     verify_token,
 )
 
-__all__ = ["AccessToken", "ClaimGrants", "TokenError", "VideoGrant", "verify_token"]
+__all__ = [
+    "AccessToken",
+    "ClaimGrants",
+    "TokenError",
+    "VideoGrant",
+    "ensure_admin_permission",
+    "ensure_create_permission",
+    "ensure_ingress_admin_permission",
+    "ensure_list_permission",
+    "ensure_record_permission",
+    "verify_token",
+]

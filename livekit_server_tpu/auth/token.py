@@ -91,6 +91,33 @@ class ClaimGrants:
     kind: str = ""    # standard | ingress | egress | sip | agent
 
 
+def ensure_admin_permission(claims: ClaimGrants, room: str) -> bool:
+    """Room-scoped admin (pkg/service/auth.go:133 EnsureAdminPermission):
+    requires roomAdmin AND the token's room claim to name the target room.
+    A bare roomAdmin token with no room claim administrates nothing."""
+    return bool(claims.video.room_admin and room and room == claims.video.room)
+
+
+def ensure_create_permission(claims: ClaimGrants) -> bool:
+    """auth.go:146 EnsureCreatePermission — roomCreate grant."""
+    return bool(claims.video.room_create)
+
+
+def ensure_list_permission(claims: ClaimGrants) -> bool:
+    """auth.go:154 EnsureListPermission — roomList grant."""
+    return bool(claims.video.room_list)
+
+
+def ensure_record_permission(claims: ClaimGrants) -> bool:
+    """auth.go:162 EnsureRecordPermission — roomRecord grant (egress)."""
+    return bool(claims.video.room_record)
+
+
+def ensure_ingress_admin_permission(claims: ClaimGrants) -> bool:
+    """auth.go:170 EnsureIngressAdminPermission — ingressAdmin grant."""
+    return bool(claims.video.ingress_admin)
+
+
 class AccessToken:
     """Mint HS256 JWTs (auth/access_token.go)."""
 

@@ -61,9 +61,23 @@ def handle_participant_signal(room, participant: Participant, req: SignalRequest
         participant.send("mute", {"sid": sid, "muted": bool(data.get("muted", False))})
     elif kind == "subscription":
         udp = getattr(room, "udp", None)
-        if udp is not None and data.get("udp_addr") and participant.sub_col >= 0:
-            host, port_ = data["udp_addr"]
-            udp.register_subscriber(room.slots.row, participant.sub_col, (host, int(port_)))
+        if (
+            udp is not None
+            and (data.get("udp_addr") or data.get("udp"))
+            and participant.sub_col >= 0
+        ):
+            # A client-supplied address is never registered verbatim (it
+            # would let any subscriber aim the server's media stream at a
+            # third party — traffic reflection). Hand back a punch id; the
+            # address latches when a PUNCH datagram carrying it arrives
+            # from the client's actual socket (ICE-consent analog).
+            # `udp_repunch` rotates a latched id after a NAT rebind.
+            punch = udp.assign_subscriber_punch(
+                room.slots.row,
+                participant.sub_col,
+                rotate=bool(data.get("udp_repunch", False)),
+            )
+            participant.send("request_response", {"udp_punch": {"punch_id": punch}})
         for sid in data.get("track_sids", []):
             if data.get("subscribe", True):
                 room.subscribe(participant, sid)
@@ -108,15 +122,35 @@ def _handle_subscription_permission(room, participant: Participant, data: dict) 
     # proto3 JSON omits false bools: a missing key means NOT all (the
     # restrictive reading — matching livekit.SubscriptionPermission).
     all_participants = bool(data.get("all_participants", False))
-    allowed = {tp.get("participant_sid") or tp.get("participant_identity")
-               for tp in data.get("track_permissions", [])}
+    # livekit.TrackPermission semantics: an entry with empty track_sids
+    # grants that participant ALL of the publisher's tracks; a non-empty
+    # list restricts the grant to exactly those track sids.
+    allow_all: set = set()
+    allow_by_track: dict[str, set] = {}
+    for tp in data.get("track_permissions", []):
+        who = tp.get("participant_sid") or tp.get("participant_identity")
+        if not who:
+            continue
+        sids = tp.get("track_sids") or []
+        if sids:
+            for tsid in sids:
+                allow_by_track.setdefault(tsid, set()).add(who)
+        else:
+            allow_all.add(who)
     for sid, (pub, track) in room.tracks.items():
         if pub.sid != participant.sid:
             continue
+        track_allowed = allow_by_track.get(sid, set())
         for p in room.participants.values():
             if p.sid == pub.sid:
                 continue
-            ok = all_participants or p.sid in allowed or p.identity in allowed
+            ok = (
+                all_participants
+                or p.sid in allow_all
+                or p.identity in allow_all
+                or p.sid in track_allowed
+                or p.identity in track_allowed
+            )
             if not ok and sid in p.subscribed_tracks:
                 room.unsubscribe(p, sid)
                 p.send("subscription_permission_update", {

@@ -17,6 +17,7 @@ latching, like the reference's UDP mux address learning).
 from __future__ import annotations
 
 import asyncio
+import secrets
 from dataclasses import dataclass
 
 import numpy as np
@@ -27,6 +28,13 @@ from livekit_server_tpu.runtime.ingest import IngestBuffer, PacketIn
 VP8_PT = 96
 OPUS_PT = 111
 AUDIO_LEVEL_EXT_ID = 1
+
+# Subscriber address punch: a client proves it owns the address it wants
+# media sent to by sending this magic + its 32-bit punch id from that
+# socket (the ICE-connectivity-check analog; a client-supplied address in
+# a signal message is never trusted — traffic-reflection hardening).
+PUNCH_REQ = b"LKPUNCH0"
+PUNCH_ACK = b"LKPUNCH1"
 
 
 @dataclass
@@ -48,17 +56,19 @@ class UDPMediaTransport(asyncio.DatagramProtocol):
         self.sub_addrs: dict[tuple, tuple] = {}          # (room,sub) → addr
         self.sub_ssrc: dict[tuple, dict[int, int]] = {}  # (room,sub) → {track: ssrc}
         self.track_kind: dict[tuple, bool] = {}          # (room,track) → is_video
+        self.punch_ids: dict[int, list] = {}             # punch id → [key, latched_addr|None]
+        self._punch_by_sub: dict[tuple, int] = {}        # (room,sub) → punch id
+        self._rx_pending: list[tuple[bytes, tuple]] = []
+        self._rx_scheduled = False
         self.stats = {
             "rx": 0, "tx": 0, "unknown_ssrc": 0, "parse_errors": 0,
-            "addr_mismatch": 0,
+            "addr_mismatch": 0, "bad_punch": 0,
         }
 
     # -- control-plane API ------------------------------------------------
     def _new_ssrc(self) -> int:
         """Random 32-bit SSRC (unguessable — a sequential counter would let
         an off-path sender inject media into live tracks)."""
-        import secrets
-
         while True:
             ssrc = secrets.randbits(32) | 0x10000
             if ssrc not in self.bindings:
@@ -90,15 +100,47 @@ class UDPMediaTransport(asyncio.DatagramProtocol):
         self.track_kind[(room, track)] = is_video
 
     def register_subscriber(self, room: int, sub: int, addr: tuple) -> None:
-        """Tell egress where a subscriber receives media (from signal or
-        latched from its own publishing socket)."""
+        """Trusted-caller egress registration (tests / in-process tooling).
+        The signal plane must NOT call this with a client-supplied address —
+        it hands out a punch id instead (assign_subscriber_punch)."""
         self.sub_addrs[(room, sub)] = addr
+
+    def assign_subscriber_punch(self, room: int, sub: int, rotate: bool = False) -> int:
+        """Mint an unguessable punch id for a subscriber. The client proves
+        address ownership by sending PUNCH_REQ+id from its media socket;
+        only then does egress flow to that source address.
+
+        One outstanding id per (room, sub): repeated subscription signals
+        reuse it (no unbounded growth, no widening of the guessable-id
+        set; a same-address retry of a latched id just re-acks). Once
+        latched, the id binds to its first source address — a replayed
+        PUNCH_REQ from anywhere else is rejected, so an observer of the
+        cleartext handshake cannot re-aim the stream. `rotate=True`
+        (client sent udp_repunch) invalidates the old id and mints a
+        fresh one: the recovery path for a NAT rebind — only the
+        authenticated signal session can trigger it, never the old id."""
+        key = (room, sub)
+        existing = self._punch_by_sub.get(key)
+        if existing is not None:
+            if not rotate:
+                return existing
+            del self.punch_ids[existing]
+        while True:
+            pid = secrets.randbits(32)
+            if pid and pid not in self.punch_ids:
+                break
+        self.punch_ids[pid] = [key, None]
+        self._punch_by_sub[key] = pid
+        return pid
 
     def release_subscriber(self, room: int, sub: int) -> None:
         """Subscriber left: stop egress and free its SSRC map (prevents
         media leaking to a stale address once the sub col is reused)."""
         self.sub_addrs.pop((room, sub), None)
         self.sub_ssrc.pop((room, sub), None)
+        pid = self._punch_by_sub.pop((room, sub), None)
+        if pid is not None:
+            self.punch_ids.pop(pid, None)
 
     def release_room(self, room: int) -> None:
         """Room closed: drop every binding on its row."""
@@ -110,6 +152,8 @@ class UDPMediaTransport(asyncio.DatagramProtocol):
             del self.sub_ssrc[key]
         for key in [k for k in self.track_kind if k[0] == room]:
             del self.track_kind[key]
+        for key in [k for k in self._punch_by_sub if k[0] == room]:
+            self.punch_ids.pop(self._punch_by_sub.pop(key), None)
 
     def subscriber_ssrc(self, room: int, sub: int, track: int) -> int:
         """Per-(subscriber, track) egress SSRC (DownTrack's own SSRC)."""
@@ -124,48 +168,90 @@ class UDPMediaTransport(asyncio.DatagramProtocol):
 
     def datagram_received(self, data: bytes, addr) -> None:
         self.stats["rx"] += 1
+        if data[:8] == PUNCH_REQ:
+            self._handle_punch(data, addr)
+            return
+        # Coalesce: datagrams arriving in the same event-loop iteration are
+        # parsed by ONE native parse_batch call (the batch design this
+        # module documents; under media load the loop wakes with many
+        # datagrams ready and the per-packet Python overhead amortizes).
+        self._rx_pending.append((data, addr))
+        if not self._rx_scheduled:
+            self._rx_scheduled = True
+            asyncio.get_running_loop().call_soon(self._flush_rx)
+
+    def _handle_punch(self, data: bytes, addr) -> None:
+        if len(data) < 12:
+            self.stats["bad_punch"] += 1
+            return
+        pid = int.from_bytes(data[8:12], "big")
+        entry = self.punch_ids.get(pid)
+        if entry is None:
+            self.stats["bad_punch"] += 1
+            return
+        key, latched = entry
+        if latched is not None and latched != addr:
+            # id already bound to another source: replay/hijack attempt
+            self.stats["bad_punch"] += 1
+            return
+        entry[1] = addr
+        self.sub_addrs[key] = addr
+        if self.transport is not None:
+            self.transport.sendto(PUNCH_ACK + data[8:12], addr)
+
+    def _flush_rx(self) -> None:
+        self._rx_scheduled = False
+        pending, self._rx_pending = self._rx_pending, []
+        if not pending:
+            return
+        lengths = np.asarray([len(d) for d, _ in pending], np.int32)
+        offsets = np.zeros(len(pending), np.int32)
+        np.cumsum(lengths[:-1], out=offsets[1:])
+        blob = b"".join(d for d, _ in pending)
         parsed = rtp.parse_batch(
-            data, np.asarray([0], np.int32), np.asarray([len(data)], np.int32),
+            blob, offsets, lengths,
             audio_level_ext=AUDIO_LEVEL_EXT_ID, vp8_pts={VP8_PT},
-        )[0]
-        if int(parsed["payload_len"]) < 0:
-            self.stats["parse_errors"] += 1
-            return
-        ssrc = int(parsed["ssrc"])
-        binding = self.bindings.get(ssrc)
-        if binding is None:
-            self.stats["unknown_ssrc"] += 1
-            return
-        # First packet latches the source address; later packets from a
-        # different address are dropped (UDP-mux address learning — without
-        # this, anyone who learns an SSRC could inject media).
-        latched = self.addrs.setdefault(ssrc, addr)
-        if latched != addr:
-            self.stats["addr_mismatch"] += 1
-            return
-        off, ln = int(parsed["payload_off"]), int(parsed["payload_len"])
-        self.ingest.push(
-            PacketIn(
-                room=binding.room,
-                track=binding.track,
-                sn=int(parsed["sn"]),
-                ts=int(parsed["ts"]),
-                size=ln,
-                payload=data[off : off + ln],
-                marker=bool(parsed["marker"]),
-                layer=binding.layer,
-                temporal=int(parsed["tid"]),
-                keyframe=bool(parsed["keyframe"]),
-                layer_sync=bool(parsed["layer_sync"]) or bool(parsed["keyframe"]),
-                begin_pic=bool(parsed["begin_pic"]),
-                pid=max(int(parsed["picture_id"]), 0),
-                tl0=max(int(parsed["tl0picidx"]), 0),
-                keyidx=max(int(parsed["keyidx"]), 0),
-                frame_ms=20 if not binding.is_video else 0,
-                audio_level=int(parsed["audio_level"]),
-                arrival_rtp=int(parsed["ts"]),
-            )
         )
+        for i, (data, addr) in enumerate(pending):
+            p = parsed[i]
+            if int(p["payload_len"]) < 0:
+                self.stats["parse_errors"] += 1
+                continue
+            ssrc = int(p["ssrc"])
+            binding = self.bindings.get(ssrc)
+            if binding is None:
+                self.stats["unknown_ssrc"] += 1
+                continue
+            # First packet latches the source address; later packets from a
+            # different address are dropped (UDP-mux address learning —
+            # without this, anyone who learns an SSRC could inject media).
+            latched = self.addrs.setdefault(ssrc, addr)
+            if latched != addr:
+                self.stats["addr_mismatch"] += 1
+                continue
+            off, ln = int(p["payload_off"]), int(p["payload_len"])
+            self.ingest.push(
+                PacketIn(
+                    room=binding.room,
+                    track=binding.track,
+                    sn=int(p["sn"]),
+                    ts=int(p["ts"]),
+                    size=ln,
+                    payload=data[off : off + ln],
+                    marker=bool(p["marker"]),
+                    layer=binding.layer,
+                    temporal=int(p["tid"]),
+                    keyframe=bool(p["keyframe"]),
+                    layer_sync=bool(p["layer_sync"]) or bool(p["keyframe"]),
+                    begin_pic=bool(p["begin_pic"]),
+                    pid=max(int(p["picture_id"]), 0),
+                    tl0=max(int(p["tl0picidx"]), 0),
+                    keyidx=max(int(p["keyidx"]), 0),
+                    frame_ms=20 if not binding.is_video else 0,
+                    audio_level=int(p["audio_level"]),
+                    arrival_rtp=int(p["ts"]),
+                )
+            )
 
     def send_egress(self, packets) -> None:
         """Rewrite + send a tick's EgressPackets: assemble all datagrams in

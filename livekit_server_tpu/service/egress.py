@@ -112,7 +112,11 @@ class EgressService:
             self._updates_sub.close()
 
     async def handle(self, request: web.Request) -> web.Response:
-        from livekit_server_tpu.auth import TokenError, verify_token
+        from livekit_server_tpu.auth import (
+            TokenError,
+            ensure_record_permission,
+            verify_token,
+        )
 
         method = request.path.removeprefix(self.PREFIX)
         token = request.headers.get("Authorization", "").removeprefix("Bearer ").strip()
@@ -120,7 +124,10 @@ class EgressService:
             claims = verify_token(token, self.server.config.keys)
         except TokenError as e:
             return web.json_response({"msg": str(e)}, status=401)
-        if not (claims.video.room_record or claims.video.room_admin):
+        # Reference parity: egress needs the dedicated roomRecord grant
+        # (egress.go EnsureRecordPermission) — roomAdmin is NOT a substitute,
+        # and in this build roomAdmin is room-scoped anyway.
+        if not ensure_record_permission(claims):
             return web.json_response({"msg": "requires roomRecord"}, status=403)
         try:
             body = await request.json()

@@ -105,7 +105,11 @@ class IngressService:
             self._updates_sub.close()
 
     async def handle(self, request: web.Request) -> web.Response:
-        from livekit_server_tpu.auth import TokenError, verify_token
+        from livekit_server_tpu.auth import (
+            TokenError,
+            ensure_ingress_admin_permission,
+            verify_token,
+        )
 
         method = request.path.removeprefix(self.PREFIX)
         token = request.headers.get("Authorization", "").removeprefix("Bearer ").strip()
@@ -113,7 +117,11 @@ class IngressService:
             claims = verify_token(token, self.server.config.keys)
         except TokenError as e:
             return web.json_response({"msg": str(e)}, status=401)
-        if not (claims.video.ingress_admin or claims.video.room_admin):
+        # Reference parity: ingress management needs the dedicated
+        # ingressAdmin grant (auth.go EnsureIngressAdminPermission) —
+        # roomAdmin is room-scoped and is NOT a substitute for a
+        # node-global capability.
+        if not ensure_ingress_admin_permission(claims):
             return web.json_response({"msg": "requires ingressAdmin"}, status=403)
         try:
             body = await request.json()

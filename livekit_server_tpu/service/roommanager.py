@@ -17,11 +17,19 @@ from livekit_server_tpu.config.config import Config
 from livekit_server_tpu.models import plane
 from livekit_server_tpu.ops import audio as audio_ops, bwe as bwe_ops
 from livekit_server_tpu.protocol import models as pm
-from livekit_server_tpu.protocol.signal import decode_signal_request
-from livekit_server_tpu.routing.messagechannel import ChannelClosed, MessageChannel
+from livekit_server_tpu.protocol.signal import (
+    SignalResponse,
+    decode_signal_request,
+    encode_signal_response,
+)
+from livekit_server_tpu.routing.messagechannel import (
+    ChannelClosed,
+    ChannelFull,
+    MessageChannel,
+)
 from livekit_server_tpu.routing.router import Router
 from livekit_server_tpu.rtc import Participant, Room, handle_participant_signal
-from livekit_server_tpu.runtime import PlaneRuntime
+from livekit_server_tpu.runtime import CapacityError, PlaneRuntime
 from livekit_server_tpu.runtime.plane_runtime import TickResult
 from livekit_server_tpu.service.store import ObjectStore
 from livekit_server_tpu.utils import ids
@@ -117,7 +125,15 @@ class RoomManager:
         request_source: MessageChannel,
         response_sink: MessageChannel,
     ) -> None:
-        room = await self.get_or_create_room(room_name)
+        try:
+            room = await self.get_or_create_room(room_name)
+        except CapacityError:
+            # Node room tensor full: reject explicitly (the reference sends
+            # a limits-reached error; a silent open WebSocket is the
+            # failure ADVICE flagged). The sink close lets rtcservice's
+            # pump end the connection.
+            self._reject_session(response_sink, request_source)
+            return
         identity = init.get("identity", "")
 
         existing = room.participants.get(identity)
@@ -135,6 +151,12 @@ class RoomManager:
             await self._session_worker(room, existing, request_source)
             return
 
+        # A same-identity rejoin replaces its old session (room.join kicks
+        # the duplicate), so it must not count toward the cap.
+        max_p = room.info.max_participants
+        if max_p and identity not in room.participants and len(room.participants) >= max_p:
+            self._reject_session(response_sink, request_source, "room is full")
+            return
         participant = Participant(
             identity,
             room,
@@ -144,12 +166,12 @@ class RoomManager:
             auto_subscribe=init.get("auto_subscribe", True),
         )
         self._attach_media_queue(room, participant)
-        max_p = room.info.max_participants
-        if max_p and len(room.participants) >= max_p:
-            participant.send("leave", {"reason": int(pm.DisconnectReason.JOIN_FAILURE)})
-            response_sink.close()
+        try:
+            join = room.join(participant)
+        except CapacityError:
+            # subscriber-column tensor full (slots.alloc_sub)
+            self._reject_session(response_sink, request_source)
             return
-        join = room.join(participant)
         participant.send("join", join)
         await self.store.store_participant(room_name, participant.to_info())
         self._update_node_stats()
@@ -197,6 +219,31 @@ class RoomManager:
                     room=room.info.to_dict(),
                     participant=participant.to_info().to_dict(),
                 )
+
+    def _reject_session(
+        self,
+        response_sink: MessageChannel,
+        request_source: MessageChannel,
+        error: str = "node at capacity",
+    ) -> None:
+        """Send an explicit JOIN_FAILURE leave and close both channels."""
+        try:
+            response_sink.write_message(
+                encode_signal_response(
+                    SignalResponse(
+                        "leave",
+                        {
+                            "reason": int(pm.DisconnectReason.JOIN_FAILURE),
+                            "can_reconnect": False,
+                            "error": error,
+                        },
+                    )
+                )
+            )
+        except (ChannelFull, ChannelClosed):
+            pass
+        response_sink.close()
+        request_source.close()
 
     def _attach_media_queue(self, room: Room, participant: Participant) -> None:
         """Subscriber egress → bounded msgpack queue drained by the WS pump

@@ -19,7 +19,13 @@ from typing import TYPE_CHECKING
 
 from aiohttp import web
 
-from livekit_server_tpu.auth import TokenError, verify_token
+from livekit_server_tpu.auth import (
+    TokenError,
+    ensure_admin_permission,
+    ensure_create_permission,
+    ensure_list_permission,
+    verify_token,
+)
 from livekit_server_tpu.protocol import models as pm
 
 if TYPE_CHECKING:
@@ -50,19 +56,27 @@ class RoomServiceAPI:
         handler = getattr(self, f"_rpc_{method}", None)
         if handler is None:
             return _err(404, f"unknown method {method}")
-        video = claims.video
-        # permission guards (auth.go EnsureAdminPermission / EnsureCreatePermission)
-        needs_admin = method not in ("ListRooms", "CreateRoom")
-        if method == "CreateRoom" and not (video.room_create or video.room_admin):
-            return _err(403, "requires roomCreate")
-        if method == "ListRooms" and not (video.room_list or video.room_admin):
-            return _err(403, "requires roomList")
-        if needs_admin and not video.room_admin:
-            return _err(403, "requires roomAdmin")
+        # Permission guards, matching the reference per-RPC
+        # (roomservice.go:79,142,165,174-271): CreateRoom/DeleteRoom need
+        # roomCreate, ListRooms needs roomList, and every participant/room
+        # mutation needs roomAdmin *scoped to the target room* — a token
+        # minted as admin of room A must not administrate room B.
+        if method in ("CreateRoom", "DeleteRoom"):
+            if not ensure_create_permission(claims):
+                return _err(403, "requires roomCreate")
+        elif method == "ListRooms":
+            if not ensure_list_permission(claims):
+                return _err(403, "requires roomList")
+        else:
+            target = body.get("room", "")
+            if not ensure_admin_permission(claims, target):
+                return _err(403, "requires roomAdmin for this room")
         return await handler(body)
 
     # -- RPCs -------------------------------------------------------------
     async def _rpc_CreateRoom(self, body: dict) -> web.Response:
+        from livekit_server_tpu.runtime import CapacityError
+
         name = body.get("name", "")
         if not name:
             return _err(400, "name required")
@@ -73,7 +87,12 @@ class RoomServiceAPI:
             max_participants=body.get("max_participants", 0),
             metadata=body.get("metadata", ""),
         )
-        room = await self.server.room_manager.get_or_create_room(name, info=info)
+        try:
+            room = await self.server.room_manager.get_or_create_room(name, info=info)
+        except CapacityError as e:
+            # node room-tensor full (reference: explicit limits-reached
+            # rejection rather than a raw 500 — roomallocator.go)
+            return _err(503, f"node at capacity: {e}")
         return web.json_response(room.info.to_dict())
 
     async def _rpc_ListRooms(self, body: dict) -> web.Response:

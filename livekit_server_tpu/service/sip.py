@@ -74,6 +74,19 @@ class SIPService:
             body = await request.json()
         except json.JSONDecodeError:
             body = {}
+        # Scoping policy: SIP trunk/dispatch-rule management is node-global,
+        # so it needs an UNscoped admin token (no `room` claim). A token
+        # minted as admin of one room may only dial/transfer SIP
+        # participants into that room — it must not manage trunks or reach
+        # other rooms (room-scoped analog of auth.go EnsureAdminPermission).
+        scoped_room = claims.video.room
+        if scoped_room:
+            room_targeted = method in ("CreateSIPParticipant", "TransferSIPParticipant")
+            if not room_targeted or body.get("room_name", "") != scoped_room:
+                return web.json_response(
+                    {"msg": "token is scoped to one room; requires unscoped roomAdmin"},
+                    status=403,
+                )
 
         if method in ("CreateSIPTrunk", "CreateSIPInboundTrunk", "CreateSIPOutboundTrunk"):
             trunk = SIPTrunk(

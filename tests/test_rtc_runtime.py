@@ -169,6 +169,57 @@ async def test_unsubscribe_and_permissions(runtime):
     assert "subscription_response" in kinds
 
 
+async def test_subscription_permission_per_track(runtime):
+    """livekit.TrackPermission semantics: an entry listing track_sids grants
+    ONLY those tracks; an entry with no track_sids grants all (the pooled
+    reading — every allowed identity gets every track — is a privilege
+    escalation; see uptrackmanager.go subscription permissions)."""
+    room = Room("tperm", runtime)
+    alice, _ = make_participant(room, "alice")
+    bob, _ = make_participant(room, "bob")
+    carol, _ = make_participant(room, "carol")
+    room.join(alice)
+    room.join(bob)
+    room.join(carol)
+    t1 = publish_audio(room, alice, cid="mic1")
+    t2 = publish_audio(room, alice, cid="mic2")
+    assert t1.info.sid in bob.subscribed_tracks  # auto-subscribed pre-restriction
+    # alice restricts: bob may see only t1; carol keeps everything
+    handle_participant_signal(
+        room,
+        alice,
+        SignalRequest(
+            "subscription_permission",
+            {
+                "track_permissions": [
+                    {"participant_identity": "bob", "track_sids": [t1.info.sid]},
+                    {"participant_identity": "carol"},
+                ]
+            },
+        ),
+    )
+    assert t1.info.sid in bob.subscribed_tracks
+    assert t2.info.sid not in bob.subscribed_tracks
+    assert t1.info.sid in carol.subscribed_tracks
+    assert t2.info.sid in carol.subscribed_tracks
+
+
+async def test_join_capacity_rejection(runtime):
+    """Sub-column exhaustion raises CapacityError (the session layer turns
+    it into an explicit JOIN_FAILURE leave, not a silent hang)."""
+    from livekit_server_tpu.runtime import CapacityError
+
+    room = Room("full", runtime)
+    joined = []
+    for i in range(DIMS.subs):
+        p, _ = make_participant(room, f"p{i}")
+        room.join(p)
+        joined.append(p)
+    extra, _ = make_participant(room, "overflow")
+    with pytest.raises(CapacityError):
+        room.join(extra)
+
+
 async def test_duplicate_identity_kicks_old(runtime):
     room = Room("dup", runtime)
     a1, s1 = make_participant(room, "alice")

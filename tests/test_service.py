@@ -46,10 +46,12 @@ def token(identity: str, room: str, **grant_kw) -> str:
     return t.to_jwt()
 
 
-def admin_token() -> str:
+def admin_token(room: str = "") -> str:
+    """roomAdmin is room-scoped (auth.go EnsureAdminPermission): per-room
+    ops need a token whose room claim names the target room."""
     t = AccessToken(API_KEY, API_SECRET)
     t.identity = "admin"
-    t.grant = VideoGrant(room_admin=True, room_create=True, room_list=True)
+    t.grant = VideoGrant(room_admin=True, room_create=True, room_list=True, room=room)
     return t.to_jwt()
 
 
@@ -215,7 +217,7 @@ async def test_join_publish_subscribe_media():
 async def test_room_service_api():
     async with running_server() as server:
         async with aiohttp.ClientSession() as s:
-            hdr = {"Authorization": f"Bearer {admin_token()}"}
+            hdr = {"Authorization": f"Bearer {admin_token('api-room')}"}
             base = f"http://127.0.0.1:{server.port}/twirp/livekit.RoomService"
 
             async with s.post(f"{base}/CreateRoom", json={"name": "api-room"}, headers=hdr) as r:
@@ -264,6 +266,57 @@ async def test_room_service_api():
             ) as r:
                 assert r.status == 403
 
+            # admin of room A must NOT administrate room B
+            # (auth.go:140 room-scoped EnsureAdminPermission)
+            async with s.post(
+                f"{base}/ListParticipants",
+                json={"room": "other-room"},
+                headers={"Authorization": f"Bearer {admin_token('api-room')}"},
+            ) as r:
+                assert r.status == 403
+
+            # ...and a roomAdmin token with no room claim scopes to nothing
+            async with s.post(
+                f"{base}/SendData",
+                json={"room": "api-room", "data": "x"},
+                headers={"Authorization": f"Bearer {admin_token()}"},
+            ) as r:
+                assert r.status == 403
+
+
+async def test_full_room_allows_same_identity_rejoin():
+    """max_participants must not count the stale session a same-identity
+    rejoin replaces (crash-reconnect without the reconnect flag)."""
+    async with running_server() as server:
+        async with aiohttp.ClientSession() as s:
+            hdr = {"Authorization": f"Bearer {admin_token()}"}
+            base = f"http://127.0.0.1:{server.port}/twirp/livekit.RoomService"
+            async with s.post(
+                f"{base}/CreateRoom",
+                json={"name": "capped", "max_participants": 1},
+                headers=hdr,
+            ) as r:
+                assert r.status == 200
+
+            c1 = SignalClient(s, server.port)
+            await c1.connect("capped", "alice")
+            # a different identity is rejected (leave with JOIN_FAILURE)
+            c2 = SignalClient(s, server.port)
+            c2.ws = await s.ws_connect(
+                f"ws://127.0.0.1:{server.port}/rtc?access_token={token('bob', 'capped')}"
+            )
+            c2._reader = asyncio.ensure_future(c2._read())
+            leave = await c2.wait_for("leave")
+            assert leave["reason"] == int(7)  # JOIN_FAILURE
+            # same identity rejoins fine; the old session is kicked
+            c3 = SignalClient(s, server.port)
+            await c3.connect("capped", "alice")
+            dup = await c1.wait_for("leave")
+            assert dup["reason"] == 2  # DUPLICATE_IDENTITY
+            await c1.close()
+            await c2.close()
+            await c3.close()
+
 
 async def test_duplicate_identity_over_wire():
     async with running_server() as server:
@@ -295,10 +348,12 @@ async def test_metrics_and_debug():
 
 async def test_udp_media_through_full_server():
     """Publisher announces a UDP track via signal, streams plain RTP to the
-    node's UDP port; subscriber registers its UDP addr and receives
-    rewritten RTP (the native-transport version of TestSinglePublisher)."""
+    node's UDP port; subscriber proves address ownership via the punch
+    handshake and receives rewritten RTP (the native-transport version of
+    TestSinglePublisher)."""
     import socket
 
+    from livekit_server_tpu.runtime.udp import PUNCH_ACK, PUNCH_REQ
     from tests.test_native import rtp_packet
 
     async with running_server() as server:
@@ -320,12 +375,28 @@ async def test_udp_media_through_full_server():
             sub_sock = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
             sub_sock.bind(("127.0.0.1", 0))
             sub_sock.setblocking(False)
+            # Request UDP egress: the server answers with a punch id, never
+            # trusting a client-supplied address (reflection hardening).
             await bob.send_signal(
                 "subscription",
-                {"track_sids": [track_sid], "subscribe": True,
-                 "udp_addr": ["127.0.0.1", sub_sock.getsockname()[1]]},
+                {"track_sids": [track_sid], "subscribe": True, "udp": True},
             )
-            await asyncio.sleep(0.05)
+            rr = await bob.wait_for("request_response")
+            punch_id = rr["udp_punch"]["punch_id"]
+            # Prove address ownership from the real receiving socket.
+            sub_sock.sendto(
+                PUNCH_REQ + int(punch_id).to_bytes(4, "big"), ("127.0.0.1", udp_port)
+            )
+            deadline = asyncio.get_event_loop().time() + 2
+            ack = b""
+            while asyncio.get_event_loop().time() < deadline:
+                await asyncio.sleep(0.02)
+                try:
+                    ack, _ = sub_sock.recvfrom(2048)
+                    break
+                except BlockingIOError:
+                    continue
+            assert ack == PUNCH_ACK + int(punch_id).to_bytes(4, "big")
 
             pub_sock = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
             got = []

@@ -76,6 +76,80 @@ async def test_udp_publish_forward_receive():
         transport.transport.close()
 
 
+async def test_udp_punch_latches_only_real_source():
+    """Egress addresses latch only from a punch datagram carrying a minted
+    id, sent from the client's actual socket — a forged/unknown punch id is
+    ignored (traffic-reflection hardening)."""
+    from livekit_server_tpu.runtime.udp import PUNCH_ACK, PUNCH_REQ
+
+    runtime = PlaneRuntime(DIMS, tick_ms=10)
+    s = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    transport = await start_udp_transport(runtime.ingest, "127.0.0.1", port)
+    try:
+        pid = transport.assign_subscriber_punch(0, 1)
+        sub = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
+        sub.bind(("127.0.0.1", 0))
+        sub.setblocking(False)
+
+        # wrong id: no latch, counted
+        sub.sendto(PUNCH_REQ + (pid ^ 0xFFFF).to_bytes(4, "big"), ("127.0.0.1", port))
+        await asyncio.sleep(0.05)
+        assert (0, 1) not in transport.sub_addrs
+        assert transport.stats["bad_punch"] == 1
+
+        # right id from the real socket: latches + acked
+        sub.sendto(PUNCH_REQ + pid.to_bytes(4, "big"), ("127.0.0.1", port))
+        await asyncio.sleep(0.05)
+        assert transport.sub_addrs[(0, 1)] == sub.getsockname()
+        ack, _ = sub.recvfrom(2048)
+        assert ack == PUNCH_ACK + pid.to_bytes(4, "big")
+
+        # retry from the SAME socket (lost ack): re-acked, still latched
+        sub.sendto(PUNCH_REQ + pid.to_bytes(4, "big"), ("127.0.0.1", port))
+        await asyncio.sleep(0.05)
+        ack, _ = sub.recvfrom(2048)
+        assert ack == PUNCH_ACK + pid.to_bytes(4, "big")
+
+        # replay of the latched id from a DIFFERENT socket (an observer of
+        # the cleartext handshake): rejected, latch unchanged
+        evil = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
+        evil.bind(("127.0.0.1", 0))
+        evil.sendto(PUNCH_REQ + pid.to_bytes(4, "big"), ("127.0.0.1", port))
+        await asyncio.sleep(0.05)
+        assert transport.sub_addrs[(0, 1)] == sub.getsockname()
+        assert transport.stats["bad_punch"] == 2
+        evil.close()
+
+        # the outstanding id is reused across subscription signals (even
+        # after a latch — a routine second subscription must not kill an
+        # id whose ack may still be in flight)
+        assert transport.assign_subscriber_punch(0, 2) == transport.assign_subscriber_punch(0, 2)
+        assert transport.assign_subscriber_punch(0, 1) == pid
+        # …but an explicit re-punch request ROTATES it (NAT-rebind
+        # recovery: old id dies, new unguessable one minted)
+        pid2 = transport.assign_subscriber_punch(0, 1, rotate=True)
+        assert pid2 != pid
+        assert pid not in transport.punch_ids
+        sub2 = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
+        sub2.bind(("127.0.0.1", 0))
+        sub2.setblocking(False)
+        sub2.sendto(PUNCH_REQ + pid2.to_bytes(4, "big"), ("127.0.0.1", port))
+        await asyncio.sleep(0.05)
+        assert transport.sub_addrs[(0, 1)] == sub2.getsockname()
+        sub2.close()
+
+        # release clears the outstanding punch id too
+        transport.release_subscriber(0, 1)
+        assert pid2 not in transport.punch_ids
+        assert (0, 1) not in transport._punch_by_sub
+        sub.close()
+    finally:
+        transport.transport.close()
+
+
 async def test_udp_unknown_ssrc_dropped():
     runtime = PlaneRuntime(DIMS, tick_ms=10)
     s = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
