@@ -43,14 +43,18 @@ from livekit_server_tpu.ops import (
     rtpmunger,
     rtpstats,
     selector,
+    streamtracker,
+    svc,
     vp8,
 )
 
 MAX_LAYERS = 3          # simulcast spatial layers (reference: 3 — receiver.go)
+MAX_TEMPORAL = 4        # temporal sublayers tracked per spatial layer
 SPEAKER_TOP_K = 3
-# Per-temporal-sublayer share of a spatial layer's bitrate (coarse model of
-# the reference's [4][4] Bitrates matrix until temporal-layer byte
-# attribution lands in stats).
+# Cold-start per-temporal-sublayer bitrate shares, used only until measured
+# per-temporal byte attribution (state.temporal_bytes) accumulates — the
+# live path derives the [4][4] Bitrates matrix from observed traffic like
+# the reference's StreamTrackerManager (streamtrackermanager.go:60-732).
 TEMPORAL_FRACTIONS = (0.45, 0.65, 0.85, 1.0)
 
 
@@ -67,6 +71,8 @@ class TrackMeta(NamedTuple):
     is_video: jax.Array     # bool
     published: jax.Array    # bool — track exists and is live
     pub_muted: jax.Array    # bool — publisher muted
+    is_svc: jax.Array       # bool — single-stream SVC (VP9/AV1) vs simulcast
+                            # (receiver.go IsSvcCodec :142-150)
 
 
 class SubControl(NamedTuple):
@@ -89,7 +95,10 @@ class PlaneState(NamedTuple):
     vp8_state: vp8.VP8State              # [R, T, S]
     sel: selector.SelectorState          # [R, T, S]
     bwe_state: bwe.BWEState              # [R, S]
-    layer_bytes_ema: jax.Array           # [R, T, L] float32 — per-layer byte/tick EMA
+    tracker: streamtracker.TrackerState  # [R, T*L] per (track, layer) stream
+    temporal_bytes: jax.Array            # [R, T, L, MAX_TEMPORAL] float32 —
+                                         # per-temporal byte/tick EMA (the
+                                         # measured Bitrates attribution)
 
 
 class TickInputs(NamedTuple):
@@ -103,6 +112,8 @@ class TickInputs(NamedTuple):
     keyframe: jax.Array    # bool
     layer_sync: jax.Array  # bool — temporal upswitch point (VP8 Y bit)
     begin_pic: jax.Array   # bool — first packet of a picture / frame
+    end_frame: jax.Array   # bool — last packet of the frame (RTP marker;
+                           # SVC downswitch boundary — vp9.go)
     pid: jax.Array         # int32 — VP8 picture id (0 for audio)
     tl0: jax.Array         # int32 — VP8 TL0PICIDX
     keyidx: jax.Array      # int32 — VP8 KEYIDX
@@ -118,6 +129,9 @@ class TickInputs(NamedTuple):
     nacks: jax.Array           # float32 — NACK count this tick
     # Scalars:
     tick_ms: jax.Array     # int32
+    roll_quality: jax.Array  # int32 bool-ish — close the stats window this
+                             # tick (host sets it ~1/s; the quality outputs
+                             # always score the accumulating window)
 
 
 class TickOutputs(NamedTuple):
@@ -147,6 +161,16 @@ class TickOutputs(NamedTuple):
     target_layers: jax.Array   # [R, S, T] int32 — flat layer targets
     fwd_packets: jax.Array     # [R] int32 — packets forwarded (telemetry)
     fwd_bytes: jax.Array       # [R] int32
+    # Connection quality (ops/quality E-model; room.go:1318 worker feed):
+    track_mos: jax.Array       # [R, T] float32 — publisher-side MOS
+    track_quality: jax.Array   # [R, T] int32 — ConnectionQuality enum
+    sub_quality: jax.Array     # [R, S] int32 — subscriber-side enum
+    # Per-(track, layer) stream liveness (streamtracker; dynacast feed):
+    layer_live: jax.Array      # [R, T, L] int32 — STOPPED/LIVE
+    # Windowed per-track receive stats (telemetry; rolled by roll_quality):
+    track_loss_pct: jax.Array  # [R, T] float32
+    track_jitter_ms: jax.Array # [R, T] float32
+    track_bps: jax.Array       # [R, T] float32 — summed live-layer bitrate
 
 
 def init_state(dims: PlaneDims) -> PlaneState:
@@ -160,6 +184,7 @@ def init_state(dims: PlaneDims) -> PlaneState:
         is_video=jnp.zeros((R, T), jnp.bool_),
         published=jnp.zeros((R, T), jnp.bool_),
         pub_muted=jnp.zeros((R, T), jnp.bool_),
+        is_svc=jnp.zeros((R, T), jnp.bool_),
     )
     ctrl = SubControl(
         subscribed=jnp.zeros((R, T, S), jnp.bool_),
@@ -176,7 +201,8 @@ def init_state(dims: PlaneDims) -> PlaneState:
         vp8_state=jax.tree.map(lambda x: tile(x, R, T), vp8.init_state(S)),
         sel=jax.tree.map(lambda x: tile(x, R, T), selector.init_state(S)),
         bwe_state=jax.tree.map(lambda x: tile(x, R), bwe.init_state(S)),
-        layer_bytes_ema=jnp.zeros((R, T, L), jnp.float32),
+        tracker=jax.tree.map(lambda x: tile(x, R), streamtracker.init_state(T * L)),
+        temporal_bytes=jnp.zeros((R, T, L, MAX_TEMPORAL), jnp.float32),
     )
 
 
@@ -193,7 +219,14 @@ def _room_tick(
     L = MAX_LAYERS
 
     # ---- 1. RTP stats per (track, layer) stream -------------------------
-    stream_idx = jnp.arange(T, dtype=jnp.int32)[:, None] * L + jnp.clip(inp.layer, 0, L - 1)
+    # Simulcast layers are independent RTP streams (own SN spaces) and get
+    # one stats row each; an SVC track carries every spatial layer in ONE
+    # stream/SN space, so all its packets fold into row 0 — per-layer rows
+    # would misread the interleaved SNs as massive loss.
+    eff_layer = jnp.where(
+        state.meta.is_svc[:, None], 0, jnp.clip(inp.layer, 0, L - 1)
+    )
+    stream_idx = jnp.arange(T, dtype=jnp.int32)[:, None] * L + eff_layer
     # Scatter packets into [T*L, K] rows by (track, layer).
     def to_streams(x, fill):
         out = jnp.full((T * L, K), fill, x.dtype)
@@ -206,27 +239,79 @@ def _room_tick(
     st_valid = to_streams(inp.valid, False)
     stats = rtpstats.update_tick(state.stats, st_sn, st_ts, st_size, st_arr, st_valid)
 
-    # ---- 2. per-layer bitrate EMA --------------------------------------
-    layer_oh = jax.nn.one_hot(jnp.clip(inp.layer, 0, L - 1), L, dtype=jnp.float32)
-    tick_bytes = jnp.einsum(
-        "tk,tkl->tl", jnp.where(inp.valid, inp.size, 0).astype(jnp.float32), layer_oh
+    # ---- 2. per-layer liveness + measured [4][4] bitrate matrix ---------
+    # StreamTracker rows (streamtracker.go cycles) per (track, layer):
+    st_pkts = jnp.sum(st_valid, axis=-1).astype(jnp.int32)            # [T*L]
+    st_bytes = jnp.sum(jnp.where(st_valid, st_size, 0), axis=-1)      # [T*L]
+    tracker, layer_status, _status_changed, tracker_bps = streamtracker.update_tick(
+        state.tracker, streamtracker.TrackerParams(), st_pkts, st_bytes, inp.tick_ms
     )
-    ema = state.layer_bytes_ema * 0.9 + tick_bytes * 0.1
+    # Per-(layer, temporal) byte attribution EMA — the measured version of
+    # the reference's Bitrates matrix (streamtrackermanager.go:60).
+    layer_oh = jax.nn.one_hot(jnp.clip(inp.layer, 0, L - 1), L, dtype=jnp.float32)
+    tm_oh = jax.nn.one_hot(
+        jnp.clip(inp.temporal, 0, MAX_TEMPORAL - 1), MAX_TEMPORAL, dtype=jnp.float32
+    )
+    vbytes = jnp.where(inp.valid, inp.size, 0).astype(jnp.float32)
+    tick_bytes_lt = jnp.einsum("tk,tkl,tkm->tlm", vbytes, layer_oh, tm_oh)  # [T,L,4]
+    temporal_bytes = state.temporal_bytes * 0.9 + tick_bytes_lt * 0.1
     tick_s = jnp.maximum(inp.tick_ms.astype(jnp.float32), 1.0) / 1000.0
-    layer_bps = ema * 8.0 / tick_s  # [T, L]
-    # Expand to the [T, 4, 4] bitrate matrix with temporal fractions.
-    frac = jnp.asarray(TEMPORAL_FRACTIONS, jnp.float32)
+    # Layer bitrate: tracker cycles once committed; per-tick EMA bootstraps
+    # the first cycle so allocation starts on the first packets. SVC tracks
+    # always use the EMA attribution — their tracker rows collapsed to row
+    # 0 (single stream), so per-spatial-layer bps only exists in
+    # temporal_bytes.
+    boot_bps = jnp.sum(temporal_bytes, axis=-1) * 8.0 / tick_s        # [T, L]
+    layer_bps = jnp.where(
+        ~state.meta.is_svc[:, None] & (tracker_bps.reshape(T, L) > 0),
+        tracker_bps.reshape(T, L),
+        boot_bps,
+    )
+    # Cumulative temporal shares from measured bytes; cold-start fractions
+    # until any bytes attribute.
+    tot = jnp.sum(temporal_bytes, axis=-1, keepdims=True)             # [T, L, 1]
+    cum = jnp.cumsum(temporal_bytes, axis=-1)                         # [T, L, 4]
+    frac0 = jnp.asarray(TEMPORAL_FRACTIONS, jnp.float32)
+    frac = jnp.where(tot > 0, cum / jnp.maximum(tot, 1e-6), frac0[None, None, :])
     bitrates = jnp.zeros((T, 4, 4), jnp.float32)
-    bitrates = bitrates.at[:, :L, :].set(layer_bps[:, :, None] * frac[None, None, :])
+    bitrates = bitrates.at[:, :L, :].set(layer_bps[:, :, None] * frac)
+    # SVC onion: forwarding spatial s sends every layer <= s, so the cost
+    # of an SVC entry is the cumulative sum over spatial layers (the
+    # reference reports cumulative SVC bitrates) — without this the
+    # allocator over-commits the channel by the lower layers' bps.
+    bitrates = jnp.where(
+        state.meta.is_svc[:, None, None], jnp.cumsum(bitrates, axis=1), bitrates
+    )
     # Audio has a single "layer": zero the matrix so allocation skips it.
     bitrates = jnp.where(state.meta.is_video[:, None, None], bitrates, 0.0)
 
     # ---- 3. per-packet layer selection with last tick's targets --------
     # (the reference's allocator also lags forwarding: StreamAllocator ticks
     # at 100 ms while WriteRTP runs continuously)
-    sel_state, v_fwd, v_drop, v_switch, need_kf = jax.vmap(selector.select_tick)(
+    sel_state, v_fwd, v_drop, v_switch, need_kf_sim = jax.vmap(selector.select_tick)(
         state.sel, inp.layer, inp.temporal, inp.keyframe, inp.layer_sync, inp.valid
     )  # masks [T, K, S]
+
+    # SVC (VP9/AV1 single-stream onion) selection shares the selector state
+    # tuple (identical fields); both run and the per-track is_svc flag picks
+    # (videolayerselector/vp9.go:43 vs simulcast.go:42).
+    svc_in = svc.SVCSelectorState(*state.sel)
+    svc_state, s_fwd, s_drop, _s_up, need_kf_svc = jax.vmap(svc.select_tick)(
+        svc_in, inp.layer, inp.temporal, inp.keyframe, inp.layer_sync,
+        inp.end_frame, inp.valid,
+    )
+    is_svc_t = state.meta.is_svc                       # [T]
+    sel_state = jax.tree.map(
+        lambda sim, sv: jnp.where(is_svc_t[:, None], sv, sim),
+        sel_state,
+        selector.SelectorState(*svc_state),
+    )
+    is_svc = is_svc_t[:, None, None]                    # [T, 1, 1]
+    v_fwd = jnp.where(is_svc, s_fwd, v_fwd)
+    v_drop = jnp.where(is_svc, s_drop, v_drop)
+    # SVC has a single SN space — no source switch on layer change.
+    v_switch = jnp.where(is_svc, False, v_switch)
+    need_kf = jnp.where(is_svc_t[:, None], need_kf_svc, need_kf_sim)
 
     # Audio path: forward to every subscribed, unmuted subscriber.
     base = (
@@ -279,6 +364,37 @@ def _room_tick(
         allocation.temporal_of(target_flat.transpose(1, 0)),
     )
 
+    # ---- connection quality (scorer.go E-model; room.go:1318 worker) ----
+    # Scored every tick over the accumulating stats window; the host rolls
+    # the window ~1/s via inp.roll_quality.
+    expected = rtpstats.expected_packets(stats)                       # [T*L]
+    exp_d = jnp.maximum(expected - stats.snap_expected, 0).reshape(T, L)
+    rcv_d = jnp.maximum(stats.received - stats.snap_received, 0).reshape(T, L)
+    exp_t = jnp.sum(exp_d, axis=-1)
+    rcv_t = jnp.sum(rcv_d, axis=-1)
+    loss_pct = jnp.where(
+        exp_t > 0, 100.0 * (exp_t - rcv_t) / jnp.maximum(exp_t, 1), 0.0
+    ).astype(jnp.float32)
+    jitter_rtp = jnp.max((stats.jitter_q4 >> 4).reshape(T, L), axis=-1)
+    clock_khz = jnp.where(state.meta.is_video, 90.0, 48.0)
+    jitter_ms = jitter_rtp.astype(jnp.float32) / clock_khz
+    has_pkts = (rcv_t > 0) & state.meta.published
+    track_mos, track_q = quality.connection_quality(
+        loss_pct, jnp.float32(0.0), jitter_ms, has_pkts
+    )
+    # A pub-muted track legitimately sends nothing — it must not read as
+    # LOST (connectionstats.go excludes muted tracks from LOST detection).
+    track_mos = jnp.where(state.meta.pub_muted, 5.0, track_mos)
+    track_q = jnp.where(
+        state.meta.pub_muted, quality.QUALITY_EXCELLENT, track_q
+    )
+    track_q = jnp.where(state.meta.published, track_q, quality.QUALITY_LOST)
+    roll = inp.roll_quality > 0
+    stats = stats._replace(
+        snap_received=jnp.where(roll, stats.received, stats.snap_received),
+        snap_expected=jnp.where(roll, expected, stats.snap_expected),
+    )
+
     # ---- 7. audio levels + active speakers -----------------------------
     is_audio_pkt = inp.valid & ~state.meta.is_video[:, None]
     audio_state, linear, is_active = audio.observe_tick(
@@ -297,6 +413,16 @@ def _room_tick(
         spk_levels = jnp.pad(spk_levels, (0, pad))
         spk_tracks = jnp.pad(spk_tracks, (0, pad), constant_values=-1)
 
+    # Subscriber-side quality: congestion ⇒ POOR, deficient allocation ⇒
+    # GOOD, else EXCELLENT (the layer-distance penalty half of
+    # connectionstats.go, from this tick's allocation).
+    any_deficient = jnp.any(deficient, axis=-1)                        # [S]
+    sub_q = jnp.where(
+        congested,
+        quality.QUALITY_POOR,
+        jnp.where(any_deficient, quality.QUALITY_GOOD, quality.QUALITY_EXCELLENT),
+    ).astype(jnp.int32)
+
     new_state = PlaneState(
         meta=state.meta,
         ctrl=state.ctrl,
@@ -306,7 +432,8 @@ def _room_tick(
         vp8_state=vp8_state,
         sel=sel_state,
         bwe_state=bwe_state,
-        layer_bytes_ema=ema,
+        tracker=tracker,
+        temporal_bytes=temporal_bytes,
     )
     # ---- device-side egress compaction ---------------------------------
     # Dense [T, K, S] grids → up to `egress_cap` (t, k, s) writes. Keeps the
@@ -337,6 +464,13 @@ def _room_tick(
         target_layers=target_flat,
         fwd_packets=jnp.sum(send.astype(jnp.int32)),
         fwd_bytes=jnp.sum(jnp.where(send, inp.size[:, :, None], 0)),
+        track_mos=track_mos,
+        track_quality=track_q,
+        sub_quality=sub_q,
+        layer_live=layer_status.reshape(T, L),
+        track_loss_pct=loss_pct,
+        track_jitter_ms=jitter_ms,
+        track_bps=jnp.sum(layer_bps, axis=-1),
     )
     return new_state, outputs
 
@@ -370,7 +504,9 @@ def media_plane_tick(
     def tick_one(st, i):
         return _room_tick(st, i, audio_params, bwe_params, egress_cap)
 
-    inp_axes = TickInputs(**{f: 0 for f in TickInputs._fields})._replace(tick_ms=None)
+    inp_axes = TickInputs(**{f: 0 for f in TickInputs._fields})._replace(
+        tick_ms=None, roll_quality=None
+    )
     return jax.vmap(tick_one, in_axes=(0, inp_axes))(state, inp)
 
 
@@ -387,14 +523,15 @@ def media_plane_tick(
 
 PKT_FIELDS = (
     "sn", "ts", "layer", "temporal", "keyframe", "layer_sync", "begin_pic",
-    "pid", "tl0", "keyidx", "size", "frame_ms", "audio_level", "arrival_rtp",
-    "valid",
+    "end_frame", "pid", "tl0", "keyidx", "size", "frame_ms", "audio_level",
+    "arrival_rtp", "valid",
 )
-_BOOL_FIELDS = {"keyframe", "layer_sync", "begin_pic", "valid"}
+_BOOL_FIELDS = {"keyframe", "layer_sync", "begin_pic", "end_frame", "valid"}
 
 
 def pack_tick_inputs(inp: TickInputs):
-    """Host-side: TickInputs → (pkt [F,R,T,K] i32, fb [3,R,S] f32, tick_ms)."""
+    """Host-side: TickInputs → (pkt [F,R,T,K] i32, fb [3,R,S] f32, tick_ms,
+    roll_quality)."""
     import numpy as np
 
     pkt = np.stack([np.asarray(getattr(inp, f)).astype(np.int32) for f in PKT_FIELDS])
@@ -405,10 +542,12 @@ def pack_tick_inputs(inp: TickInputs):
             np.asarray(inp.nacks, np.float32),
         ]
     )
-    return pkt, fb, np.int32(inp.tick_ms)
+    return pkt, fb, np.int32(inp.tick_ms), np.int32(inp.roll_quality)
 
 
-def unpack_tick_inputs(pkt: jax.Array, fb: jax.Array, tick_ms: jax.Array) -> TickInputs:
+def unpack_tick_inputs(
+    pkt: jax.Array, fb: jax.Array, tick_ms: jax.Array, roll_quality: jax.Array
+) -> TickInputs:
     """Device-side (traced): stacked arrays → TickInputs."""
     fields = {}
     for i, name in enumerate(PKT_FIELDS):
@@ -420,6 +559,7 @@ def unpack_tick_inputs(pkt: jax.Array, fb: jax.Array, tick_ms: jax.Array) -> Tic
         estimate_valid=fb[1] > 0.5,
         nacks=fb[2],
         tick_ms=tick_ms,
+        roll_quality=roll_quality,
     )
 
 
@@ -453,8 +593,15 @@ def unpack_tick_outputs(buf, dims: PlaneDims, egress_cap: int) -> TickOutputs:
         "target_layers": (R, S, T),
         "fwd_packets": (R,),
         "fwd_bytes": (R,),
+        "track_mos": (R, T),
+        "track_quality": (R, T),
+        "sub_quality": (R, S),
+        "layer_live": (R, T, MAX_LAYERS),
+        "track_loss_pct": (R, T),
+        "track_jitter_ms": (R, T),
+        "track_bps": (R, T),
     }
-    floats = {"speaker_levels"}
+    floats = {"speaker_levels", "track_mos", "track_loss_pct", "track_jitter_ms", "track_bps"}
     bools = {"need_keyframe", "congested"}
     buf = np.asarray(buf)
     pieces, off = {}, 0

@@ -73,6 +73,7 @@ def make_meta_ctrl(dims: plane.PlaneDims, spec: TrafficSpec):
         is_video=is_video,
         published=published,
         pub_muted=np.zeros((R, T), bool),
+        is_svc=np.zeros((R, T), bool),
     )
     ctrl = plane.SubControl(
         subscribed=np.broadcast_to(published[:, :, None], (R, T, S)).copy(),
@@ -176,6 +177,11 @@ def next_tick(
     def full(x, dtype):
         return np.broadcast_to(x, (R, T, K)).astype(dtype)
 
+    # Last generated packet of each track's tick is the frame end (coarse
+    # marker-bit model; exact per-frame markers come from the wire parser).
+    end_frame = valid & ~np.roll(valid, -1, axis=-1)
+    end_frame[..., -1] = valid[..., -1]
+
     inp = plane.TickInputs(
         sn=full(sn, np.int32),
         ts=full(ts, np.int32),
@@ -184,6 +190,7 @@ def next_tick(
         keyframe=full(keyframe, bool),
         layer_sync=full(layer_sync, bool),
         begin_pic=full(begin_pic | ~is_video[None, :, None], bool),
+        end_frame=full(end_frame, bool),
         pid=full(pid, np.int32),
         tl0=full(tl0, np.int32),
         keyidx=np.zeros((R, T, K), np.int32),
@@ -196,6 +203,7 @@ def next_tick(
         estimate_valid=np.ones((R, S), bool),
         nacks=np.zeros((R, S), np.float32),
         tick_ms=np.int32(spec.tick_ms),
+        roll_quality=np.int32(0),
     )
     new_state = TrafficState(
         sn=new_sn, ts=new_ts, pid=(state.pid + pid_inc) & 0x7FFF,

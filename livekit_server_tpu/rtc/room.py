@@ -53,6 +53,9 @@ class Room:
         self.on_track_published: list[Callable] = []
         self._on_close: list[Callable[[], None]] = []
         self._active_speakers: list[dict] = []
+        from livekit_server_tpu.rtc.dynacast import DynacastState
+
+        self.dynacast = DynacastState()
 
     # -- join / leave (room.go Join :313) ---------------------------------
     def join(self, participant: Participant) -> dict:
@@ -126,12 +129,19 @@ class Room:
         track = PublishedTrack(info=info, track_col=col)
         self.tracks[info.sid] = (publisher, track)
         self.col_to_sid[col] = info.sid
+        # SVC codecs (VP9/AV1) carry all spatial layers in one stream and
+        # take the onion-selection path on device (receiver.go IsSvcCodec).
+        mime = (info.mime_type or "").lower()
+        is_svc = info.type == pm.TrackType.VIDEO and (
+            "vp9" in mime or "av1" in mime
+        )
         self.runtime.set_track(
             self.slots.row,
             col,
             published=True,
             is_video=info.type == pm.TrackType.VIDEO,
             pub_muted=info.muted,
+            is_svc=is_svc,
         )
         if self.udp is not None:
             self.udp.set_track_kind(self.slots.row, col, info.type == pm.TrackType.VIDEO)
@@ -318,6 +328,65 @@ class Room:
         p = self.sub_index.get(pkt.sub)
         if p is not None:
             p.deliver_media(pkt)
+
+    def handle_quality(self, track_quality, track_mos, sub_quality) -> None:
+        """Per-window connection-quality fan-out (room.go:1318-1396
+        connectionQualityWorker): each participant's quality = worst of its
+        published tracks' E-model scores and its subscriber-side state,
+        broadcast as a connection_quality update."""
+        updates = []
+        from livekit_server_tpu.ops.quality import QUALITY_EXCELLENT, QUALITY_LOST
+
+        for p in self.participants.values():
+            qs: list[int] = []
+            scores: list[float] = []
+            for sid in p.published:
+                ent = self.tracks.get(sid)
+                if ent is None:
+                    continue
+                col = ent[1].track_col
+                qs.append(int(track_quality[col]))
+                scores.append(float(track_mos[col]))
+            if p.sub_col >= 0 and p.subscribed_tracks:
+                qs.append(int(sub_quality[p.sub_col]))
+            # LOST only dominates when everything is LOST
+            # (ParticipantImpl.GetConnectionQuality aggregation).
+            live = [q for q in qs if q != QUALITY_LOST]
+            if qs and not live:
+                q = QUALITY_LOST
+            elif live:
+                q = min(live)
+            else:
+                q = QUALITY_EXCELLENT  # signal-only participant
+            updates.append(
+                {
+                    "participant_sid": p.sid,
+                    "quality": q,
+                    "score": round(min(scores), 2) if scores else 5.0,
+                }
+            )
+        if not updates:
+            return
+        for p in self.participants.values():
+            p.send("connection_quality", {"updates": updates})
+
+    def reconcile_dynacast(self) -> None:
+        """Aggregate subscriber layer demand → subscribed_quality_update to
+        publishers so they stop encoding unwatched simulcast layers
+        (dynacastmanager.go:187-255; debounced downgrades inside
+        rtc.dynacast.reconcile)."""
+        from livekit_server_tpu.rtc.dynacast import reconcile
+
+        for publisher, sid, maxq in reconcile(self.dynacast, self):
+            publisher.send(
+                "subscribed_quality_update",
+                {
+                    "track_sid": sid,
+                    "subscribed_qualities": [
+                        {"quality": q, "enabled": q <= maxq} for q in range(3)
+                    ],
+                },
+            )
 
     # -- lifecycle --------------------------------------------------------
     @property

@@ -80,6 +80,7 @@ class IngestBuffer:
         self.keyframe = self._bool()
         self.layer_sync = self._bool()
         self.begin_pic = self._bool()
+        self.end_frame = self._bool()
         self.pid = self._i32()
         self.tl0 = self._i32()
         self.keyidx = self._i32()
@@ -104,6 +105,7 @@ class IngestBuffer:
         self.keyframe[r, t, k] = pkt.keyframe
         self.layer_sync[r, t, k] = pkt.layer_sync
         self.begin_pic[r, t, k] = pkt.begin_pic
+        self.end_frame[r, t, k] = pkt.marker
         self.pid[r, t, k] = pkt.pid
         self.tl0[r, t, k] = pkt.tl0
         self.keyidx[r, t, k] = pkt.keyidx
@@ -126,12 +128,15 @@ class IngestBuffer:
         if nacks:
             self._nacks[room, sub] += nacks
 
-    def drain(self) -> tuple[plane.TickInputs, dict[tuple[int, int, int], bytes]]:
+    def drain(
+        self, roll_quality: bool = False
+    ) -> tuple[plane.TickInputs, dict[tuple[int, int, int], bytes]]:
         """Snapshot this tick's tensors and reset for the next tick."""
         inp = plane.TickInputs(
             sn=self.sn.copy(), ts=self.ts.copy(), layer=self.layer.copy(),
             temporal=self.temporal.copy(), keyframe=self.keyframe.copy(),
             layer_sync=self.layer_sync.copy(), begin_pic=self.begin_pic.copy(),
+            end_frame=self.end_frame.copy(),
             pid=self.pid.copy(), tl0=self.tl0.copy(), keyidx=self.keyidx.copy(),
             size=self.size.copy(), frame_ms=self.frame_ms.copy(),
             audio_level=self.audio_level.copy(),
@@ -140,6 +145,7 @@ class IngestBuffer:
             estimate_valid=self._estimate_valid.copy(),
             nacks=self._nacks.copy(),
             tick_ms=np.int32(self.tick_ms),
+            roll_quality=np.int32(1 if roll_quality else 0),
         )
         payloads = self._payloads
         self._payloads = {}

@@ -67,6 +67,16 @@ class TickResult:
     fwd_packets: int
     fwd_bytes: int
     tick_s: float                                    # wall time of the step
+    # Quality / stats tensors (numpy views of TickOutputs; consumers index
+    # by room row). None until the first tick completes.
+    track_quality: Any = None     # [R, T] int32 ConnectionQuality enum
+    track_mos: Any = None         # [R, T] float32
+    sub_quality: Any = None       # [R, S] int32
+    layer_live: Any = None        # [R, T, L] int32
+    track_loss_pct: Any = None    # [R, T] float32
+    track_jitter_ms: Any = None   # [R, T] float32
+    track_bps: Any = None         # [R, T] float32
+    quality_window_closed: bool = False  # this tick rolled the stats window
 
 
 @functools.lru_cache(maxsize=None)
@@ -74,8 +84,8 @@ def _build_step(audio_params, bwe_params, egress_cap):
     """Packed-wire step: ONE input upload, ONE output fetch per tick
     (plane.pack_tick_inputs / pack_tick_outputs)."""
 
-    def tick(state, pkt, fb, tick_ms):
-        inp = plane.unpack_tick_inputs(pkt, fb, tick_ms)
+    def tick(state, pkt, fb, tick_ms, roll_quality):
+        inp = plane.unpack_tick_inputs(pkt, fb, tick_ms, roll_quality)
         state, out = plane.media_plane_tick(
             state, inp, audio_params, bwe_params, egress_cap=egress_cap
         )
@@ -114,6 +124,7 @@ class PlaneRuntime:
             is_video=np.zeros((R, T), bool),
             published=np.zeros((R, T), bool),
             pub_muted=np.zeros((R, T), bool),
+            is_svc=np.zeros((R, T), bool),
         )
         self.ctrl = plane.SubControl(
             subscribed=np.zeros((R, T, S), bool),
@@ -148,10 +159,11 @@ class PlaneRuntime:
 
     # -- control-plane mutation API (host mirrors; applied at tick edge) --
     def set_track(self, room: int, track: int, *, published: bool, is_video: bool,
-                  pub_muted: bool = False) -> None:
+                  pub_muted: bool = False, is_svc: bool = False) -> None:
         self.meta.published[room, track] = published
         self.meta.is_video[room, track] = is_video
         self.meta.pub_muted[room, track] = pub_muted
+        self.meta.is_svc[room, track] = is_svc
         if not published:
             # Free the columns' subscriber state implicitly: masks go false.
             self.ctrl.subscribed[room, track, :] = False
@@ -200,8 +212,8 @@ class PlaneRuntime:
         if self._mesh is not None:
             self.state, out = self._step(self.state, inp)
             return jax.tree.map(np.asarray, out)
-        pkt, fb, tick_ms = plane.pack_tick_inputs(inp)
-        self.state, buf = self._step(self.state, pkt, fb, tick_ms)
+        pkt, fb, tick_ms, roll = plane.pack_tick_inputs(inp)
+        self.state, buf = self._step(self.state, pkt, fb, tick_ms, roll)
         return plane.unpack_tick_outputs(np.asarray(buf), self.dims, self.egress_cap)
 
     async def step_once(self) -> TickResult:
@@ -210,10 +222,15 @@ class PlaneRuntime:
         t0 = time.perf_counter()
         if self._ctrl_dirty:
             self._upload_ctrl()
-        inp, payloads = self.ingest.drain()
+        # Close the quality/stats window about once per second
+        # (connectionquality windows; room.go:1318 worker cadence).
+        q_ticks = max(1, 1000 // self.tick_ms)
+        roll = (self.tick_index + 1) % q_ticks == 0
+        inp, payloads = self.ingest.drain(roll_quality=roll)
         loop = asyncio.get_running_loop()
         out = await loop.run_in_executor(self._executor, self._device_step, inp)
         result = self._fan_out(out, payloads, time.perf_counter() - t0)
+        result.quality_window_closed = roll
         self.tick_index += 1
         self.stats["ticks"] += 1
         self.stats["fwd_packets"] += result.fwd_packets
@@ -283,6 +300,13 @@ class PlaneRuntime:
             fwd_packets=int(out.fwd_packets.sum()),
             fwd_bytes=int(out.fwd_bytes.sum()),
             tick_s=tick_s,
+            track_quality=out.track_quality,
+            track_mos=out.track_mos,
+            sub_quality=out.sub_quality,
+            layer_live=out.layer_live,
+            track_loss_pct=out.track_loss_pct,
+            track_jitter_ms=out.track_jitter_ms,
+            track_bps=out.track_bps,
         )
 
     # -- loop ------------------------------------------------------------

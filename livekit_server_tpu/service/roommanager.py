@@ -295,6 +295,14 @@ class RoomManager:
             room = self._row_to_room.get(row)
             if room is not None:
                 room.handle_keyframe_request(track_col)
+        if res.quality_window_closed and res.track_quality is not None:
+            # ~1/s: connection-quality fan-out + dynacast reconciliation
+            # (room.go:1318 connectionQualityWorker; dynacastmanager.go).
+            for row, room in self._row_to_room.items():
+                room.handle_quality(
+                    res.track_quality[row], res.track_mos[row], res.sub_quality[row]
+                )
+                room.reconcile_dynacast()
         if self.telemetry is not None:
             self.telemetry.observe_plane(self.runtime.stats)
 

@@ -40,6 +40,7 @@ def make_inputs(dims: plane.PlaneDims, **over):
         sn=z(jnp.int32), ts=z(jnp.int32), layer=z(jnp.int32), temporal=z(jnp.int32),
         keyframe=z(jnp.bool_), layer_sync=jnp.ones((R, T, K), jnp.bool_),
         begin_pic=jnp.ones((R, T, K), jnp.bool_),
+        end_frame=jnp.ones((R, T, K), jnp.bool_),
         pid=z(jnp.int32), tl0=z(jnp.int32), keyidx=z(jnp.int32),
         size=z(jnp.int32), frame_ms=jnp.full((R, T, K), 20, jnp.int32),
         audio_level=jnp.full((R, T, K), 127, jnp.int32),
@@ -48,6 +49,7 @@ def make_inputs(dims: plane.PlaneDims, **over):
         estimate_valid=jnp.zeros((R, S), jnp.bool_),
         nacks=jnp.zeros((R, S), jnp.float32),
         tick_ms=jnp.int32(20),
+        roll_quality=jnp.int32(0),
     )
     return inp._replace(**over)
 
@@ -148,6 +150,7 @@ def video_room_state():
             is_video=jnp.ones((1, 1), jnp.bool_),
             published=jnp.ones((1, 1), jnp.bool_),
             pub_muted=jnp.zeros((1, 1), jnp.bool_),
+            is_svc=jnp.zeros((1, 1), jnp.bool_),
         ),
         ctrl=st.ctrl._replace(subscribed=jnp.ones((1, 1, 3), jnp.bool_)),
     )
@@ -201,6 +204,166 @@ def test_simulcast_keyframe_lockon_and_munge():
     assert send[0, 0] and send[2, 1] and send[2, 2]
     assert int(out.out_sn[0, 0, 0, 0]) == 101
     assert not np.asarray(out.need_keyframe).any()
+
+
+def svc_room_state():
+    """1 SVC (VP9-style) video track, 2 subscribers."""
+    dims = plane.PlaneDims(rooms=1, tracks=1, pkts=3, subs=2)
+    st = plane.init_state(dims)
+    st = st._replace(
+        meta=plane.TrackMeta(
+            is_video=jnp.ones((1, 1), jnp.bool_),
+            published=jnp.ones((1, 1), jnp.bool_),
+            pub_muted=jnp.zeros((1, 1), jnp.bool_),
+            is_svc=jnp.ones((1, 1), jnp.bool_),
+        ),
+        ctrl=st.ctrl._replace(subscribed=jnp.ones((1, 1, 2), jnp.bool_)),
+    )
+    return dims, st
+
+
+def test_svc_onion_forwarding():
+    """SVC tracks forward ALL spatial layers <= current (onion), unlike
+    simulcast which forwards exactly one (videolayerselector/vp9.go:43)."""
+    dims, st = svc_room_state()
+    # sub0 capped at spatial 0, sub1 wants the full onion.
+    st = st._replace(
+        sel=st.sel._replace(target_spatial=jnp.asarray([[[0, 2]]], jnp.int32)),
+        ctrl=st.ctrl._replace(max_spatial=jnp.asarray([[[0, 2]]], jnp.int32)),
+    )
+    step = dense_step(jax.jit(plane.media_plane_tick), dims)
+
+    # Keyframe picture carrying spatial layers 0..2 in one stream.
+    inp = make_inputs(
+        dims,
+        sn=jnp.asarray([[[100, 101, 102]]], jnp.int32),
+        ts=jnp.full((1, 1, 3), 90, jnp.int32),
+        layer=jnp.asarray([[[0, 1, 2]]], jnp.int32),
+        keyframe=jnp.ones((1, 1, 3), jnp.bool_),
+        size=jnp.full((1, 1, 3), 500, jnp.int32),
+        valid=jnp.ones((1, 1, 3), jnp.bool_),
+    )
+    st, out = step(st, inp)
+    send = np.asarray(out.send)[0, 0]  # [K, S]
+    # sub0: only spatial 0; sub1: all three layers of the onion.
+    assert send[0, 0] and not send[1, 0] and not send[2, 0]
+    assert send[0, 1] and send[1, 1] and send[2, 1]
+    # Single SN space: munged SNs stay contiguous for the full-onion sub.
+    assert [int(out.out_sn[0, 0, k, 1]) for k in range(3)] == [100, 101, 102]
+
+    # Delta picture: same onion behavior without keyframes.
+    inp2 = make_inputs(
+        dims,
+        sn=jnp.asarray([[[103, 104, 105]]], jnp.int32),
+        ts=jnp.full((1, 1, 3), 3090, jnp.int32),
+        layer=jnp.asarray([[[0, 1, 2]]], jnp.int32),
+        size=jnp.full((1, 1, 3), 500, jnp.int32),
+        valid=jnp.ones((1, 1, 3), jnp.bool_),
+    )
+    st, out = step(st, inp2)
+    send = np.asarray(out.send)[0, 0]
+    assert send[0, 0] and not send[2, 0]
+    assert send[0, 1] and send[1, 1] and send[2, 1]
+    # sub0 dropped layers 1-2 compact its SN space: next SN follows 100.
+    assert int(out.out_sn[0, 0, 0, 0]) == 101
+
+
+def test_quality_outputs_and_window_roll():
+    """Clean stream scores EXCELLENT; heavy loss scores worse; rolling the
+    window resets the accumulators (scorer.go E-model + windows)."""
+    dims, st = two_party_audio_state()
+    step = dense_step(jax.jit(plane.media_plane_tick), dims)
+    # 10 clean ticks.
+    for i in range(10):
+        inp = make_inputs(
+            dims,
+            sn=jnp.asarray([[[i], [i]]], jnp.int32),
+            size=jnp.full((1, 2, 1), 120, jnp.int32),
+            valid=jnp.ones((1, 2, 1), jnp.bool_),
+        )
+        st, out = step(st, inp)
+    assert int(out.raw.track_quality[0, 0]) == 2  # EXCELLENT
+    assert float(out.raw.track_mos[0, 0]) > 4.1
+    assert float(out.raw.track_loss_pct[0, 0]) == 0.0
+
+    # Roll the window, then deliver 1-in-5 packets (80% loss).
+    inp = make_inputs(dims, roll_quality=jnp.int32(1))
+    st, out = step(st, inp)
+    for i in range(10):
+        inp = make_inputs(
+            dims,
+            sn=jnp.asarray([[[10 + 5 * i], [10 + i]]], jnp.int32),
+            size=jnp.full((1, 2, 1), 120, jnp.int32),
+            valid=jnp.ones((1, 2, 1), jnp.bool_),
+        )
+        st, out = step(st, inp)
+    assert float(out.raw.track_loss_pct[0, 0]) > 50.0
+    assert int(out.raw.track_quality[0, 0]) == 0  # POOR
+    assert int(out.raw.track_quality[0, 1]) == 2  # clean track unaffected
+
+
+def test_svc_single_stream_stats_no_false_loss():
+    """An SVC track interleaves spatial layers in ONE SN space; stats must
+    fold into one stream row, or healthy traffic reads as ~66% loss."""
+    dims, st = svc_room_state()
+    step = dense_step(jax.jit(plane.media_plane_tick), dims)
+    for i in range(10):
+        inp = make_inputs(
+            dims,
+            sn=jnp.asarray([[[100 + 3 * i, 101 + 3 * i, 102 + 3 * i]]], jnp.int32),
+            layer=jnp.asarray([[[0, 1, 2]]], jnp.int32),
+            keyframe=jnp.full((1, 1, 3), i == 0, jnp.bool_),
+            size=jnp.asarray([[[300, 600, 900]]], jnp.int32),
+            valid=jnp.ones((1, 1, 3), jnp.bool_),
+        )
+        st, out = step(st, inp)
+    assert float(out.raw.track_loss_pct[0, 0]) == 0.0
+    assert int(out.raw.track_quality[0, 0]) == 2  # EXCELLENT
+    # Onion cost: the allocator's layer-2 entry covers layers 0+1+2, so the
+    # per-subscriber target cost is the full track bitrate, not layer 2's.
+    bps = float(out.raw.track_bps[0, 0])
+    assert bps > 0
+
+
+def test_pub_muted_track_not_lost():
+    """A muted publisher sends nothing by design — quality must not read
+    LOST (connectionstats.go excludes muted tracks)."""
+    dims, st = two_party_audio_state()
+    st = st._replace(meta=st.meta._replace(pub_muted=jnp.asarray([[True, False]])))
+    step = dense_step(jax.jit(plane.media_plane_tick), dims)
+    for i in range(5):
+        inp = make_inputs(
+            dims,
+            sn=jnp.asarray([[[0], [i]]], jnp.int32),
+            size=jnp.full((1, 2, 1), 120, jnp.int32),
+            valid=jnp.asarray([[[False], [True]]], jnp.bool_),
+        )
+        st, out = step(st, inp)
+    assert int(out.raw.track_quality[0, 0]) == 2  # muted ⇒ EXCELLENT, not LOST
+    assert int(out.raw.track_quality[0, 1]) == 2
+
+
+def test_measured_bitrate_matrix():
+    """The allocator's bitrate matrix comes from measured per-layer bytes
+    (streamtracker), not hardcoded fractions."""
+    dims, st = video_room_state()
+    step = dense_step(jax.jit(plane.media_plane_tick), dims)
+    # ~600ms of traffic at 20ms ticks: layer sizes 300/600/900 bytes.
+    for i in range(30):
+        inp = make_inputs(
+            dims,
+            sn=jnp.asarray([[[100 + 3 * i, 5000 + 3 * i, 9000 + 3 * i]]], jnp.int32),
+            layer=jnp.asarray([[[0, 1, 2]]], jnp.int32),
+            keyframe=jnp.full((1, 1, 3), i == 0, jnp.bool_),
+            size=jnp.asarray([[[300, 600, 900]]], jnp.int32),
+            valid=jnp.ones((1, 1, 3), jnp.bool_),
+        )
+        st, out = step(st, inp)
+    # All three layers live after the tracker cycles.
+    assert np.asarray(out.raw.layer_live)[0, 0].tolist() == [1, 1, 1]
+    # Track bitrate reflects the 1800 B/tick → ~720 kbps load.
+    bps = float(out.raw.track_bps[0, 0])
+    assert 4e5 < bps < 1.1e6, bps
 
 
 def test_multi_room_vmap_isolation():

@@ -278,6 +278,80 @@ async def test_ping_pong_and_metadata(runtime):
     assert alice.metadata == "m2" and alice.name == "Alice"
 
 
+async def test_connection_quality_signal(runtime):
+    """handle_quality broadcasts per-participant connection_quality built
+    from device scores (room.go:1318 connectionQualityWorker)."""
+    import numpy as np
+
+    room = Room("q", runtime)
+    alice, a_sink = make_participant(room, "alice")
+    bob, b_sink = make_participant(room, "bob")
+    room.join(alice)
+    room.join(bob)
+    track = publish_audio(room, alice)
+    col = track.track_col
+
+    track_quality = np.full((DIMS.tracks,), 3, np.int32)
+    track_quality[col] = 2
+    track_mos = np.full((DIMS.tracks,), 1.0, np.float32)
+    track_mos[col] = 4.4
+    sub_quality = np.full((DIMS.subs,), 2, np.int32)
+    room.handle_quality(track_quality, track_mos, sub_quality)
+
+    msgs = [m for m in drain_sink(b_sink) if m.kind == "connection_quality"]
+    assert msgs, "no connection_quality broadcast"
+    updates = {u["participant_sid"]: u for u in msgs[-1].data["updates"]}
+    assert updates[alice.sid]["quality"] == 2
+    assert updates[alice.sid]["score"] == 4.4
+    # bob publishes nothing; his quality comes from the subscriber side
+    assert updates[bob.sid]["quality"] == 2
+
+
+async def test_quality_window_rolls_in_runtime(runtime):
+    """The runtime closes the stats window about once a second and carries
+    quality tensors in TickResult."""
+    closed = 0
+    for _ in range(1000 // runtime.tick_ms + 1):
+        res = await runtime.step_once()
+        closed += res.quality_window_closed
+    assert closed >= 1
+    assert res.track_quality is not None
+    assert res.track_quality.shape == (DIMS.rooms, DIMS.tracks)
+
+
+async def test_dynacast_subscribed_quality_update(runtime):
+    """Subscriber caps aggregate to a subscribed_quality_update for the
+    publisher; upgrades fire immediately (dynacastmanager.go:187-255)."""
+    room = Room("dyn", runtime)
+    alice, a_sink = make_participant(room, "alice")
+    bob, _ = make_participant(room, "bob")
+    room.join(alice)
+    room.join(bob)
+    handle_participant_signal(
+        room, alice,
+        SignalRequest("add_track", {"cid": "cam", "type": 1, "name": "v"}),
+    )
+    track = alice.publish_pending("cam")
+    assert track is not None
+    # bob (the only subscriber) caps the track at quality 0
+    room.update_track_settings(bob, track.info.sid, {"quality": 0})
+    room.reconcile_dynacast()
+    msgs = [m for m in drain_sink(a_sink) if m.kind == "subscribed_quality_update"]
+    assert msgs
+    upd = msgs[-1].data
+    assert upd["track_sid"] == track.info.sid
+    enabled = {q["quality"]: q["enabled"] for q in upd["subscribed_qualities"]}
+    assert enabled == {0: True, 1: False, 2: False}
+
+    # raising the cap re-enables layers immediately (no debounce on up)
+    room.update_track_settings(bob, track.info.sid, {"quality": 2})
+    room.reconcile_dynacast()
+    msgs = [m for m in drain_sink(a_sink) if m.kind == "subscribed_quality_update"]
+    assert msgs
+    enabled = {q["quality"]: q["enabled"] for q in msgs[-1].data["subscribed_qualities"]}
+    assert enabled == {0: True, 1: True, 2: True}
+
+
 async def test_checkpoint_restore_mid_stream(runtime):
     """Munger state survives snapshot/restore (migration seeding, §5.4)."""
     room = Room("ckpt", runtime)
