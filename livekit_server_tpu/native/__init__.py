@@ -68,6 +68,12 @@ class _NativeRTP:
             ctypes.c_void_p, ctypes.c_void_p, ctypes.c_int,
             ctypes.c_void_p, ctypes.c_void_p, ctypes.c_void_p,
         ]
+        self.lib.rewrite_rtp_vp8_batch.restype = None
+        self.lib.rewrite_rtp_vp8_batch.argtypes = [
+            ctypes.c_void_p, ctypes.c_void_p, ctypes.c_void_p, ctypes.c_int,
+            ctypes.c_void_p, ctypes.c_void_p, ctypes.c_void_p,
+            ctypes.c_void_p, ctypes.c_void_p, ctypes.c_void_p, ctypes.c_void_p,
+        ]
         self.native = True
 
     def parse_batch(
@@ -100,6 +106,27 @@ class _NativeRTP:
             np.ascontiguousarray(sns, np.uint16).ctypes.data,
             np.ascontiguousarray(tss, np.uint32).ctypes.data,
             np.ascontiguousarray(ssrcs, np.uint32).ctypes.data,
+        )
+
+    def rewrite_vp8_batch(
+        self, buf: bytearray, offsets, lengths, sns, tss, ssrcs,
+        pids, tl0s, keyidxs, vp8_flags,
+    ) -> None:
+        """Header + VP8 payload-descriptor rewrite (codecmunger/vp8.go:161):
+        picture-id (width-preserving 7/15-bit), TL0PICIDX, KEYIDX patched
+        in place from the device munger's per-(packet, subscriber) outputs."""
+        b = np.frombuffer(buf, np.uint8)
+        offs = np.ascontiguousarray(offsets, np.int32)
+        self.lib.rewrite_rtp_vp8_batch(
+            b.ctypes.data, offs.ctypes.data,
+            np.ascontiguousarray(lengths, np.int32).ctypes.data, len(offs),
+            np.ascontiguousarray(sns, np.uint16).ctypes.data,
+            np.ascontiguousarray(tss, np.uint32).ctypes.data,
+            np.ascontiguousarray(ssrcs, np.uint32).ctypes.data,
+            np.ascontiguousarray(pids, np.int32).ctypes.data,
+            np.ascontiguousarray(tl0s, np.int32).ctypes.data,
+            np.ascontiguousarray(keyidxs, np.int32).ctypes.data,
+            np.ascontiguousarray(vp8_flags, np.uint8).ctypes.data,
         )
 
 
@@ -202,6 +229,64 @@ class _PythonRTP:
             buf[off + 2 : off + 4] = int(sn).to_bytes(2, "big")
             buf[off + 4 : off + 8] = int(ts).to_bytes(4, "big")
             buf[off + 8 : off + 12] = int(ssrc).to_bytes(4, "big")
+
+    def rewrite_vp8_batch(
+        self, buf, offsets, lengths, sns, tss, ssrcs, pids, tl0s, keyidxs, vp8_flags
+    ):
+        for i, off in enumerate(offsets):
+            off, ln = int(off), int(lengths[i])
+            if ln < 12:
+                continue  # same skip as native: never write past a runt
+            buf[off + 2 : off + 4] = int(sns[i]).to_bytes(2, "big")
+            buf[off + 4 : off + 8] = int(tss[i]).to_bytes(4, "big")
+            buf[off + 8 : off + 12] = int(ssrcs[i]).to_bytes(4, "big")
+            if not vp8_flags[i]:
+                continue
+            p = buf[off : off + ln]
+            cc = p[0] & 0x0F
+            q = 12 + cc * 4
+            if (p[0] >> 4) & 1:  # extension
+                if q + 4 > len(p):
+                    continue
+                q += 4 + int.from_bytes(p[q + 2 : q + 4], "big") * 4
+            if q >= len(p):
+                continue
+            d = off + q  # descriptor start in buf
+            b0 = buf[d]
+            if not (b0 & 0x80):
+                continue
+            j = d + 1
+            if j >= off + ln:
+                continue
+            xb = buf[j]
+            j += 1
+            pid, tl0, kidx = int(pids[i]), int(tl0s[i]), int(keyidxs[i])
+            if xb & 0x80:  # I
+                if j >= off + ln:
+                    continue
+                if buf[j] & 0x80:  # 15-bit
+                    if j + 1 >= off + ln:
+                        continue
+                    if pid >= 0:
+                        buf[j] = 0x80 | ((pid >> 8) & 0x7F)
+                        buf[j + 1] = pid & 0xFF
+                    j += 2
+                else:
+                    if pid >= 0:
+                        buf[j] = pid & 0x7F
+                    j += 1
+            if xb & 0x40:  # L
+                if j >= off + ln:
+                    continue
+                if tl0 >= 0:
+                    buf[j] = tl0 & 0xFF
+                j += 1
+            if xb & 0x30:  # T or K
+                if j >= off + ln:
+                    continue
+                if kidx >= 0:
+                    buf[j] = (buf[j] & 0xE0) | (kidx & 0x1F)
+                j += 1
 
 
 def _load():

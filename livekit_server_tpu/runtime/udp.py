@@ -255,8 +255,9 @@ class UDPMediaTransport(asyncio.DatagramProtocol):
 
     def send_egress(self, packets) -> None:
         """Rewrite + send a tick's EgressPackets: assemble all datagrams in
-        one buffer, ONE native rewrite_batch call, then sendto per datagram
-        (the batched write half of DownTrack.WriteRTP + pacer)."""
+        one buffer, ONE native rewrite call (headers + VP8 payload
+        descriptors), then sendto per datagram (the batched write half of
+        DownTrack.WriteRTP + pacer)."""
         if self.transport is None:
             return
         buf = bytearray()
@@ -265,6 +266,10 @@ class UDPMediaTransport(asyncio.DatagramProtocol):
         sns: list[int] = []
         tss: list[int] = []
         ssrcs: list[int] = []
+        pids: list[int] = []
+        tl0s: list[int] = []
+        keyidxs: list[int] = []
+        vp8_flags: list[int] = []
         addrs: list[tuple] = []
         for pkt in packets:
             addr = self.sub_addrs.get((pkt.room, pkt.sub))
@@ -280,15 +285,27 @@ class UDPMediaTransport(asyncio.DatagramProtocol):
             sns.append(pkt.sn)
             tss.append(pkt.ts)
             ssrcs.append(self.subscriber_ssrc(pkt.room, pkt.sub, pkt.track))
+            # Device-munged VP8 descriptor values reach the wire here
+            # (codecmunger/vp8.go:161): after a simulcast switch or
+            # temporal drop, receivers need contiguous picture ids.
+            pids.append(pkt.pid if is_video else -1)
+            tl0s.append(pkt.tl0 if is_video else -1)
+            keyidxs.append(pkt.keyidx if is_video else -1)
+            vp8_flags.append(1 if is_video else 0)
             addrs.append(addr)
         if not offsets:
             return
-        rtp.rewrite_batch(
+        rtp.rewrite_vp8_batch(
             buf,
             np.asarray(offsets, np.int32),
+            np.asarray(lengths, np.int32),
             np.asarray(sns, np.uint16),
             np.asarray(tss, np.uint32),
             np.asarray(ssrcs, np.uint32),
+            np.asarray(pids, np.int32),
+            np.asarray(tl0s, np.int32),
+            np.asarray(keyidxs, np.int32),
+            np.asarray(vp8_flags, np.uint8),
         )
         view = memoryview(buf)
         for off, ln, addr in zip(offsets, lengths, addrs):

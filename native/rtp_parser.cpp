@@ -172,4 +172,81 @@ void rewrite_rtp_batch(uint8_t* buf, const int32_t* offsets, int n,
   }
 }
 
+// Full egress rewrite: SN/TS/SSRC header patch plus, for packets flagged
+// vp8, an in-place rewrite of the VP8 payload descriptor's picture-id /
+// TL0PICIDX / KEYIDX from the device munger's outputs — the byte-level
+// half of codecmunger/vp8.go:161 UpdateAndGet. Field widths are preserved
+// (a 7-bit picture-id slot takes the low 7 bits, a 15-bit slot the low
+// 15; both remain contiguous because the munged sequence is contiguous),
+// since an in-place rewrite cannot grow the descriptor. pid/tl0/keyidx
+// values < 0 skip that field; fields absent from the descriptor are left
+// untouched.
+void rewrite_rtp_vp8_batch(uint8_t* buf, const int32_t* offsets,
+                           const int32_t* lengths, int n,
+                           const uint16_t* sns, const uint32_t* tss,
+                           const uint32_t* ssrcs, const int32_t* pids,
+                           const int32_t* tl0s, const int32_t* keyidxs,
+                           const uint8_t* vp8_flags) {
+  for (int i = 0; i < n; i++) {
+    uint8_t* p = buf + offsets[i];
+    int len = lengths[i];
+    if (len < 12) continue;
+    p[2] = sns[i] >> 8;
+    p[3] = sns[i] & 0xFF;
+    p[4] = tss[i] >> 24; p[5] = (tss[i] >> 16) & 0xFF;
+    p[6] = (tss[i] >> 8) & 0xFF; p[7] = tss[i] & 0xFF;
+    p[8] = ssrcs[i] >> 24; p[9] = (ssrcs[i] >> 16) & 0xFF;
+    p[10] = (ssrcs[i] >> 8) & 0xFF; p[11] = ssrcs[i] & 0xFF;
+    if (!vp8_flags[i]) continue;
+
+    // Locate the payload (same walk as the parser: CSRCs + extension).
+    int cc = p[0] & 0x0F;
+    bool has_ext = (p[0] >> 4) & 1;
+    int off = 12 + cc * 4;
+    if (off > len) continue;
+    if (has_ext) {
+      if (off + 4 > len) continue;
+      int ext_words = (p[off + 2] << 8) | p[off + 3];
+      off += 4 + ext_words * 4;
+      if (off > len) continue;
+    }
+    uint8_t* d = p + off;
+    int dl = len - off;
+    if (dl < 1) continue;
+
+    // Walk + patch the VP8 payload descriptor (RFC 7741).
+    int q = 0;
+    uint8_t b0 = d[q++];
+    if (!(b0 & 0x80)) continue;  // no X ⇒ no pid/tl0/keyidx fields
+    if (q >= dl) continue;
+    uint8_t xb = d[q++];
+    bool I = xb & 0x80, L = xb & 0x40, T = xb & 0x20, K = xb & 0x10;
+    if (I) {
+      if (q >= dl) continue;
+      if (d[q] & 0x80) {  // 15-bit picture id
+        if (q + 1 >= dl) continue;
+        if (pids[i] >= 0) {
+          d[q] = 0x80 | ((pids[i] >> 8) & 0x7F);
+          d[q + 1] = pids[i] & 0xFF;
+        }
+        q += 2;
+      } else {  // 7-bit picture id
+        if (pids[i] >= 0) d[q] = pids[i] & 0x7F;
+        q += 1;
+      }
+    }
+    if (L) {
+      if (q >= dl) continue;
+      if (tl0s[i] >= 0) d[q] = tl0s[i] & 0xFF;
+      q += 1;
+    }
+    if (T || K) {
+      if (q >= dl) continue;
+      // Preserve TID/Y (packet-intrinsic), replace KEYIDX (munged).
+      if (keyidxs[i] >= 0) d[q] = (d[q] & 0xE0) | (keyidxs[i] & 0x1F);
+      q += 1;
+    }
+  }
+}
+
 }  // extern "C"

@@ -141,6 +141,90 @@ def test_rewrite_batch():
     assert int(out["ssrc"][0]) == 0xDEAD
 
 
+def test_rewrite_vp8_batch_patches_descriptor():
+    """The egress rewrite must patch picture-id/TL0PICIDX/KEYIDX inside the
+    VP8 payload descriptor (codecmunger/vp8.go:161), preserving TID/Y bits
+    and the VP8 bitstream bytes after the descriptor."""
+    pay15 = vp8_payload(pid=3000, tl0=7, tid=1, ysync=1, keyidx=4)
+    pay7 = vp8_payload(pid=90, tl0=8, tid=0, keyidx=5)
+    pkts = [
+        bytearray(rtp_packet(sn=1, ts=10, ssrc=1, pt=96, payload=pay15)),
+        bytearray(rtp_packet(sn=2, ts=20, ssrc=1, pt=96, payload=pay7)),
+        bytearray(rtp_packet(sn=3, ts=30, ssrc=2, pt=111)),  # audio untouched
+    ]
+    buf = bytearray(b"".join(pkts))
+    offsets = np.asarray([0, len(pkts[0]), len(pkts[0]) + len(pkts[1])], np.int32)
+    lengths = np.asarray([len(p) for p in pkts], np.int32)
+    rtp.rewrite_vp8_batch(
+        buf, offsets, lengths,
+        np.asarray([11, 12, 13], np.uint16),
+        np.asarray([110, 120, 130], np.uint32),
+        np.asarray([9, 9, 9], np.uint32),
+        np.asarray([4500, 21, -1], np.int32),   # new picture ids
+        np.asarray([70, 80, -1], np.int32),     # new tl0
+        np.asarray([1, 2, -1], np.int32),       # new keyidx
+        np.asarray([1, 1, 0], np.uint8),
+    )
+    out = rtp.parse_batch(
+        bytes(buf), offsets, lengths, audio_level_ext=1, vp8_pts={96}
+    )
+    # 15-bit pid slot carries the new pid; tl0/keyidx patched; tid/Y kept.
+    assert int(out["sn"][0]) == 11 and int(out["ssrc"][0]) == 9
+    assert int(out["picture_id"][0]) == 4500
+    assert int(out["tl0picidx"][0]) == 70
+    assert int(out["keyidx"][0]) == 1
+    assert int(out["tid"][0]) == 1 and int(out["layer_sync"][0]) == 1
+    # 7-bit slot: low 7 bits, width preserved.
+    assert int(out["picture_id"][1]) == 21
+    assert int(out["tl0picidx"][1]) == 80
+    assert int(out["keyidx"][1]) == 2
+    # VP8 bitstream bytes after the descriptor untouched (keyframe P bit).
+    assert int(out["keyframe"][0]) == 1
+    # Audio packet: header rewritten, payload untouched.
+    assert int(out["sn"][2]) == 13
+    off, ln = int(out["payload_off"][2]), int(out["payload_len"][2])
+    base = int(offsets[2])
+    assert bytes(buf[base + off : base + off + ln]) == b"\xaa" * 20
+
+
+def test_rewrite_vp8_batch_python_native_agree():
+    """Native and fallback rewriters must produce identical bytes."""
+    from livekit_server_tpu.native import _PythonRTP
+
+    rng = np.random.default_rng(7)
+    pkts = []
+    for i in range(40):
+        pay = vp8_payload(
+            pid=int(rng.integers(0, 0x7FFF)) if rng.random() < 0.8 else None,
+            tl0=int(rng.integers(0, 255)) if rng.random() < 0.7 else None,
+            tid=int(rng.integers(0, 3)) if rng.random() < 0.7 else None,
+            keyidx=int(rng.integers(0, 31)) if rng.random() < 0.5 else None,
+            keyframe=bool(rng.random() < 0.3),
+        )
+        pkts.append(rtp_packet(sn=i, ts=i * 90, ssrc=5, pt=96, payload=pay))
+    offsets, lengths, off = [], [], 0
+    for p in pkts:
+        offsets.append(off)
+        lengths.append(len(p))
+        off += len(p)
+    offsets = np.asarray(offsets, np.int32)
+    lengths = np.asarray(lengths, np.int32)
+    args = (
+        np.arange(40, dtype=np.uint16),
+        np.arange(40, dtype=np.uint32) * 10,
+        np.full(40, 77, np.uint32),
+        rng.integers(-1, 0x7FFF, 40).astype(np.int32),
+        rng.integers(-1, 255, 40).astype(np.int32),
+        rng.integers(-1, 31, 40).astype(np.int32),
+        np.ones(40, np.uint8),
+    )
+    buf_a = bytearray(b"".join(pkts))
+    buf_b = bytearray(b"".join(pkts))
+    rtp.rewrite_vp8_batch(buf_a, offsets, lengths, *args)
+    _PythonRTP().rewrite_vp8_batch(buf_b, offsets, lengths, *args)
+    assert bytes(buf_a) == bytes(buf_b)
+
+
 def test_fuzz_agreement():
     """Random bytes: native and Python must classify identically (no
     crashes, no disagreement on validity)."""

@@ -11,6 +11,7 @@ import socket
 import numpy as np
 
 from livekit_server_tpu.models import plane
+from livekit_server_tpu.native import rtp as parser
 from livekit_server_tpu.runtime import PlaneRuntime
 from livekit_server_tpu.runtime.udp import start_udp_transport
 from tests.test_native import rtp_packet, vp8_payload
@@ -70,6 +71,77 @@ async def test_udp_publish_forward_receive():
             assert int(out["sn"]) == 600 + i
             off, ln = int(out["payload_off"]), int(out["payload_len"])
             assert data[off : off + ln] == b"opus" + bytes([i])
+        pub.close()
+        sub.close()
+    finally:
+        transport.transport.close()
+
+
+async def test_udp_vp8_rewrite_reaches_wire_across_layer_switch():
+    """Simulcast layer switch: the device's rewritten picture ids must
+    appear in the actual payload bytes on the wire, contiguous across the
+    switch even though each source layer has its own pid space (the bug
+    codecmunger/vp8.go:161 exists to prevent)."""
+    runtime = PlaneRuntime(DIMS, tick_ms=10)
+    s = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    transport = await start_udp_transport(runtime.ingest, "127.0.0.1", port)
+    try:
+        runtime.set_track(0, 0, published=True, is_video=True)
+        runtime.set_subscription(0, 0, 1, subscribed=True)
+        ssrc0 = transport.assign_ssrc(room=0, track=0, is_video=True, layer=0)
+        ssrc1 = transport.assign_ssrc(room=0, track=0, is_video=True, layer=1)
+
+        pub = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
+        sub = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
+        sub.bind(("127.0.0.1", 0))
+        sub.setblocking(False)
+        transport.register_subscriber(0, 1, sub.getsockname())
+
+        async def send_and_step(sn, ts, ssrc, pid, keyframe):
+            pub.sendto(
+                rtp_packet(
+                    sn=sn, ts=ts, ssrc=ssrc, pt=96,
+                    payload=vp8_payload(pid=pid, tl0=pid % 256, tid=0,
+                                        keyidx=pid % 32, keyframe=keyframe),
+                ),
+                ("127.0.0.1", port),
+            )
+            await asyncio.sleep(0.02)
+            res = await runtime.step_once()
+            transport.send_egress(res.egress)
+            await asyncio.sleep(0.01)
+
+        # Layer 0: keyframe + deltas, pid space starting at 1000.
+        for i in range(6):
+            await send_and_step(100 + i, 90 * i, ssrc0, 1000 + i, i == 0)
+        # Layer 1 appears with keyframes, its own pid space at 5000; once
+        # its bitrate registers the allocator upgrades and the selector
+        # switches at a layer-1 keyframe.
+        for i in range(30):
+            await send_and_step(500 + i, 90 * (6 + i), ssrc1, 5000 + i, True)
+
+        got = []
+        while True:
+            try:
+                got.append(sub.recvfrom(4096)[0])
+            except BlockingIOError:
+                break
+        assert len(got) >= 10, f"only {len(got)} packets received"
+        pids = []
+        for data in got:
+            out = parser.parse_batch(
+                data, np.asarray([0], np.int32), np.asarray([len(data)], np.int32),
+                vp8_pts={96},
+            )[0]
+            assert int(out["payload_len"]) > 0
+            pids.append(int(out["picture_id"]))
+        # Wire picture ids must be CONTIGUOUS across the source switch —
+        # no 1000→5000 jump may survive to the payload bytes.
+        diffs = [b - a for a, b in zip(pids, pids[1:])]
+        assert all(d == 1 for d in diffs), f"pids not contiguous: {pids}"
         pub.close()
         sub.close()
     finally:
