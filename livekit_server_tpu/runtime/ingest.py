@@ -28,6 +28,25 @@ def _wrap_i32(x: int) -> int:
 
 
 @dataclass
+class PayloadSlab:
+    """One tick's payload bytes + [R, T, K] index arrays (drain output)."""
+
+    data: bytes
+    off: np.ndarray      # int64; -1 = no payload staged
+    length: np.ndarray   # int32
+    marker: np.ndarray   # bool — RTP M bit
+
+    def get(self, r: int, t: int, k: int) -> tuple[bytes, bool]:
+        o = int(self.off[r, t, k])
+        if o < 0:
+            return b"", False
+        return (
+            bytes(self.data[o : o + int(self.length[r, t, k])]),
+            bool(self.marker[r, t, k]),
+        )
+
+
+@dataclass
 class PacketIn:
     """Parsed header fields of one media packet (ExtPacket analog)."""
 
@@ -63,10 +82,14 @@ class IngestBuffer:
         self._i32 = lambda: np.zeros((R, T, K), np.int32)
         self._bool = lambda: np.zeros((R, T, K), bool)
         self._alloc_fields()
-        # Payload slab indexed (r, t, k) — host-side only; egress rebuilds
-        # wire packets from (payload bytes, marker bit) (PacketFactory
-        # analog; the marker never crosses to the device).
-        self._payloads: dict[tuple[int, int, int], tuple[bytes, bool]] = {}
+        # Payload slab — host-side only (PacketFactory analog; payload
+        # bytes never cross to the device). One contiguous bytearray per
+        # tick plus [R, T, K] offset/length arrays, so egress gathers
+        # payloads by index math instead of dict lookups per packet.
+        self._slab = bytearray()
+        self.pay_off = np.full((R, T, K), -1, np.int64)
+        self.pay_len = np.zeros((R, T, K), np.int32)
+        self.marker = np.zeros((R, T, K), bool)
         # Per-subscriber feedback staging.
         self._estimate = np.zeros((R, S), np.float32)
         self._estimate_valid = np.zeros((R, S), bool)
@@ -115,7 +138,10 @@ class IngestBuffer:
         self.arrival_rtp[r, t, k] = _wrap_i32(pkt.arrival_rtp)
         self.valid[r, t, k] = True
         if pkt.payload:
-            self._payloads[(r, t, int(k))] = (pkt.payload, pkt.marker)
+            self.pay_off[r, t, k] = len(self._slab)
+            self.pay_len[r, t, k] = len(pkt.payload)
+            self.marker[r, t, k] = pkt.marker
+            self._slab += pkt.payload
         return True
 
     def push_feedback(
@@ -130,7 +156,7 @@ class IngestBuffer:
 
     def drain(
         self, roll_quality: bool = False
-    ) -> tuple[plane.TickInputs, dict[tuple[int, int, int], bytes]]:
+    ) -> tuple[plane.TickInputs, PayloadSlab]:
         """Snapshot this tick's tensors and reset for the next tick."""
         inp = plane.TickInputs(
             sn=self.sn.copy(), ts=self.ts.copy(), layer=self.layer.copy(),
@@ -147,8 +173,16 @@ class IngestBuffer:
             tick_ms=np.int32(self.tick_ms),
             roll_quality=np.int32(1 if roll_quality else 0),
         )
-        payloads = self._payloads
-        self._payloads = {}
+        payloads = PayloadSlab(
+            data=bytes(self._slab),
+            off=self.pay_off.copy(),
+            length=self.pay_len.copy(),
+            marker=self.marker.copy(),
+        )
+        self._slab.clear()
+        self.pay_off[:] = -1
+        self.pay_len[:] = 0
+        self.marker[:] = False
         self._count[:] = 0
         self.valid[:] = False
         self.audio_level[:] = 127

@@ -56,11 +56,56 @@ class EgressPacket:
 
 
 @dataclass
+class EgressBatch:
+    """One tick's egress as column arrays — the vectorized host-egress
+    unit (no per-packet Python objects on the wire path). All arrays are
+    [N] over egress entries; payload bytes stay in the ingest slab and
+    are gathered by (room, track, k) index math."""
+
+    rooms: np.ndarray     # int32
+    tracks: np.ndarray    # int32
+    ks: np.ndarray        # int32 — packet slot within the tick
+    subs: np.ndarray      # int32
+    sn: np.ndarray        # int32 (16-bit munged)
+    ts: np.ndarray        # int32 (32-bit munged, two's complement)
+    pid: np.ndarray       # int32
+    tl0: np.ndarray       # int32
+    keyidx: np.ndarray    # int32
+    payloads: Any         # PayloadSlab
+
+    def __len__(self) -> int:
+        return len(self.rooms)
+
+    def to_packets(self, mask: np.ndarray | None = None) -> list[EgressPacket]:
+        """Materialize EgressPacket objects (WS delivery / tests); `mask`
+        selects a subset of entries."""
+        idx = np.nonzero(mask)[0] if mask is not None else range(len(self.rooms))
+        out = []
+        for i in idx:
+            r, t, k = int(self.rooms[i]), int(self.tracks[i]), int(self.ks[i])
+            payload, marker = self.payloads.get(r, t, k)
+            out.append(
+                EgressPacket(
+                    room=r, track=t, sub=int(self.subs[i]),
+                    sn=int(self.sn[i]) & 0xFFFF,
+                    ts=int(self.ts[i]) & 0xFFFFFFFF,
+                    pid=int(self.pid[i]),
+                    tl0=int(self.tl0[i]),
+                    keyidx=int(self.keyidx[i]),
+                    size=len(payload),
+                    payload=payload,
+                    marker=marker,
+                )
+            )
+        return out
+
+
+@dataclass
 class TickResult:
     """Host-visible outputs of one tick."""
 
     tick_index: int
-    egress: list[EgressPacket]
+    egress_batch: EgressBatch
     speakers: dict[int, list[tuple[int, float]]]     # room → [(track, level)]
     need_keyframe: list[tuple[int, int, int]]        # (room, track, sub)
     congested: dict[int, list[int]]                  # room → [sub]
@@ -77,6 +122,15 @@ class TickResult:
     track_jitter_ms: Any = None   # [R, T] float32
     track_bps: Any = None         # [R, T] float32
     quality_window_closed: bool = False  # this tick rolled the stats window
+    _egress_cache: list[EgressPacket] | None = None
+
+    @property
+    def egress(self) -> list[EgressPacket]:
+        """Lazy object view of egress_batch (WS fan-out, tests). The UDP
+        wire path consumes egress_batch directly and never builds this."""
+        if self._egress_cache is None:
+            self._egress_cache = self.egress_batch.to_packets()
+        return self._egress_cache
 
 
 @functools.lru_cache(maxsize=None)
@@ -242,35 +296,28 @@ class PlaneRuntime:
         return result
 
     def _fan_out(self, out, payloads, tick_s: float) -> TickResult:
-        # Compacted egress: [R, E] index lists (see plane.TickOutputs).
+        # Compacted egress: [R, E] index lists (see plane.TickOutputs) →
+        # column arrays. No per-packet Python objects here; the wire path
+        # consumes the batch arrays directly (DownTrackSpreader's fan-out
+        # loop became pure array math).
         K, S = self.dims.pkts, self.dims.subs
         idx = out.egress_idx
         rr, ee = np.nonzero(idx >= 0)
         flat = idx[rr, ee]
         tt, rem = np.divmod(flat, K * S)
         kk, ss = np.divmod(rem, S)
-        sn = out.egress_sn[rr, ee]
-        ts = out.egress_ts[rr, ee]
-        pid = out.egress_pid[rr, ee]
-        tl0 = out.egress_tl0[rr, ee]
-        kidx = out.egress_keyidx[rr, ee]
-        egress: list[EgressPacket] = []
-        for i in range(len(rr)):
-            r, t, k = int(rr[i]), int(tt[i]), int(kk[i])
-            payload, marker = payloads.get((r, t, k), (b"", False))
-            egress.append(
-                EgressPacket(
-                    room=r, track=t, sub=int(ss[i]),
-                    sn=int(sn[i]) & 0xFFFF,
-                    ts=int(ts[i]) & 0xFFFFFFFF,
-                    pid=int(pid[i]),
-                    tl0=int(tl0[i]),
-                    keyidx=int(kidx[i]),
-                    size=len(payload),
-                    payload=payload,
-                    marker=marker,
-                )
-            )
+        batch = EgressBatch(
+            rooms=rr.astype(np.int32),
+            tracks=tt.astype(np.int32),
+            ks=kk.astype(np.int32),
+            subs=ss.astype(np.int32),
+            sn=out.egress_sn[rr, ee],
+            ts=out.egress_ts[rr, ee],
+            pid=out.egress_pid[rr, ee],
+            tl0=out.egress_tl0[rr, ee],
+            keyidx=out.egress_keyidx[rr, ee],
+            payloads=payloads,
+        )
         overflow = int(out.egress_overflow.sum())
         if overflow:
             self.stats["egress_overflow"] = self.stats.get("egress_overflow", 0) + overflow
