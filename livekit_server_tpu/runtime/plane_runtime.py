@@ -340,7 +340,7 @@ class PlaneRuntime:
             congested.setdefault(int(r), []).append(int(s))
         return TickResult(
             tick_index=self.tick_index,
-            egress=egress,
+            egress_batch=batch,
             speakers=speakers,
             need_keyframe=nk,
             congested=congested,
