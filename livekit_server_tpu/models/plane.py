@@ -43,6 +43,7 @@ from livekit_server_tpu.ops import (
     rtpmunger,
     rtpstats,
     selector,
+    sequencer,
     streamtracker,
     svc,
     vp8,
@@ -51,6 +52,9 @@ from livekit_server_tpu.ops import (
 MAX_LAYERS = 3          # simulcast spatial layers (reference: 3 — receiver.go)
 MAX_TEMPORAL = 4        # temporal sublayers tracked per spatial layer
 SPEAKER_TOP_K = 3
+NACK_SLOTS = 8          # max NACKed SNs resolvable per subscriber per tick
+SLAB_WINDOW = 64        # ticks of payload history the host retains for RTX
+                        # (sequencer.go rtt-bounded ring; 64×10 ms = 640 ms)
 # Cold-start per-temporal-sublayer bitrate shares, used only until measured
 # per-temporal byte attribution (state.temporal_bytes) accumulates — the
 # live path derives the [4][4] Bitrates matrix from observed traffic like
@@ -96,6 +100,7 @@ class PlaneState(NamedTuple):
     sel: selector.SelectorState          # [R, T, S]
     bwe_state: bwe.BWEState              # [R, S]
     tracker: streamtracker.TrackerState  # [R, T*L] per (track, layer) stream
+    seq: sequencer.SequencerState        # [R, S, RING] — NACK replay rings
     temporal_bytes: jax.Array            # [R, T, L, MAX_TEMPORAL] float32 —
                                          # per-temporal byte/tick EMA (the
                                          # measured Bitrates attribution)
@@ -122,16 +127,26 @@ class TickInputs(NamedTuple):
                            # (Opus ptime; 0 for video — levels are audio-only)
     audio_level: jax.Array # int32 — RFC6464 dBov (127 if none)
     arrival_rtp: jax.Array # int32 — arrival time in RTP units
+    ts_jump: jax.Array     # int32 — TS advance at a source switch landing on
+                           # this packet; -1 = host SR-normalized the TS onto
+                           # the track's common timeline (no re-anchor)
     valid: jax.Array       # bool
     # Per-subscriber feedback, [R, S]:
     estimate: jax.Array        # float32 — TWCC/REMB estimate sample
     estimate_valid: jax.Array  # bool
     nacks: jax.Array           # float32 — NACK count this tick
+    rtt_ms: jax.Array          # int32 — per-subscriber RTT (replay throttle)
+    # NACK resolution requests, [R, S, NACK_SLOTS] (-1 = empty):
+    nack_sn: jax.Array         # int32 — munged SNs subscribers NACKed
+    nack_track: jax.Array      # int32 — track each NACK targets
     # Scalars:
     tick_ms: jax.Array     # int32
     roll_quality: jax.Array  # int32 bool-ish — close the stats window this
                              # tick (host sets it ~1/s; the quality outputs
                              # always score the accumulating window)
+    slab_base: jax.Array   # int32 — (tick mod SLAB_WINDOW) * T * K; packet
+                           # row p of this tick gets slab key slab_base + p
+    now_ms: jax.Array      # int32 — monotonic tick clock (sequencer aging)
 
 
 class TickOutputs(NamedTuple):
@@ -171,6 +186,10 @@ class TickOutputs(NamedTuple):
     track_loss_pct: jax.Array  # [R, T] float32
     track_jitter_ms: jax.Array # [R, T] float32
     track_bps: jax.Array       # [R, T] float32 — summed live-layer bitrate
+    # NACK replay resolution (sequencer.getExtPacketMetas analog):
+    replay_key: jax.Array      # [R, S, NACK_SLOTS] int32 slab key; -1 = miss
+    replay_ts: jax.Array       # [R, S, NACK_SLOTS] int32 original munged TS
+    replay_meta: jax.Array     # [R, S, NACK_SLOTS] int32 packed VP8 desc
 
 
 def init_state(dims: PlaneDims) -> PlaneState:
@@ -202,6 +221,7 @@ def init_state(dims: PlaneDims) -> PlaneState:
         sel=jax.tree.map(lambda x: tile(x, R, T), selector.init_state(S)),
         bwe_state=jax.tree.map(lambda x: tile(x, R), bwe.init_state(S)),
         tracker=jax.tree.map(lambda x: tile(x, R), streamtracker.init_state(T * L)),
+        seq=jax.tree.map(lambda x: tile(x, R), sequencer.init_state(S)),
         temporal_bytes=jnp.zeros((R, T, L, MAX_TEMPORAL), jnp.float32),
     )
 
@@ -327,15 +347,35 @@ def _room_tick(
     need_kf = need_kf & base & state.meta.is_video[:, None]
 
     # ---- 6. SN/TS + VP8 munging (vmap over tracks) ---------------------
-    # TS jump at a source switch ≈ one frame at 90 kHz/30 fps. Cross-layer
-    # TS alignment via sender reports refines this host-side.
-    ts_jump = jnp.full((T, K), 3000, jnp.int32)
+    # inp.ts_jump: -1 when the host SR-normalized this packet's TS onto
+    # the track's common timeline (exact cross-layer continuity,
+    # forwarder.go:1456); else a one-frame fallback advance.
     munger_state, out_sn, out_ts, send = jax.vmap(rtpmunger.munge_tick)(
-        state.munger, inp.sn, inp.ts, inp.valid, fwd, drop, switch, ts_jump
+        state.munger, inp.sn, inp.ts, inp.valid, fwd, drop, switch, inp.ts_jump
     )
     vp8_state, out_pid, out_tl0, out_ki = jax.vmap(vp8.munge_tick)(
         state.vp8_state, inp.pid, inp.tl0, inp.keyidx, inp.begin_pic,
         inp.valid, fwd, drop, switch,
+    )
+
+    # ---- NACK replay resolution + sequencer ring push ------------------
+    # Resolve BEFORE pushing (NACKs target earlier ticks), then record this
+    # tick's sends. Entries older than the host's payload-history window
+    # are gated on-device so a stale slab slot is never dereferenced.
+    max_age = (SLAB_WINDOW - 2) * jnp.maximum(inp.tick_ms, 1)
+    seq, replay_key, replay_ts, replay_meta, _replay_ok = sequencer.lookup_nacks(
+        state.seq, inp.nack_sn, inp.nack_track, inp.now_ms, inp.rtt_ms, max_age
+    )
+    P = T * K
+    seq = sequencer.push_tick(
+        seq,
+        out_sn.reshape(P, S),
+        out_ts.reshape(P, S),
+        sequencer.pack_meta(out_pid, out_tl0, out_ki).reshape(P, S),
+        jnp.repeat(jnp.arange(T, dtype=jnp.int32), K),
+        send.reshape(P, S),
+        inp.slab_base + jnp.arange(P, dtype=jnp.int32),
+        inp.now_ms,
     )
 
     # ---- BWE per subscriber (uses this tick's actual send counts) ------
@@ -433,6 +473,7 @@ def _room_tick(
         sel=sel_state,
         bwe_state=bwe_state,
         tracker=tracker,
+        seq=seq,
         temporal_bytes=temporal_bytes,
     )
     # ---- device-side egress compaction ---------------------------------
@@ -471,6 +512,9 @@ def _room_tick(
         track_loss_pct=loss_pct,
         track_jitter_ms=jitter_ms,
         track_bps=jnp.sum(layer_bps, axis=-1),
+        replay_key=replay_key,
+        replay_ts=replay_ts,
+        replay_meta=replay_meta,
     )
     return new_state, outputs
 
@@ -505,7 +549,7 @@ def media_plane_tick(
         return _room_tick(st, i, audio_params, bwe_params, egress_cap)
 
     inp_axes = TickInputs(**{f: 0 for f in TickInputs._fields})._replace(
-        tick_ms=None, roll_quality=None
+        tick_ms=None, roll_quality=None, slab_base=None, now_ms=None
     )
     return jax.vmap(tick_one, in_axes=(0, inp_axes))(state, inp)
 
@@ -524,14 +568,14 @@ def media_plane_tick(
 PKT_FIELDS = (
     "sn", "ts", "layer", "temporal", "keyframe", "layer_sync", "begin_pic",
     "end_frame", "pid", "tl0", "keyidx", "size", "frame_ms", "audio_level",
-    "arrival_rtp", "valid",
+    "arrival_rtp", "ts_jump", "valid",
 )
 _BOOL_FIELDS = {"keyframe", "layer_sync", "begin_pic", "end_frame", "valid"}
 
 
 def pack_tick_inputs(inp: TickInputs):
-    """Host-side: TickInputs → (pkt [F,R,T,K] i32, fb [3,R,S] f32, tick_ms,
-    roll_quality)."""
+    """Host-side: TickInputs → (pkt [F,R,T,K] i32, fb [4,R,S] f32,
+    nk [2,R,S,M] i32, tick_ms, roll_quality, slab_base, now_ms)."""
     import numpy as np
 
     pkt = np.stack([np.asarray(getattr(inp, f)).astype(np.int32) for f in PKT_FIELDS])
@@ -540,13 +584,26 @@ def pack_tick_inputs(inp: TickInputs):
             np.asarray(inp.estimate, np.float32),
             np.asarray(inp.estimate_valid).astype(np.float32),
             np.asarray(inp.nacks, np.float32),
+            np.asarray(inp.rtt_ms, np.float32),
         ]
     )
-    return pkt, fb, np.int32(inp.tick_ms), np.int32(inp.roll_quality)
+    nk = np.stack(
+        [
+            np.asarray(inp.nack_sn, np.int32),
+            np.asarray(inp.nack_track, np.int32),
+        ]
+    )
+    return (
+        pkt, fb, nk,
+        np.int32(inp.tick_ms), np.int32(inp.roll_quality),
+        np.int32(inp.slab_base), np.int32(inp.now_ms),
+    )
 
 
 def unpack_tick_inputs(
-    pkt: jax.Array, fb: jax.Array, tick_ms: jax.Array, roll_quality: jax.Array
+    pkt: jax.Array, fb: jax.Array, nk: jax.Array,
+    tick_ms: jax.Array, roll_quality: jax.Array,
+    slab_base: jax.Array, now_ms: jax.Array,
 ) -> TickInputs:
     """Device-side (traced): stacked arrays → TickInputs."""
     fields = {}
@@ -558,8 +615,13 @@ def unpack_tick_inputs(
         estimate=fb[0],
         estimate_valid=fb[1] > 0.5,
         nacks=fb[2],
+        rtt_ms=fb[3].astype(jnp.int32),
+        nack_sn=nk[0],
+        nack_track=nk[1],
         tick_ms=tick_ms,
         roll_quality=roll_quality,
+        slab_base=slab_base,
+        now_ms=now_ms,
     )
 
 
@@ -600,6 +662,9 @@ def unpack_tick_outputs(buf, dims: PlaneDims, egress_cap: int) -> TickOutputs:
         "track_loss_pct": (R, T),
         "track_jitter_ms": (R, T),
         "track_bps": (R, T),
+        "replay_key": (R, S, NACK_SLOTS),
+        "replay_ts": (R, S, NACK_SLOTS),
+        "replay_meta": (R, S, NACK_SLOTS),
     }
     floats = {"speaker_levels", "track_mos", "track_loss_pct", "track_jitter_ms", "track_bps"}
     bools = {"need_keyframe", "congested"}

@@ -57,16 +57,29 @@ class MungerState(NamedTuple):
     last_sn: jax.Array    # last outgoing 16-bit SN
     last_ts: jax.Array    # last outgoing 32-bit TS
     started: jax.Array    # bool: offsets are valid
+    ts_anchor_aligned: jax.Array  # bool: ts_offset was anchored on a
+                                  # common-timeline (SR-normalized) packet —
+                                  # only then may an aligned switch carry
+                                  # the offset through unchanged
+
+
+# A forwarded (non-switch) packet whose output TS would jump by more than
+# this re-anchors instead: the input timeline shifted under us (e.g. SR
+# alignment kicked in mid-stream and renumbered the layer's TS space).
+REANCHOR_TS_THRESH = 900_000  # 10 s @ 90 kHz
+FALLBACK_TS_JUMP = 3000       # one frame @ 90 kHz / 30 fps
 
 
 def init_state(num_subscribers: int) -> MungerState:
     z = jnp.zeros((num_subscribers,), jnp.int32)
+    f = jnp.zeros((num_subscribers,), jnp.bool_)
     return MungerState(
         sn_offset=z,
         ts_offset=z,
         last_sn=z,
         last_ts=z,
-        started=jnp.zeros((num_subscribers,), jnp.bool_),
+        started=f,
+        ts_anchor_aligned=f,
     )
 
 
@@ -78,7 +91,13 @@ def munge_tick(
     forward: jax.Array,        # [P, S] bool
     drop: jax.Array,           # [P, S] bool
     switch: jax.Array,         # [P, S] bool
-    switch_ts_jump: jax.Array, # [P] int32 — TS advance applied at a switch
+    switch_ts_jump: jax.Array, # [P] int32 — TS advance applied at a switch;
+                               # -1 = the host already normalized this
+                               # packet's TS onto the track's common
+                               # timeline (SR-based cross-layer alignment,
+                               # forwarder.go:1456 processSourceSwitch), so
+                               # the existing ts_offset stays valid and no
+                               # re-anchor happens.
 ):
     """One tick of SN/TS munging for one track.
 
@@ -92,16 +111,40 @@ def munge_tick(
         fwd = fwd & valid
         drp = drp & valid & ~fwd
         sw = sw & fwd
+        pkt_aligned = jump < 0
+        jump_eff = jnp.where(pkt_aligned, FALLBACK_TS_JUMP, jump)
 
-        # Source switch: continue output SN at last_sn + 1, TS at last_ts + jump.
+        # Source switch: continue output SN at last_sn + 1, TS at
+        # last_ts + jump — unless BOTH this packet and the current anchor
+        # sit on the SR-normalized common timeline, in which case the
+        # existing ts_offset already maps it exactly (no guess needed).
         sw_sn_off = seqnum.sub16(sn, seqnum.add16(carry.last_sn, 1))
-        sw_ts_off = seqnum.sub32(ts, seqnum.add32(carry.last_ts, jump))
+        sw_ts_off = seqnum.sub32(ts, seqnum.add32(carry.last_ts, jump_eff))
+        carry_through = pkt_aligned & carry.ts_anchor_aligned
+        sw_ts_off = jnp.where(carry_through, carry.ts_offset, sw_ts_off)
         # First packet ever: identity mapping (reference SetLastSnTs seeds
         # outgoing = incoming on the first packet).
         fresh = fwd & ~carry.started
         resync = sw & carry.started
+        # Timeline shear guard: a continuing (non-switch) forward whose
+        # output TS would leap implausibly far means the INPUT timeline
+        # moved under this subscriber (SR alignment starting mid-stream
+        # renumbers a layer's TS space) — re-anchor with the fallback jump
+        # instead of emitting a 2^31-size discontinuity.
+        cur_out_ts = seqnum.sub32(ts, carry.ts_offset)
+        shear = seqnum.sub32(cur_out_ts, carry.last_ts)
+        sheared = fwd & ~sw & carry.started & (jnp.abs(shear) > REANCHOR_TS_THRESH)
+        shear_ts_off = seqnum.sub32(ts, seqnum.add32(carry.last_ts, FALLBACK_TS_JUMP))
+
+        anchor = fresh | resync | sheared
         sn_offset = jnp.where(resync, sw_sn_off, jnp.where(fresh, 0, carry.sn_offset))
-        ts_offset = jnp.where(resync, sw_ts_off, jnp.where(fresh, 0, carry.ts_offset))
+        ts_offset = jnp.where(
+            sheared, shear_ts_off,
+            jnp.where(resync, sw_ts_off, jnp.where(fresh, 0, carry.ts_offset)),
+        )
+        ts_anchor_aligned = jnp.where(
+            anchor, pkt_aligned, carry.ts_anchor_aligned
+        )
 
         out_sn = seqnum.sub16(sn, sn_offset)
         out_ts = seqnum.sub32(ts, ts_offset)
@@ -113,7 +156,9 @@ def munge_tick(
         sn_offset = jnp.where(drp & carry.started, seqnum.add16(sn_offset, 1), sn_offset)
         started = carry.started | fwd
 
-        new_carry = MungerState(sn_offset, ts_offset, last_sn, last_ts, started)
+        new_carry = MungerState(
+            sn_offset, ts_offset, last_sn, last_ts, started, ts_anchor_aligned
+        )
         return new_carry, (out_sn, out_ts, fwd)
 
     xs = (pkt_sn, pkt_ts, pkt_valid, forward, drop, switch, switch_ts_jump)
@@ -148,5 +193,6 @@ def padding_tick(
         last_sn=jnp.where(n > 0, seqnum.add16(state.last_sn, n), state.last_sn),
         last_ts=jnp.where(n > 0, seqnum.add32(state.last_ts, ts_advance), state.last_ts),
         started=state.started,
+        ts_anchor_aligned=state.ts_anchor_aligned,
     )
     return new_state, pad_sn, pad_ts, valid

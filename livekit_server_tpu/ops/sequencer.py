@@ -5,12 +5,21 @@ munged SN → (original packet reference, layer, codec state) for NACK
 replay (`getExtPacketMetas` :263), with RTT gating so a packet isn't
 re-sent twice within one round trip.
 
-TPU-first re-design: one ring per subscriber, all subscribers updated in a
-single scatter per tick. The ring stores the *slab key* of the original
-payload ((track<<16 | pkt_slot) of the tick it was sent in is not stable
-across ticks, so the host passes a monotonically increasing slab id) —
-lookup returns that key for the host/C++ egress to replay bytes from its
-payload history.
+TPU-first re-design: ONE ring per subscriber (not per DownTrack), all
+subscribers updated in a single scatter per tick. Each slot stores the
+originating track alongside the munged SN, so tracks share the ring and
+the hit check is (sent_sn, sent_track) == (nacked_sn, nacked_track);
+cross-track slot collisions just evict (a miss makes the client re-NACK,
+exactly like an evicted reference ring entry).
+
+The slot payload is everything a replay needs:
+  - slab_key: host payload-history key — encodes (tick mod window, track,
+    pkt slot) so the host can gather the original bytes from its rolling
+    PayloadSlab ring (runtime/plane_runtime.py history)
+  - sent_ts / sent_meta: the munged TS and packed VP8 descriptor
+    (pid<<13 | tl0<<5 | keyidx) of the ORIGINAL transmission — a replay
+    must carry identical bytes, not re-munged ones
+  - sent_at_ms / last_nack_ms: age + RTT replay throttle
 """
 
 from __future__ import annotations
@@ -22,6 +31,25 @@ import jax.numpy as jnp
 
 RING_BITS = 9               # 512 entries ≈ reference's default window
 RING = 1 << RING_BITS
+NEVER_MS = -(1 << 30)       # last_nack_ms sentinel: slot never replayed.
+                            # Kept OUT of the (now - last) subtraction —
+                            # now_ms grows to 2^31 over ~24 days and
+                            # now - NEVER_MS would overflow int32, reading
+                            # as "throttled" and silently disabling RTX.
+
+
+def pack_meta(pid: jax.Array, tl0: jax.Array, keyidx: jax.Array) -> jax.Array:
+    """VP8 descriptor fields → one int32 (pid 15 bits, tl0 8, keyidx 5)."""
+    return (
+        (jnp.clip(pid, 0, 0x7FFF) << 13)
+        | (jnp.clip(tl0, 0, 0xFF) << 5)
+        | jnp.clip(keyidx, 0, 0x1F)
+    ).astype(jnp.int32)
+
+
+def unpack_meta(meta):
+    """int32 → (pid, tl0, keyidx); works on jax or numpy arrays/scalars."""
+    return (meta >> 13) & 0x7FFF, (meta >> 5) & 0xFF, meta & 0x1F
 
 
 class SequencerState(NamedTuple):
@@ -29,7 +57,10 @@ class SequencerState(NamedTuple):
 
     slab_key: jax.Array      # int32 — host payload-history key (-1 empty)
     sent_sn: jax.Array       # int32 — munged SN stored at this slot
-    sent_at_ms: jax.Array    # int32 — send time (for RTT gating)
+    sent_track: jax.Array    # int32 — track the SN belongs to (-1 empty)
+    sent_ts: jax.Array       # int32 — munged TS of the original send
+    sent_meta: jax.Array     # int32 — packed VP8 descriptor (pack_meta)
+    sent_at_ms: jax.Array    # int32 — send time (age + RTT gating)
     last_nack_ms: jax.Array  # int32 — last replay time
 
 
@@ -38,14 +69,20 @@ def init_state(num_subscribers: int) -> SequencerState:
     return SequencerState(
         slab_key=jnp.full(shape, -1, jnp.int32),
         sent_sn=jnp.full(shape, -1, jnp.int32),
+        sent_track=jnp.full(shape, -1, jnp.int32),
+        sent_ts=jnp.zeros(shape, jnp.int32),
+        sent_meta=jnp.zeros(shape, jnp.int32),
         sent_at_ms=jnp.zeros(shape, jnp.int32),
-        last_nack_ms=jnp.full(shape, -(1 << 30), jnp.int32),
+        last_nack_ms=jnp.full(shape, NEVER_MS, jnp.int32),
     )
 
 
 def push_tick(
     state: SequencerState,
     out_sn: jax.Array,     # [P, S] int32 — munged SNs sent this tick
+    out_ts: jax.Array,     # [P, S] int32 — munged TSs sent this tick
+    out_meta: jax.Array,   # [P, S] int32 — packed VP8 descriptors
+    track: jax.Array,      # [P] int32 — source track of each packet row
     sent: jax.Array,       # [P, S] bool — send mask
     slab_key: jax.Array,   # [P] int32 — host payload-history keys
     now_ms: jax.Array,     # scalar int32
@@ -55,6 +92,7 @@ def push_tick(
     slot = out_sn & (RING - 1)                        # [P, S]
     sub = jnp.broadcast_to(jnp.arange(S, dtype=jnp.int32)[None, :], (P, S))
     keys = jnp.broadcast_to(slab_key[:, None], (P, S))
+    trks = jnp.broadcast_to(track[:, None], (P, S))
 
     # Masked scatter: unsent entries write to a scratch slot we discard.
     flat_idx = jnp.where(sent, sub * RING + slot, S * RING)  # [P,S]
@@ -67,6 +105,9 @@ def push_tick(
     return SequencerState(
         slab_key=scatter(state.slab_key, keys),
         sent_sn=scatter(state.sent_sn, jnp.where(sent, out_sn, -1)),
+        sent_track=scatter(state.sent_track, jnp.where(sent, trks, -1)),
+        sent_ts=scatter(state.sent_ts, out_ts),
+        sent_meta=scatter(state.sent_meta, out_meta),
         sent_at_ms=scatter(state.sent_at_ms, jnp.full((P, S), now_ms, jnp.int32)),
         last_nack_ms=state.last_nack_ms,
     )
@@ -74,25 +115,38 @@ def push_tick(
 
 def lookup_nacks(
     state: SequencerState,
-    nacked_sn: jax.Array,   # [S, M] int32 — munged SNs the subs NACKed (-1 pad)
-    now_ms: jax.Array,      # scalar int32
-    rtt_ms: jax.Array,      # [S] int32 — per-sub RTT (replay throttle)
+    nacked_sn: jax.Array,     # [S, M] int32 — munged SNs the subs NACKed (-1 pad)
+    nacked_track: jax.Array,  # [S, M] int32 — track each NACK targets
+    now_ms: jax.Array,        # scalar int32
+    rtt_ms: jax.Array,        # [S] int32 — per-sub RTT (replay throttle)
+    max_age_ms: jax.Array | int = 1 << 30,
 ):
-    """Resolve NACKs → slab keys (getExtPacketMetas + RTT gate).
+    """Resolve NACKs → replay records (getExtPacketMetas + RTT gate).
 
-    Returns (state, slab_key [S, M], ok [S, M]); `ok` is False for unknown/
-    evicted SNs and for SNs replayed within the last RTT.
+    Returns (state, slab_key [S, M], ts [S, M], meta [S, M], ok [S, M]);
+    `ok` is False for unknown/evicted SNs, for SNs replayed within the last
+    RTT, and for entries older than `max_age_ms` (whose payload slab slot
+    the host has already recycled).
     """
     S, M = nacked_sn.shape
     slot = nacked_sn & (RING - 1)
     sub = jnp.arange(S, dtype=jnp.int32)[:, None]
-    hit = (jnp.take_along_axis(state.sent_sn, slot, axis=-1) == nacked_sn) & (
-        nacked_sn >= 0
+    hit = (
+        (jnp.take_along_axis(state.sent_sn, slot, axis=-1) == nacked_sn)
+        & (jnp.take_along_axis(state.sent_track, slot, axis=-1) == nacked_track)
+        & (nacked_sn >= 0)
     )
     key = jnp.take_along_axis(state.slab_key, slot, axis=-1)
+    ts = jnp.take_along_axis(state.sent_ts, slot, axis=-1)
+    meta = jnp.take_along_axis(state.sent_meta, slot, axis=-1)
+    sent_at = jnp.take_along_axis(state.sent_at_ms, slot, axis=-1)
     last = jnp.take_along_axis(state.last_nack_ms, slot, axis=-1)
-    throttled = (now_ms - last) < jnp.maximum(rtt_ms[:, None], 1)
-    ok = hit & ~throttled & (key >= 0)
+    # Sentinel excluded from the subtraction (int32 overflow — see NEVER_MS).
+    throttled = (last != NEVER_MS) & (
+        (now_ms - last) < jnp.maximum(rtt_ms[:, None], 1)
+    )
+    fresh = (now_ms - sent_at) < max_age_ms
+    ok = hit & ~throttled & fresh & (key >= 0)
 
     # Stamp replay time on the slots we are re-sending.
     flat = jnp.where(ok, sub * RING + slot, S * RING)
@@ -100,4 +154,10 @@ def lookup_nacks(
     padded = padded.at[flat.reshape(-1)].set(jnp.full((S * M,), now_ms, jnp.int32))
     new_last = padded[:-1].reshape(state.last_nack_ms.shape)
 
-    return state._replace(last_nack_ms=new_last), jnp.where(ok, key, -1), ok
+    return (
+        state._replace(last_nack_ms=new_last),
+        jnp.where(ok, key, -1),
+        ts,
+        meta,
+        ok,
+    )

@@ -53,6 +53,7 @@ class Room:
         self.on_track_published: list[Callable] = []
         self._on_close: list[Callable[[], None]] = []
         self._active_speakers: list[dict] = []
+        self._last_pli: dict[int, float] = {}  # track col → monotonic s
         from livekit_server_tpu.rtc.dynacast import DynacastState
 
         self.dynacast = DynacastState()
@@ -317,7 +318,15 @@ class Room:
 
     def handle_keyframe_request(self, track_col: int) -> None:
         """Device says a subscriber needs a keyframe ⇒ PLI to publisher
-        (receiver.go SendPLI / mediatrack.go)."""
+        (receiver.go SendPLI / mediatrack.go), throttled per track so a
+        persistent need_keyframe or a PLI-spamming subscriber cannot
+        force a keyframe storm (buffer pliThrottle analog)."""
+        from livekit_server_tpu.runtime.udp import PLI_THROTTLE_MS
+
+        now = time.monotonic()
+        if now - self._last_pli.get(track_col, -1e12) < PLI_THROTTLE_MS / 1000.0:
+            return
+        self._last_pli[track_col] = now
         sid = self.col_to_sid.get(track_col)
         if sid and sid in self.tracks:
             pub, track = self.tracks[sid]

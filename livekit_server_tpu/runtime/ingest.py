@@ -68,6 +68,8 @@ class PacketIn:
     frame_ms: int = 20
     audio_level: int = 127
     arrival_rtp: int = 0
+    ts_aligned: bool = False  # ts already on the track's common timeline
+                              # (SR-normalized by the transport)
 
 
 class IngestBuffer:
@@ -94,6 +96,14 @@ class IngestBuffer:
         self._estimate = np.zeros((R, S), np.float32)
         self._estimate_valid = np.zeros((R, S), bool)
         self._nacks = np.zeros((R, S), np.float32)
+        # NACK resolution requests (sequencer lookups) + per-sub RTT.
+        M = plane.NACK_SLOTS
+        self._nack_sn = np.full((R, S, M), -1, np.int32)
+        self._nack_track = np.full((R, S, M), -1, np.int32)
+        self._nack_cnt = np.zeros((R, S), np.int32)
+        self.rtt_ms = np.full((R, S), 100, np.int32)  # persistent (RR-updated)
+        self.nack_overflow = 0
+        self.dupes = 0
 
     def _alloc_fields(self):
         self.sn = self._i32()
@@ -111,6 +121,9 @@ class IngestBuffer:
         self.frame_ms = self._i32()
         self.audio_level = np.full(self.sn.shape, 127, np.int32)
         self.arrival_rtp = self._i32()
+        # -1 = SR-normalized (exact cross-layer continuity); else one-frame
+        # fallback advance at a source switch (forwarder.go:1456).
+        self.ts_jump = np.full(self.sn.shape, 3000, np.int32)
         self.valid = self._bool()
 
     def push(self, pkt: PacketIn) -> bool:
@@ -136,6 +149,7 @@ class IngestBuffer:
         self.frame_ms[r, t, k] = pkt.frame_ms
         self.audio_level[r, t, k] = pkt.audio_level
         self.arrival_rtp[r, t, k] = _wrap_i32(pkt.arrival_rtp)
+        self.ts_jump[r, t, k] = -1 if pkt.ts_aligned else 3000
         self.valid[r, t, k] = True
         if pkt.payload:
             self.pay_off[r, t, k] = len(self._slab)
@@ -154,10 +168,90 @@ class IngestBuffer:
         if nacks:
             self._nacks[room, sub] += nacks
 
+    def push_nack(self, room: int, sub: int, track: int, sns) -> int:
+        """Stage NACKed munged SNs for device-side sequencer resolution
+        (buffer.go RTCP NACK → sequencer.getExtPacketMetas). Returns how
+        many were staged; overflow beyond NACK_SLOTS/tick is counted and
+        the client is expected to re-NACK (reference drops the same way)."""
+        staged = 0
+        for sn in sns:
+            c = self._nack_cnt[room, sub]
+            sn &= 0xFFFF
+            # Dedup within the tick: two feedback packets (or overlapping
+            # BLP masks) naming the same SN must not double-retransmit.
+            if any(
+                self._nack_sn[room, sub, i] == sn
+                and self._nack_track[room, sub, i] == track
+                for i in range(c)
+            ):
+                continue
+            if c >= self._nack_sn.shape[-1]:
+                self.nack_overflow += 1
+                continue
+            self._nack_sn[room, sub, c] = sn
+            self._nack_track[room, sub, c] = track
+            self._nack_cnt[room, sub] = c + 1
+            staged += 1
+        if staged:
+            self._nacks[room, sub] += staged
+        return staged
+
+    def set_rtt(self, room: int, sub: int, rtt_ms: int) -> None:
+        """RR-derived round-trip time (replay throttle input)."""
+        self.rtt_ms[room, sub] = max(1, min(int(rtt_ms), 10_000))
+
+    def _reorder_dedup(self) -> None:
+        """Sort each (room, track)'s staged packets by (layer, SN) and drop
+        same-SN duplicates — the jitter-ordering half of buffer.Buffer
+        (buffer.go Write reorder + duplicate detection). Within-tick only:
+        packets are in flight for one tick, so this IS the jitter window."""
+        if not (self._count > 1).any():
+            return
+        R, T, K = self.sn.shape
+        # Per-(r, t, layer) SN unwrap: rel SN relative to the first staged
+        # packet of the same layer (simulcast layers are separate SN spaces).
+        rel = np.zeros((R, T, K), np.int32)
+        for l in range(int(self.layer.max()) + 1 if self.valid.any() else 0):
+            m = self.valid & (self.layer == l)
+            if not m.any():
+                continue
+            first = np.argmax(m, axis=-1)                       # [R, T]
+            base = np.take_along_axis(self.sn, first[:, :, None], axis=-1)
+            d = (self.sn - base) & 0xFFFF
+            rel = np.where(m, np.where(d >= 0x8000, d - 0x10000, d), rel)
+        key = np.where(
+            self.valid, self.layer.astype(np.int64) * (1 << 20) + rel, 1 << 40
+        )
+        order = np.argsort(key, axis=-1, kind="stable")
+        if (order == np.arange(K)).all():
+            pass  # already ordered; still run dedup below
+        else:
+            for arr in (
+                self.sn, self.ts, self.layer, self.temporal, self.keyframe,
+                self.layer_sync, self.begin_pic, self.end_frame, self.pid,
+                self.tl0, self.keyidx, self.size, self.frame_ms,
+                self.audio_level, self.arrival_rtp, self.ts_jump, self.valid,
+                self.pay_off, self.pay_len, self.marker,
+            ):
+                arr[...] = np.take_along_axis(arr, order, axis=-1)
+        dup = np.zeros_like(self.valid)
+        dup[:, :, 1:] = (
+            self.valid[:, :, 1:]
+            & self.valid[:, :, :-1]
+            & (self.sn[:, :, 1:] == self.sn[:, :, :-1])
+            & (self.layer[:, :, 1:] == self.layer[:, :, :-1])
+        )
+        n = int(dup.sum())
+        if n:
+            self.valid[dup] = False
+            self.dupes += n
+
     def drain(
-        self, roll_quality: bool = False
+        self, roll_quality: bool = False, tick_index: int = 0
     ) -> tuple[plane.TickInputs, PayloadSlab]:
         """Snapshot this tick's tensors and reset for the next tick."""
+        self._reorder_dedup()
+        R, T, K, _S = self.dims
         inp = plane.TickInputs(
             sn=self.sn.copy(), ts=self.ts.copy(), layer=self.layer.copy(),
             temporal=self.temporal.copy(), keyframe=self.keyframe.copy(),
@@ -166,12 +260,18 @@ class IngestBuffer:
             pid=self.pid.copy(), tl0=self.tl0.copy(), keyidx=self.keyidx.copy(),
             size=self.size.copy(), frame_ms=self.frame_ms.copy(),
             audio_level=self.audio_level.copy(),
-            arrival_rtp=self.arrival_rtp.copy(), valid=self.valid.copy(),
+            arrival_rtp=self.arrival_rtp.copy(), ts_jump=self.ts_jump.copy(),
+            valid=self.valid.copy(),
             estimate=self._estimate.copy(),
             estimate_valid=self._estimate_valid.copy(),
             nacks=self._nacks.copy(),
+            rtt_ms=self.rtt_ms.copy(),
+            nack_sn=self._nack_sn.copy(),
+            nack_track=self._nack_track.copy(),
             tick_ms=np.int32(self.tick_ms),
             roll_quality=np.int32(1 if roll_quality else 0),
+            slab_base=np.int32((tick_index % plane.SLAB_WINDOW) * T * K),
+            now_ms=np.int32((tick_index * self.tick_ms) & 0x7FFFFFFF),
         )
         payloads = PayloadSlab(
             data=bytes(self._slab),
@@ -188,4 +288,7 @@ class IngestBuffer:
         self.audio_level[:] = 127
         self._estimate_valid[:] = False
         self._nacks[:] = 0.0
+        self._nack_sn[:] = -1
+        self._nack_track[:] = -1
+        self._nack_cnt[:] = 0
         return inp, payloads

@@ -112,6 +112,7 @@ class TickResult:
     fwd_packets: int
     fwd_bytes: int
     tick_s: float                                    # wall time of the step
+    replays: list[EgressPacket] = field(default_factory=list)  # NACK retransmits
     # Quality / stats tensors (numpy views of TickOutputs; consumers index
     # by room row). None until the first tick completes.
     track_quality: Any = None     # [R, T] int32 ConnectionQuality enum
@@ -138,8 +139,10 @@ def _build_step(audio_params, bwe_params, egress_cap):
     """Packed-wire step: ONE input upload, ONE output fetch per tick
     (plane.pack_tick_inputs / pack_tick_outputs)."""
 
-    def tick(state, pkt, fb, tick_ms, roll_quality):
-        inp = plane.unpack_tick_inputs(pkt, fb, tick_ms, roll_quality)
+    def tick(state, pkt, fb, nk, tick_ms, roll_quality, slab_base, now_ms):
+        inp = plane.unpack_tick_inputs(
+            pkt, fb, nk, tick_ms, roll_quality, slab_base, now_ms
+        )
         state, out = plane.media_plane_tick(
             state, inp, audio_params, bwe_params, egress_cap=egress_cap
         )
@@ -203,6 +206,10 @@ class PlaneRuntime:
             # compilation cache instead of re-tracing a fresh closure.
             self._step = _build_step(self._ap, self._bp, self.egress_cap)
 
+        # Rolling payload history for NACK replay (sequencer slab keys
+        # reference slot tick % SLAB_WINDOW; sequencer.lookup_nacks age-gates
+        # on device so a recycled slot is never dereferenced).
+        self._slab_history: list = [None] * plane.SLAB_WINDOW
         self._task: asyncio.Task | None = None
         self._on_tick: list[Callable[[TickResult], Awaitable[None] | None]] = []
         self.stats = {"ticks": 0, "fwd_packets": 0, "fwd_bytes": 0, "late_ticks": 0}
@@ -266,8 +273,8 @@ class PlaneRuntime:
         if self._mesh is not None:
             self.state, out = self._step(self.state, inp)
             return jax.tree.map(np.asarray, out)
-        pkt, fb, tick_ms, roll = plane.pack_tick_inputs(inp)
-        self.state, buf = self._step(self.state, pkt, fb, tick_ms, roll)
+        packed = plane.pack_tick_inputs(inp)
+        self.state, buf = self._step(self.state, *packed)
         return plane.unpack_tick_outputs(np.asarray(buf), self.dims, self.egress_cap)
 
     async def step_once(self) -> TickResult:
@@ -280,10 +287,13 @@ class PlaneRuntime:
         # (connectionquality windows; room.go:1318 worker cadence).
         q_ticks = max(1, 1000 // self.tick_ms)
         roll = (self.tick_index + 1) % q_ticks == 0
-        inp, payloads = self.ingest.drain(roll_quality=roll)
+        inp, payloads = self.ingest.drain(roll_quality=roll, tick_index=self.tick_index)
+        # Retain the slab for the RTX window: replay keys minted this tick
+        # reference slot (tick % SLAB_WINDOW) until it recycles.
+        self._slab_history[self.tick_index % plane.SLAB_WINDOW] = payloads
         loop = asyncio.get_running_loop()
         out = await loop.run_in_executor(self._executor, self._device_step, inp)
-        result = self._fan_out(out, payloads, time.perf_counter() - t0)
+        result = self._fan_out(out, payloads, inp, time.perf_counter() - t0)
         result.quality_window_closed = roll
         self.tick_index += 1
         self.stats["ticks"] += 1
@@ -295,7 +305,42 @@ class PlaneRuntime:
                 await r
         return result
 
-    def _fan_out(self, out, payloads, tick_s: float) -> TickResult:
+    def _assemble_replays(self, out, inp) -> list[EgressPacket]:
+        """Resolve device replay keys → EgressPackets from the slab history
+        (the replay half of sequencer.go:263; cold path — loss events only,
+        so per-packet objects are fine here)."""
+        rk = np.asarray(out.replay_key)
+        hits = np.nonzero(rk >= 0)
+        if not len(hits[0]):
+            return []
+        from livekit_server_tpu.ops import sequencer
+
+        TK = self.dims.tracks * self.dims.pkts
+        K = self.dims.pkts
+        rts, rmeta = np.asarray(out.replay_ts), np.asarray(out.replay_meta)
+        replays: list[EgressPacket] = []
+        for r, s, m in zip(*hits):
+            w, tk = divmod(int(rk[r, s, m]), TK)
+            t, k = divmod(tk, K)
+            slab = self._slab_history[w]
+            if slab is None:
+                continue
+            payload, marker = slab.get(int(r), t, k)
+            if not payload:
+                continue
+            pid, tl0, keyidx = sequencer.unpack_meta(int(rmeta[r, s, m]))
+            replays.append(
+                EgressPacket(
+                    room=int(r), track=t, sub=int(s),
+                    sn=int(inp.nack_sn[r, s, m]) & 0xFFFF,
+                    ts=int(rts[r, s, m]) & 0xFFFFFFFF,
+                    pid=pid, tl0=tl0, keyidx=keyidx,
+                    size=len(payload), payload=payload, marker=marker,
+                )
+            )
+        return replays
+
+    def _fan_out(self, out, payloads, inp, tick_s: float) -> TickResult:
         # Compacted egress: [R, E] index lists (see plane.TickOutputs) →
         # column arrays. No per-packet Python objects here; the wire path
         # consumes the batch arrays directly (DownTrackSpreader's fan-out
@@ -338,9 +383,13 @@ class PlaneRuntime:
         congested: dict[int, list[int]] = {}
         for r, s in zip(*np.nonzero(out.congested)):
             congested.setdefault(int(r), []).append(int(s))
+        replays = self._assemble_replays(out, inp)
+        if replays:
+            self.stats["rtx_packets"] = self.stats.get("rtx_packets", 0) + len(replays)
         return TickResult(
             tick_index=self.tick_index,
             egress_batch=batch,
+            replays=replays,
             speakers=speakers,
             need_keyframe=nk,
             congested=congested,

@@ -36,6 +36,133 @@ AUDIO_LEVEL_EXT_ID = 1
 PUNCH_REQ = b"LKPUNCH0"
 PUNCH_ACK = b"LKPUNCH1"
 
+# RTCP payload types (rtcp-mux demux range per RFC 5761: byte1 in 192-223).
+RTCP_SR = 200
+RTCP_RR = 201
+RTCP_RTPFB = 205   # FMT 1 = generic NACK
+RTCP_PSFB = 206    # FMT 1 = PLI, FMT 15 = REMB (application layer feedback)
+PLI_THROTTLE_MS = 500.0  # min spacing of upstream keyframe requests per
+                         # track (pliThrottle — sfu/buffer config default)
+
+
+def build_nack(sender_ssrc: int, media_ssrc: int, sns) -> bytes:
+    """Generic NACK (RFC 4585 §6.2.1): (PID, BLP) pairs from a SN list."""
+    sns = sorted(set(s & 0xFFFF for s in sns))
+    fci = bytearray()
+    i = 0
+    while i < len(sns):
+        pid = sns[i]
+        blp = 0
+        j = i + 1
+        while j < len(sns) and 0 < ((sns[j] - pid) & 0xFFFF) <= 16:
+            blp |= 1 << (((sns[j] - pid) & 0xFFFF) - 1)
+            j += 1
+        fci += pid.to_bytes(2, "big") + blp.to_bytes(2, "big")
+        i = j
+    length_words = 2 + len(fci) // 4
+    return (
+        bytes([0x80 | 1, RTCP_RTPFB])
+        + length_words.to_bytes(2, "big")
+        + sender_ssrc.to_bytes(4, "big")
+        + media_ssrc.to_bytes(4, "big")
+        + bytes(fci)
+    )
+
+
+def parse_nack_fci(fci: bytes) -> list[int]:
+    sns = []
+    for i in range(0, len(fci) - 3, 4):
+        pid = int.from_bytes(fci[i : i + 2], "big")
+        blp = int.from_bytes(fci[i + 2 : i + 4], "big")
+        sns.append(pid)
+        for b in range(16):
+            if blp & (1 << b):
+                sns.append((pid + b + 1) & 0xFFFF)
+    return sns
+
+
+def ntp_now() -> int:
+    """64-bit NTP timestamp (RFC 3550 SR wallclock)."""
+    import time
+
+    t = time.time() + 2208988800.0  # Unix → NTP epoch (1900)
+    sec = int(t)
+    frac = int((t - sec) * (1 << 32)) & 0xFFFFFFFF
+    return ((sec & 0xFFFFFFFF) << 32) | frac
+
+
+def ntp_mid32(ntp64: int) -> int:
+    """Middle 32 bits of an NTP timestamp (the RR LSR/DLSR unit)."""
+    return (ntp64 >> 16) & 0xFFFFFFFF
+
+
+def build_sr(ssrc: int, ntp64: int, rtp_ts: int, pkts: int, octets: int) -> bytes:
+    """Sender report, no report blocks (RFC 3550 §6.4.1)."""
+    return (
+        bytes([0x80, RTCP_SR, 0, 6])
+        + (ssrc & 0xFFFFFFFF).to_bytes(4, "big")
+        + ntp64.to_bytes(8, "big")
+        + (rtp_ts & 0xFFFFFFFF).to_bytes(4, "big")
+        + (pkts & 0xFFFFFFFF).to_bytes(4, "big")
+        + (octets & 0xFFFFFFFF).to_bytes(4, "big")
+    )
+
+
+def parse_sr(chunk: bytes):
+    """SR → (ssrc, ntp64, rtp_ts); None if truncated."""
+    if len(chunk) < 28:
+        return None
+    return (
+        int.from_bytes(chunk[4:8], "big"),
+        int.from_bytes(chunk[8:16], "big"),
+        int.from_bytes(chunk[16:20], "big"),
+    )
+
+
+def build_pli(sender_ssrc: int, media_ssrc: int) -> bytes:
+    return (
+        bytes([0x80 | 1, RTCP_PSFB, 0, 2])
+        + sender_ssrc.to_bytes(4, "big")
+        + media_ssrc.to_bytes(4, "big")
+    )
+
+
+def build_remb(sender_ssrc: int, bitrate_bps: float, media_ssrcs) -> bytes:
+    """REMB (draft-alvestrand-rmcat-remb): exp/mantissa bitrate + SSRC list."""
+    bitrate = max(0, int(bitrate_bps))
+    exp = 0
+    while bitrate >= (1 << 18):
+        bitrate >>= 1
+        exp += 1
+    fci = (
+        b"REMB"
+        + bytes([len(media_ssrcs)])
+        + ((exp << 18) | bitrate).to_bytes(3, "big")
+        + b"".join(s.to_bytes(4, "big") for s in media_ssrcs)
+    )
+    length_words = 2 + len(fci) // 4
+    return (
+        bytes([0x80 | 15, RTCP_PSFB])
+        + length_words.to_bytes(2, "big")
+        + sender_ssrc.to_bytes(4, "big")
+        + (0).to_bytes(4, "big")
+        + fci
+    )
+
+
+def parse_remb(fci: bytes) -> tuple[float, list[int]]:
+    if fci[:4] != b"REMB" or len(fci) < 8:
+        return 0.0, []
+    n = fci[4]
+    raw = int.from_bytes(fci[5:8], "big")
+    bitrate = float((raw & 0x3FFFF) << (raw >> 18))
+    ssrcs = [
+        int.from_bytes(fci[8 + 4 * i : 12 + 4 * i], "big")
+        for i in range(n)
+        if 12 + 4 * i <= len(fci)
+    ]
+    return bitrate, ssrcs
+
 
 @dataclass
 class SSRCBinding:
@@ -60,9 +187,31 @@ class UDPMediaTransport(asyncio.DatagramProtocol):
         self._punch_by_sub: dict[tuple, int] = {}        # (room,sub) → punch id
         self._rx_pending: list[tuple[bytes, tuple]] = []
         self._rx_scheduled = False
+        self.egress_rev: dict[int, tuple] = {}           # downtrack ssrc → (room,sub,track)
+        self.node_ssrc = secrets.randbits(32)            # our RTCP sender SSRC
+        # Upstream loss detection (buffer.go doNACKs): per publisher SSRC.
+        self._rx_hi: dict[int, int] = {}                 # ssrc → highest ext SN
+        self._rx_missing: dict[int, dict[int, list]] = {}  # ssrc → {sn: [tries, due_ms]}
+        self.on_pli = None                               # cb(room, track) for non-UDP publishers
+        # Egress SR bookkeeping: per downtrack SSRC [pkts, octets, last_ts];
+        # LSR echo table for RR → RTT (RFC 3550 A.8).
+        self._tx_sr: dict[int, list] = {}
+        self._sr_sent: dict[int, list] = {}              # ssrc → recent SR mid32s
+        self._last_sr_ms = 0.0
+        # Publisher-side SR state: upstream ssrc → (ntp64, rtp_ts) — the
+        # cross-layer timestamp anchor (forwarder.go processSourceSwitch).
+        # _ts_delta[(room, track, layer)] = layer's RTP-TS offset relative
+        # to layer 0 at a common wallclock instant; ingest subtracts it so
+        # every simulcast layer rides ONE timeline and the device munger
+        # needs no TS re-anchor at a source switch.
+        self.pub_sr: dict[int, tuple[int, int]] = {}
+        self._ts_delta: dict[tuple, int] = {}
+        self._last_pli_ms: dict[tuple, float] = {}       # (room,track) → throttle
         self.stats = {
             "rx": 0, "tx": 0, "unknown_ssrc": 0, "parse_errors": 0,
             "addr_mismatch": 0, "bad_punch": 0,
+            "rtcp_rx": 0, "rtcp_bad": 0, "nacks_rx": 0, "nacks_tx": 0,
+            "plis_rx": 0, "plis_tx": 0, "rtx_tx": 0,
         }
 
     # -- control-plane API ------------------------------------------------
@@ -86,10 +235,16 @@ class UDPMediaTransport(asyncio.DatagramProtocol):
     def release_ssrc(self, ssrc: int) -> None:
         self.bindings.pop(ssrc, None)
         self.addrs.pop(ssrc, None)
+        self._rx_hi.pop(ssrc, None)
+        self._rx_missing.pop(ssrc, None)
+        self.pub_sr.pop(ssrc, None)
 
     def release_track(self, room: int, track: int) -> None:
         """Track unpublished: drop its kind entry + every layer SSRC."""
         self.track_kind.pop((room, track), None)
+        self._last_pli_ms.pop((room, track), None)
+        for key in [k for k in self._ts_delta if k[:2] == (room, track)]:
+            del self._ts_delta[key]
         for ssrc in [
             s for s, b in self.bindings.items() if b.room == room and b.track == track
         ]:
@@ -137,7 +292,10 @@ class UDPMediaTransport(asyncio.DatagramProtocol):
         """Subscriber left: stop egress and free its SSRC map (prevents
         media leaking to a stale address once the sub col is reused)."""
         self.sub_addrs.pop((room, sub), None)
-        self.sub_ssrc.pop((room, sub), None)
+        for ssrc in (self.sub_ssrc.pop((room, sub), None) or {}).values():
+            self.egress_rev.pop(ssrc, None)
+            self._tx_sr.pop(ssrc, None)
+            self._sr_sent.pop(ssrc, None)
         pid = self._punch_by_sub.pop((room, sub), None)
         if pid is not None:
             self.punch_ids.pop(pid, None)
@@ -149,9 +307,17 @@ class UDPMediaTransport(asyncio.DatagramProtocol):
         for key in [k for k in self.sub_addrs if k[0] == room]:
             del self.sub_addrs[key]
         for key in [k for k in self.sub_ssrc if k[0] == room]:
+            for ssrc in self.sub_ssrc[key].values():
+                self.egress_rev.pop(ssrc, None)
+                self._tx_sr.pop(ssrc, None)
+                self._sr_sent.pop(ssrc, None)
             del self.sub_ssrc[key]
         for key in [k for k in self.track_kind if k[0] == room]:
             del self.track_kind[key]
+        for key in [k for k in self._last_pli_ms if k[0] == room]:
+            del self._last_pli_ms[key]
+        for key in [k for k in self._ts_delta if k[0] == room]:
+            del self._ts_delta[key]
         for key in [k for k in self._punch_by_sub if k[0] == room]:
             self.punch_ids.pop(self._punch_by_sub.pop(key), None)
 
@@ -160,6 +326,7 @@ class UDPMediaTransport(asyncio.DatagramProtocol):
         m = self.sub_ssrc.setdefault((room, sub), {})
         if track not in m:
             m[track] = self._new_ssrc()
+            self.egress_rev[m[track]] = (room, sub, track)
         return m[track]
 
     # -- datagram path ----------------------------------------------------
@@ -171,6 +338,11 @@ class UDPMediaTransport(asyncio.DatagramProtocol):
         if data[:8] == PUNCH_REQ:
             self._handle_punch(data, addr)
             return
+        # rtcp-mux demux (RFC 5761): RTCP PTs land in byte1 192-223 — a
+        # range RTP reserves — so one byte splits the flows.
+        if len(data) >= 8 and 192 <= data[1] <= 223:
+            self._handle_rtcp(data, addr)
+            return
         # Coalesce: datagrams arriving in the same event-loop iteration are
         # parsed by ONE native parse_batch call (the batch design this
         # module documents; under media load the loop wakes with many
@@ -179,6 +351,191 @@ class UDPMediaTransport(asyncio.DatagramProtocol):
         if not self._rx_scheduled:
             self._rx_scheduled = True
             asyncio.get_running_loop().call_soon(self._flush_rx)
+
+    def _handle_rtcp(self, data: bytes, addr) -> None:
+        """Compound RTCP walk: NACK → sequencer lookup, PLI → keyframe
+        request, REMB → BWE estimate sample, RR → loss/RTT bookkeeping
+        (the RTCP half of buffer.Buffer — buffer.go:673 onwards)."""
+        self.stats["rtcp_rx"] += 1
+        off = 0
+        while off + 8 <= len(data):
+            fmt = data[off] & 0x1F
+            pt = data[off + 1]
+            length = (int.from_bytes(data[off + 2 : off + 4], "big") + 1) * 4
+            chunk = data[off : off + length]
+            off += length
+            if len(chunk) < 12:
+                # Valid 8-byte chunks exist (empty RR, BYE) — skip, keep
+                # walking the compound; only truncation is malformed.
+                if len(chunk) < 8:
+                    self.stats["rtcp_bad"] += 1
+                    return
+                continue
+            media_ssrc = int.from_bytes(chunk[8:12], "big")
+            if pt == RTCP_RTPFB and fmt == 1:
+                dest = self.egress_rev.get(media_ssrc)
+                if dest is None:
+                    continue
+                room, sub, track = dest
+                # Anti-spoof: feedback must come from the sub's own address.
+                if self.sub_addrs.get((room, sub)) != addr:
+                    self.stats["addr_mismatch"] += 1
+                    continue
+                sns = parse_nack_fci(chunk[12:])
+                self.stats["nacks_rx"] += len(sns)
+                self.ingest.push_nack(room, sub, track, sns)
+            elif pt == RTCP_PSFB and fmt == 1:
+                dest = self.egress_rev.get(media_ssrc)
+                if dest is None:
+                    continue
+                room, sub, track = dest
+                if self.sub_addrs.get((room, sub)) != addr:
+                    self.stats["addr_mismatch"] += 1
+                    continue
+                self.stats["plis_rx"] += 1
+                self.send_pli(room, track)
+            elif pt == RTCP_PSFB and fmt == 15:
+                bitrate, ssrcs = parse_remb(chunk[12:])
+                if bitrate <= 0:
+                    continue
+                for s in ssrcs:
+                    dest = self.egress_rev.get(s)
+                    if dest is None:
+                        continue
+                    room, sub, _track = dest
+                    if self.sub_addrs.get((room, sub)) != addr:
+                        self.stats["addr_mismatch"] += 1
+                        break
+                    self.ingest.push_feedback(room, sub, estimate=bitrate)
+                    break  # one estimate per REMB: the channel is per-sub
+            elif pt == RTCP_SR:
+                # Publisher sender report: the (NTP, RTP-TS) anchor for
+                # cross-layer timestamp alignment (forwarder.go:1456
+                # processSourceSwitch reads exactly this pair).
+                sr = parse_sr(chunk)
+                if sr is not None:
+                    ssrc, ntp64, rtp_ts = sr
+                    b = self.bindings.get(ssrc)
+                    if b is not None and self.addrs.get(ssrc) == addr:
+                        self.pub_sr[ssrc] = (ntp64, rtp_ts)
+                        self._update_ts_deltas(b.room, b.track)
+            elif pt == RTCP_RR:
+                # Report blocks carry subscriber-observed loss per downtrack
+                # SSRC; fraction_lost feeds the BWE nack channel as a loss
+                # signal (nacktracker.go ratio semantics), and LSR/DLSR
+                # against our SR echo table yields RTT (RFC 3550 A.8).
+                count = fmt  # RC field shares the FMT bits
+                blocks = chunk[8:]
+                for i in range(count):
+                    b = blocks[i * 24 : i * 24 + 24]
+                    if len(b) < 24:
+                        break
+                    ssrc = int.from_bytes(b[0:4], "big")
+                    fraction = b[4] / 256.0
+                    dest = self.egress_rev.get(ssrc)
+                    if dest is None:
+                        continue
+                    room, sub, _track = dest
+                    if self.sub_addrs.get((room, sub)) != addr:
+                        continue
+                    # Loss itself is NOT fed to BWE here: the NACK path
+                    # already counts it (push_nack → _nacks); adding
+                    # fraction_lost would double-count the same event.
+                    lsr = int.from_bytes(b[16:20], "big")
+                    dlsr = int.from_bytes(b[20:24], "big")
+                    if lsr and lsr in self._sr_sent.get(ssrc, ()):
+                        units = (ntp_mid32(ntp_now()) - lsr - dlsr) & 0xFFFFFFFF
+                        rtt_ms = units * 1000.0 / 65536.0
+                        if 0 < rtt_ms < 10_000:
+                            self.ingest.set_rtt(room, sub, rtt_ms)
+
+    def _update_ts_deltas(self, room: int, track: int) -> None:
+        """Recompute per-layer TS offsets from the latest SR anchors
+        (forwarder.go:1456-1650 processSourceSwitch's NTP alignment): at a
+        common wallclock instant t, layer l's RTP clock reads
+        sr_rtp_l + (t - sr_ntp_l)·90k; delta_l is its lead over layer 0."""
+        anchors: dict[int, tuple[int, int]] = {}
+        for ssrc, b in self.bindings.items():
+            if b.room == room and b.track == track and ssrc in self.pub_sr:
+                anchors[b.layer] = self.pub_sr[ssrc]
+        if 0 not in anchors:
+            return
+        ntp0, rtp0 = anchors[0]
+        for layer, (ntp, rtp) in anchors.items():
+            dt_s = (ntp - ntp0) / float(1 << 32)  # ntp64 is 32.32 fixed point
+            delta = int(round(rtp - rtp0 - dt_s * 90_000.0))
+            self._ts_delta[(room, track, layer)] = delta & 0xFFFFFFFF
+
+    def send_pli(self, room: int, track: int) -> None:
+        """Keyframe request toward the publisher: RTCP PLI to every latched
+        layer SSRC of the track (downtrack.go keyframe request path); falls
+        back to the on_pli callback for signal-plane (WS) publishers.
+        Throttled per track (pliThrottle analog) so a PLI-spamming
+        subscriber cannot force a keyframe storm on the publisher."""
+        now_ms = asyncio.get_event_loop().time() * 1000.0
+        if now_ms - self._last_pli_ms.get((room, track), -1e12) < PLI_THROTTLE_MS:
+            return
+        self._last_pli_ms[(room, track)] = now_ms
+        sent = False
+        if self.transport is not None:
+            for ssrc, b in self.bindings.items():
+                if b.room == room and b.track == track:
+                    addr = self.addrs.get(ssrc)
+                    if addr is not None:
+                        self.transport.sendto(
+                            build_pli(self.node_ssrc, ssrc), addr
+                        )
+                        self.stats["plis_tx"] += 1
+                        sent = True
+        if not sent and self.on_pli is not None:
+            self.on_pli(room, track)
+
+    def _track_upstream_loss(self, ssrc: int, sn: int, now_ms: float) -> None:
+        """Extend the per-SSRC highest-SN watermark; queue NACKs for gaps
+        (buffer.go:673 doNACKs). Late arrivals clear their missing entry."""
+        ext = sn & 0xFFFF
+        hi = self._rx_hi.get(ssrc)
+        if hi is None:
+            self._rx_hi[ssrc] = ext
+            return
+        diff = (ext - hi) & 0xFFFF
+        missing = self._rx_missing.setdefault(ssrc, {})
+        if diff == 0:
+            return  # duplicate of the watermark
+        if diff < 0x8000:
+            # In-order advance; SNs (hi+1 .. ext-1) are now missing.
+            for gap in range(1, min(diff, 17)):
+                missing[(hi + gap) & 0xFFFF] = [0, now_ms]
+            if diff > 17:
+                missing.clear()  # burst loss beyond window: resync, PLI path recovers
+            self._rx_hi[ssrc] = ext
+        else:
+            # Out-of-order arrival: it fills a hole if we were tracking one.
+            missing.pop(ext, None)
+
+    def _send_upstream_nacks(self, now_ms: float) -> None:
+        if self.transport is None:
+            return
+        for ssrc, missing in self._rx_missing.items():
+            if not missing:
+                continue
+            addr = self.addrs.get(ssrc)
+            if addr is None:
+                missing.clear()
+                continue
+            due = [sn for sn, st in missing.items() if st[1] <= now_ms]
+            if not due:
+                continue
+            for sn in due:
+                st = missing[sn]
+                st[0] += 1
+                if st[0] >= 3:  # reference's maxNackTimes
+                    del missing[sn]
+                else:
+                    st[1] = now_ms + 30.0 * st[0]  # backoff between retries
+            if due:
+                self.transport.sendto(build_nack(self.node_ssrc, ssrc, due), addr)
+                self.stats["nacks_tx"] += len(due)
 
     def _handle_punch(self, data: bytes, addr) -> None:
         if len(data) < 12:
@@ -204,6 +561,7 @@ class UDPMediaTransport(asyncio.DatagramProtocol):
         pending, self._rx_pending = self._rx_pending, []
         if not pending:
             return
+        now_ms = asyncio.get_event_loop().time() * 1000.0
         lengths = np.asarray([len(d) for d, _ in pending], np.int32)
         offsets = np.zeros(len(pending), np.int32)
         np.cumsum(lengths[:-1], out=offsets[1:])
@@ -229,13 +587,27 @@ class UDPMediaTransport(asyncio.DatagramProtocol):
             if latched != addr:
                 self.stats["addr_mismatch"] += 1
                 continue
+            if binding.is_video:
+                # NACK generation is video-only (the reference negotiates
+                # NACK for video; audio loss is concealed, never replayed).
+                self._track_upstream_loss(ssrc, int(p["sn"]), now_ms)
             off, ln = int(p["payload_off"]), int(p["payload_len"])
+            # SR-based cross-layer alignment: subtract this layer's delta so
+            # all simulcast layers share layer 0's timeline; the munger then
+            # carries TS straight through a source switch (ts_aligned ⇒
+            # ts_jump = -1 on device).
+            raw_ts = int(p["ts"])
+            delta = self._ts_delta.get(
+                (binding.room, binding.track, binding.layer)
+            )
+            ts = (raw_ts - delta) & 0xFFFFFFFF if delta is not None else raw_ts
             self.ingest.push(
                 PacketIn(
                     room=binding.room,
                     track=binding.track,
                     sn=int(p["sn"]),
-                    ts=int(p["ts"]),
+                    ts=ts,
+                    ts_aligned=delta is not None,
                     size=ln,
                     payload=data[off : off + ln],
                     marker=bool(p["marker"]),
@@ -252,8 +624,39 @@ class UDPMediaTransport(asyncio.DatagramProtocol):
                     arrival_rtp=int(p["ts"]),
                 )
             )
+        self._send_upstream_nacks(now_ms)
 
-    def send_egress(self, packets) -> None:
+    def _send_srs(self, now_ms: float) -> None:
+        """~1/s sender reports per downtrack SSRC: RTT echo anchors + the
+        receiver-side sync clients need (rtcpSenderWorker analog)."""
+        if now_ms - self._last_sr_ms < 1000.0:
+            return
+        self._last_sr_ms = now_ms
+        ntp = ntp_now()
+        mid = ntp_mid32(ntp)
+        for ssrc, st in self._tx_sr.items():
+            dest = self.egress_rev.get(ssrc)
+            if dest is None:
+                continue
+            addr = self.sub_addrs.get((dest[0], dest[1]))
+            if addr is None:
+                continue
+            # RFC 3550 §6.4.1: the SR's RTP TS must correspond to the SAME
+            # instant as its NTP TS — extrapolate from the last packet's TS
+            # by the wallclock elapsed since it was sent, else the anchor
+            # skews by a frame (or unboundedly on a paused track) and
+            # receiver lip-sync drifts.
+            clock = 90_000 if self.track_kind.get((dest[0], dest[2]), True) else 48_000
+            rtp_ts = (st[2] + int((now_ms - st[3]) * clock / 1000.0)) & 0xFFFFFFFF
+            self.transport.sendto(build_sr(ssrc, ntp, rtp_ts, st[0], st[1]), addr)
+            # Keep the last few mids: an RR may echo an SR one or two
+            # behind; anything else is a stale/garbage LSR we must not
+            # let poison rtt_ms (it throttles NACK replays).
+            mids = self._sr_sent.setdefault(ssrc, [])
+            mids.append(mid)
+            del mids[:-4]
+
+    def send_egress(self, packets, rtx: bool = False) -> None:
         """Rewrite + send a tick's EgressPackets: assemble all datagrams in
         one buffer, ONE native rewrite call (headers + VP8 payload
         descriptors), then sendto per datagram (the batched write half of
@@ -311,6 +714,21 @@ class UDPMediaTransport(asyncio.DatagramProtocol):
         for off, ln, addr in zip(offsets, lengths, addrs):
             self.transport.sendto(bytes(view[off : off + ln]), addr)
             self.stats["tx"] += 1
+        if rtx:
+            self.stats["rtx_tx"] += len(offsets)
+        else:
+            # SR bookkeeping rides the primary path only (replays re-send
+            # old timestamps and must not advance the SR anchor).
+            now_ms = asyncio.get_event_loop().time() * 1000.0
+            for ssrc, ln, ts in zip(ssrcs, lengths, tss):
+                st = self._tx_sr.get(ssrc)
+                if st is None:
+                    st = self._tx_sr[ssrc] = [0, 0, 0, 0.0]
+                st[0] += 1
+                st[1] += ln - 12
+                st[2] = ts & 0xFFFFFFFF
+                st[3] = now_ms
+            self._send_srs(now_ms)
 
 
 async def start_udp_transport(

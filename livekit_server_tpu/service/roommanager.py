@@ -272,11 +272,20 @@ class RoomManager:
 
         participant.on_media(media_out)
 
+    def handle_pli(self, row: int, track_col: int) -> None:
+        """RTCP PLI from a UDP subscriber → keyframe request toward the
+        publisher over the signal plane (receiver.go SendPLI)."""
+        room = self._row_to_room.get(row)
+        if room is not None:
+            room.handle_keyframe_request(track_col)
+
     # -- tick fan-out -----------------------------------------------------
     def _dispatch_tick(self, res: TickResult) -> None:
         udp_subs = self.udp.sub_addrs if self.udp is not None else {}
         if self.udp is not None:
             self.udp.send_egress(res.egress)
+            if res.replays:
+                self.udp.send_egress(res.replays, rtx=True)  # NACK retransmits
         for pkt in res.egress:
             if (pkt.room, pkt.sub) in udp_subs:
                 continue  # delivered over UDP; don't double-send on WS

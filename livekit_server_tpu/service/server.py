@@ -155,6 +155,8 @@ class LivekitServer:
                     self.config.bind_addresses[0],
                     self.config.rtc.udp_port,
                 )
+                # Client PLIs over RTCP reach signal-plane publishers too.
+                self.room_manager.udp.on_pli = self.room_manager.handle_pli
                 for room in self.room_manager.rooms.values():
                     room.udp = self.room_manager.udp
             except OSError:

@@ -44,12 +44,19 @@ def make_inputs(dims: plane.PlaneDims, **over):
         pid=z(jnp.int32), tl0=z(jnp.int32), keyidx=z(jnp.int32),
         size=z(jnp.int32), frame_ms=jnp.full((R, T, K), 20, jnp.int32),
         audio_level=jnp.full((R, T, K), 127, jnp.int32),
-        arrival_rtp=z(jnp.int32), valid=jnp.zeros((R, T, K), jnp.bool_),
+        arrival_rtp=z(jnp.int32),
+        ts_jump=jnp.full((R, T, K), 3000, jnp.int32),
+        valid=jnp.zeros((R, T, K), jnp.bool_),
         estimate=jnp.zeros((R, S), jnp.float32),
         estimate_valid=jnp.zeros((R, S), jnp.bool_),
         nacks=jnp.zeros((R, S), jnp.float32),
+        rtt_ms=jnp.full((R, S), 100, jnp.int32),
+        nack_sn=jnp.full((R, S, plane.NACK_SLOTS), -1, jnp.int32),
+        nack_track=jnp.full((R, S, plane.NACK_SLOTS), -1, jnp.int32),
         tick_ms=jnp.int32(20),
         roll_quality=jnp.int32(0),
+        slab_base=jnp.int32(0),
+        now_ms=jnp.int32(0),
     )
     return inp._replace(**over)
 

@@ -352,6 +352,65 @@ async def test_dynacast_subscribed_quality_update(runtime):
     assert enabled == {0: True, 1: True, 2: True}
 
 
+def test_ingest_reorders_within_tick():
+    """Out-of-order arrivals inside one tick are sorted by SN before the
+    device sees them (buffer.Buffer jitter ordering, buffer.go Write)."""
+    from livekit_server_tpu.models import plane as plane_mod
+    from livekit_server_tpu.runtime.ingest import IngestBuffer
+
+    buf = IngestBuffer(plane_mod.PlaneDims(1, 2, 8, 2), tick_ms=10)
+    for sn in (102, 100, 103, 101):
+        buf.push(PacketIn(room=0, track=0, sn=sn, ts=sn * 10, size=10,
+                          payload=bytes([sn & 0xFF])))
+    inp, slab = buf.drain()
+    valid = inp.valid[0, 0]
+    assert list(inp.sn[0, 0][valid]) == [100, 101, 102, 103]
+    # Payload slab indices permuted consistently with the header fields.
+    assert slab.get(0, 0, 0)[0] == bytes([100])
+    assert slab.get(0, 0, 3)[0] == bytes([103])
+
+
+def test_ingest_reorder_handles_sn_wrap():
+    from livekit_server_tpu.models import plane as plane_mod
+    from livekit_server_tpu.runtime.ingest import IngestBuffer
+
+    buf = IngestBuffer(plane_mod.PlaneDims(1, 1, 4, 1), tick_ms=10)
+    for sn in (1, 65535, 0, 2):  # wraps 65535 → 0 → 1 → 2
+        buf.push(PacketIn(room=0, track=0, sn=sn, ts=0, size=10))
+    inp, _ = buf.drain()
+    assert list(inp.sn[0, 0][inp.valid[0, 0]]) == [65535, 0, 1, 2]
+
+
+def test_ingest_dedups_within_tick():
+    from livekit_server_tpu.models import plane as plane_mod
+    from livekit_server_tpu.runtime.ingest import IngestBuffer
+
+    buf = IngestBuffer(plane_mod.PlaneDims(1, 1, 8, 1), tick_ms=10)
+    for sn in (100, 101, 101, 102, 101):
+        buf.push(PacketIn(room=0, track=0, sn=sn, ts=0, size=10))
+    inp, _ = buf.drain()
+    assert int(inp.valid.sum()) == 3
+    assert buf.dupes == 2
+    assert sorted(inp.sn[0, 0][inp.valid[0, 0]]) == [100, 101, 102]
+
+
+def test_ingest_reorder_is_per_layer():
+    """Simulcast layers have independent SN spaces; ordering must group by
+    layer, not interleave across spaces."""
+    from livekit_server_tpu.models import plane as plane_mod
+    from livekit_server_tpu.runtime.ingest import IngestBuffer
+
+    buf = IngestBuffer(plane_mod.PlaneDims(1, 1, 8, 1), tick_ms=10)
+    buf.push(PacketIn(room=0, track=0, sn=5000, ts=0, size=10, layer=1))
+    buf.push(PacketIn(room=0, track=0, sn=101, ts=0, size=10, layer=0))
+    buf.push(PacketIn(room=0, track=0, sn=5001, ts=0, size=10, layer=1))
+    buf.push(PacketIn(room=0, track=0, sn=100, ts=0, size=10, layer=0))
+    inp, _ = buf.drain()
+    v = inp.valid[0, 0]
+    pairs = list(zip(inp.layer[0, 0][v], inp.sn[0, 0][v]))
+    assert pairs == [(0, 100), (0, 101), (1, 5000), (1, 5001)]
+
+
 async def test_checkpoint_restore_mid_stream(runtime):
     """Munger state survives snapshot/restore (migration seeding, §5.4)."""
     room = Room("ckpt", runtime)

@@ -69,6 +69,24 @@ def test_source_switch_continues_sn_space():
     np.testing.assert_array_equal(np.asarray(ts)[:, 0], [5000, 5500])
 
 
+def test_source_switch_aligned_timeline_keeps_ts_offset():
+    """jump = -1: the host SR-normalized both layers onto one timeline, so
+    a switch re-anchors SN but carries TS straight through — exact
+    continuity instead of the one-frame guess (forwarder.go:1456)."""
+    st = rtpmunger.init_state(1)
+    st, *_ = _tick(st, [100, 101], [1000, 2000], [[1], [1]])
+    # New stream, SNs from a different space but TS already normalized:
+    # next frame on the shared timeline is 5000.
+    st, sn, ts, send = _tick(
+        st, [7000, 7001], [5000, 5090], [[1], [1]], switch=[[1], [0]], jump=[-1, -1]
+    )
+    np.testing.assert_array_equal(np.asarray(sn)[:, 0], [102, 103])
+    np.testing.assert_array_equal(np.asarray(ts)[:, 0], [5000, 5090])
+    # The offset survives a later non-switch tick too.
+    st, _, ts, _ = _tick(st, [7002], [5180], [[1]])
+    assert int(ts[0, 0]) == 5180
+
+
 def test_sn_wraparound():
     st = rtpmunger.init_state(1)
     st, sn, _, _ = _tick(st, [65534, 65535, 0, 1], [0, 0, 0, 0], [[1]] * 4)

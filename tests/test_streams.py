@@ -42,37 +42,94 @@ def test_tracker_bitrate_tracks_input():
 
 # ---- sequencer / NACK -------------------------------------------------
 
+def _push(st, out_sn, sent, keys, now_ms, track=None, ts=None, meta=None):
+    P, S = out_sn.shape
+    track = track if track is not None else jnp.zeros((P,), jnp.int32)
+    ts = ts if ts is not None else out_sn * 10
+    meta = meta if meta is not None else jnp.zeros((P, S), jnp.int32)
+    return sequencer.push_tick(st, out_sn, ts, meta, track, sent, keys, now_ms)
+
+
+def _lookup(st, nacks, now_ms, rtt, track=None, max_age=1 << 30):
+    track = track if track is not None else jnp.zeros_like(nacks)
+    return sequencer.lookup_nacks(st, nacks, track, now_ms, rtt, max_age)
+
+
 def test_sequencer_push_and_nack_replay():
     st = sequencer.init_state(2)
     out_sn = jnp.asarray([[100, 200], [101, 201]], jnp.int32)  # [P=2, S=2]
     sent = jnp.asarray([[True, True], [True, False]])
-    st = sequencer.push_tick(st, out_sn, sent, jnp.asarray([7, 8], jnp.int32), 1000)
+    st = _push(st, out_sn, sent, jnp.asarray([7, 8], jnp.int32), 1000)
 
     nacks = jnp.asarray([[100, 101], [200, 201]], jnp.int32)
-    st, key, ok = sequencer.lookup_nacks(st, nacks, 1100, jnp.asarray([50, 50], jnp.int32))
+    st, key, ts, meta, ok = _lookup(st, nacks, 1100, jnp.asarray([50, 50], jnp.int32))
     assert ok.tolist() == [[True, True], [True, False]]  # 201 never sent to sub1
     assert key.tolist() == [[7, 8], [7, -1]]
+    assert int(ts[0, 0]) == 1000  # original munged TS travels with the slot
+
+
+def test_sequencer_track_mismatch_rejected():
+    st = sequencer.init_state(1)
+    st = _push(
+        st, jnp.asarray([[100]], jnp.int32), jnp.asarray([[True]]),
+        jnp.asarray([7], jnp.int32), 0, track=jnp.asarray([2], jnp.int32),
+    )
+    # NACK for the same SN on a different track misses (shared-ring safety).
+    st, key, _ts, _m, ok = _lookup(
+        st, jnp.asarray([[100]], jnp.int32), 10, jnp.asarray([1], jnp.int32),
+        track=jnp.asarray([[1]], jnp.int32),
+    )
+    assert not bool(ok[0, 0])
+    st, key, _ts, _m, ok = _lookup(
+        st, jnp.asarray([[100]], jnp.int32), 10, jnp.asarray([1], jnp.int32),
+        track=jnp.asarray([[2]], jnp.int32),
+    )
+    assert bool(ok[0, 0]) and int(key[0, 0]) == 7
+
+
+def test_sequencer_vp8_meta_roundtrip():
+    pid, tl0, ki = 12345, 200, 17
+    meta = sequencer.pack_meta(
+        jnp.asarray(pid), jnp.asarray(tl0), jnp.asarray(ki)
+    )
+    p, t, k = sequencer.unpack_meta(int(meta))
+    assert (p, t, k) == (pid, tl0, ki)
 
 
 def test_sequencer_rtt_throttle():
     st = sequencer.init_state(1)
-    st = sequencer.push_tick(
-        st, jnp.asarray([[500]], jnp.int32), jnp.asarray([[True]]), jnp.asarray([3], jnp.int32), 0
+    st = _push(
+        st, jnp.asarray([[500]], jnp.int32), jnp.asarray([[True]]),
+        jnp.asarray([3], jnp.int32), 0,
     )
     nack = jnp.asarray([[500]], jnp.int32)
-    st, key, ok = sequencer.lookup_nacks(st, nack, 10, jnp.asarray([100], jnp.int32))
+    st, key, _ts, _m, ok = _lookup(st, nack, 10, jnp.asarray([100], jnp.int32))
     assert bool(ok[0, 0])
     # immediate repeat within RTT → throttled
-    st, key, ok = sequencer.lookup_nacks(st, nack, 50, jnp.asarray([100], jnp.int32))
+    st, key, _ts, _m, ok = _lookup(st, nack, 50, jnp.asarray([100], jnp.int32))
     assert not bool(ok[0, 0])
     # after RTT → replayable again
-    st, key, ok = sequencer.lookup_nacks(st, nack, 200, jnp.asarray([100], jnp.int32))
+    st, key, _ts, _m, ok = _lookup(st, nack, 200, jnp.asarray([100], jnp.int32))
     assert bool(ok[0, 0])
+
+
+def test_sequencer_age_gate():
+    st = sequencer.init_state(1)
+    st = _push(
+        st, jnp.asarray([[500]], jnp.int32), jnp.asarray([[True]]),
+        jnp.asarray([3], jnp.int32), 0,
+    )
+    # Entry older than the host slab window must not resolve.
+    st, key, _ts, _m, ok = _lookup(
+        st, jnp.asarray([[500]], jnp.int32), 700, jnp.asarray([10], jnp.int32),
+        max_age=620,
+    )
+    assert not bool(ok[0, 0])
 
 
 def test_sequencer_unknown_sn_rejected():
     st = sequencer.init_state(1)
-    st, key, ok = sequencer.lookup_nacks(
+    st, key, _ts, _m, ok = _lookup(
         st, jnp.asarray([[12345]], jnp.int32), 0, jnp.asarray([0], jnp.int32)
     )
     assert not bool(ok[0, 0]) and int(key[0, 0]) == -1

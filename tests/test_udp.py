@@ -55,7 +55,8 @@ async def test_udp_publish_forward_receive():
             while True:
                 try:
                     data, _ = sub.recvfrom(2048)
-                    got.append(data)
+                    if not (192 <= data[1] <= 223):  # skip interleaved RTCP SRs
+                        got.append(data)
                 except BlockingIOError:
                     break
 
@@ -126,7 +127,9 @@ async def test_udp_vp8_rewrite_reaches_wire_across_layer_switch():
         got = []
         while True:
             try:
-                got.append(sub.recvfrom(4096)[0])
+                data = sub.recvfrom(4096)[0]
+                if not (192 <= data[1] <= 223):  # skip interleaved RTCP SRs
+                    got.append(data)
             except BlockingIOError:
                 break
         assert len(got) >= 10, f"only {len(got)} packets received"
@@ -142,6 +145,87 @@ async def test_udp_vp8_rewrite_reaches_wire_across_layer_switch():
         # no 1000→5000 jump may survive to the payload bytes.
         diffs = [b - a for a, b in zip(pids, pids[1:])]
         assert all(d == 1 for d in diffs), f"pids not contiguous: {pids}"
+        pub.close()
+        sub.close()
+    finally:
+        transport.transport.close()
+
+
+async def test_udp_sr_aligned_ts_across_layer_switch():
+    """Publisher SRs for both simulcast layers put them on one timeline;
+    the wire TS across a layer switch is then exactly continuous (no
+    fallback one-frame jump) — forwarder.go:1456 processSourceSwitch."""
+    from livekit_server_tpu.runtime.udp import build_sr, ntp_now
+
+    runtime = PlaneRuntime(DIMS, tick_ms=10)
+    s = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    transport = await start_udp_transport(runtime.ingest, "127.0.0.1", port)
+    try:
+        runtime.set_track(0, 0, published=True, is_video=True)
+        runtime.set_subscription(0, 0, 1, subscribed=True)
+        ssrc0 = transport.assign_ssrc(room=0, track=0, is_video=True, layer=0)
+        ssrc1 = transport.assign_ssrc(room=0, track=0, is_video=True, layer=1)
+
+        pub = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
+        pub.bind(("127.0.0.1", 0))
+        sub = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
+        sub.bind(("127.0.0.1", 0))
+        sub.setblocking(False)
+        transport.register_subscriber(0, 1, sub.getsockname())
+
+        # Layer 1's RTP clock leads layer 0's by exactly 100_000 units:
+        # same capture instant, offset TS spaces.
+        L1_OFF = 100_000
+        ntp = ntp_now()
+
+        async def send_and_step(sn, ts, ssrc, pid, keyframe):
+            pub.sendto(
+                rtp_packet(
+                    sn=sn, ts=ts, ssrc=ssrc, pt=96,
+                    payload=vp8_payload(pid=pid, tl0=pid % 256, tid=0,
+                                        keyidx=pid % 32, keyframe=keyframe),
+                ),
+                ("127.0.0.1", port),
+            )
+            await asyncio.sleep(0.02)
+            res = await runtime.step_once()
+            transport.send_egress(res.egress)
+            await asyncio.sleep(0.01)
+
+        # Latch both SSRCs, then anchor both layers with SRs at one instant.
+        await send_and_step(100, 0, ssrc0, 1000, True)
+        await send_and_step(500, L1_OFF, ssrc1, 5000, True)
+        pub.sendto(build_sr(ssrc0, ntp, 0, 1, 100), ("127.0.0.1", port))
+        pub.sendto(build_sr(ssrc1, ntp, L1_OFF, 1, 100), ("127.0.0.1", port))
+        await asyncio.sleep(0.05)
+        assert transport._ts_delta[(0, 0, 1)] == L1_OFF
+        assert transport._ts_delta[(0, 0, 0)] == 0
+
+        # Frames advance at 3000 units/frame on the shared timeline.
+        for i in range(1, 6):
+            await send_and_step(100 + i, 3000 * i, ssrc0, 1000 + i, i == 1)
+        for i in range(30):
+            await send_and_step(
+                501 + i, L1_OFF + 3000 * (6 + i), ssrc1, 5000 + i, True
+            )
+
+        tss = []
+        while True:
+            try:
+                data = sub.recvfrom(4096)[0]
+            except BlockingIOError:
+                break
+            if 192 <= data[1] <= 223:
+                continue
+            tss.append(int.from_bytes(data[4:8], "big"))
+        assert len(tss) >= 10
+        # Every wire TS sits on the 3000-unit shared grid — the switch
+        # introduced no fallback jump and no L1_OFF leak.
+        diffs = [b - a for a, b in zip(tss, tss[1:])]
+        assert all(d % 3000 == 0 and 0 < d <= 9000 for d in diffs), (tss, diffs)
         pub.close()
         sub.close()
     finally:
@@ -217,6 +301,213 @@ async def test_udp_punch_latches_only_real_source():
         transport.release_subscriber(0, 1)
         assert pid2 not in transport.punch_ids
         assert (0, 1) not in transport._punch_by_sub
+        sub.close()
+    finally:
+        transport.transport.close()
+
+
+async def test_udp_nack_rtx_end_to_end():
+    """A subscriber loses a packet, NACKs it over RTCP, and receives the
+    retransmit with the original munged SN and payload bytes (the
+    buffer.go:673 → sequencer.go:263 replay loop, device-resolved)."""
+    from livekit_server_tpu.runtime.udp import build_nack
+
+    runtime = PlaneRuntime(DIMS, tick_ms=10)
+    s = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    transport = await start_udp_transport(runtime.ingest, "127.0.0.1", port)
+    try:
+        runtime.set_track(0, 0, published=True, is_video=False)
+        runtime.set_subscription(0, 0, 1, subscribed=True)
+        ssrc = transport.assign_ssrc(room=0, track=0, is_video=False)
+
+        pub = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
+        pub.bind(("127.0.0.1", 0))
+        sub = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
+        sub.bind(("127.0.0.1", 0))
+        sub.setblocking(False)
+        transport.register_subscriber(0, 1, sub.getsockname())
+
+        for i in range(5):
+            pub.sendto(
+                rtp_packet(sn=600 + i, ts=960 * i, ssrc=ssrc, audio_level=20,
+                           payload=b"opus" + bytes([i])),
+                ("127.0.0.1", port),
+            )
+            await asyncio.sleep(0.02)
+            res = await runtime.step_once()
+            transport.send_egress(res.egress)
+            await asyncio.sleep(0.01)
+        while True:  # drain the original deliveries ("the client lost 602")
+            try:
+                sub.recvfrom(2048)
+            except BlockingIOError:
+                break
+
+        # The client NACKs munged SN 602 on its downtrack SSRC.
+        dt_ssrc = transport.subscriber_ssrc(0, 1, 0)
+        sub.sendto(build_nack(0x1234, dt_ssrc, [602]), ("127.0.0.1", port))
+        await asyncio.sleep(0.03)
+        assert transport.stats["nacks_rx"] == 1
+
+        res = await runtime.step_once()
+        assert len(res.replays) == 1
+        rp = res.replays[0]
+        assert (rp.room, rp.sub, rp.track) == (0, 1, 0)
+        assert rp.sn == 602 and rp.payload == b"opus\x02"
+        transport.send_egress(res.replays)
+        await asyncio.sleep(0.03)
+        data, _ = sub.recvfrom(2048)
+        out = parser.parse_batch(
+            data, np.asarray([0], np.int32), np.asarray([len(data)], np.int32)
+        )[0]
+        assert int(out["sn"]) == 602
+        off, ln = int(out["payload_off"]), int(out["payload_len"])
+        assert data[off : off + ln] == b"opus\x02"
+
+        # Immediate duplicate NACK is RTT-throttled on device.
+        sub.sendto(build_nack(0x1234, dt_ssrc, [602]), ("127.0.0.1", port))
+        await asyncio.sleep(0.03)
+        res = await runtime.step_once()
+        assert len(res.replays) == 0
+        pub.close()
+        sub.close()
+    finally:
+        transport.transport.close()
+
+
+async def test_udp_upstream_nack_generation():
+    """A gap in the publisher's SN stream makes the server NACK the
+    publisher over RTCP (buffer.go doNACKs), and a late arrival of the
+    missing packet clears the request."""
+    from livekit_server_tpu.runtime.udp import RTCP_RTPFB, parse_nack_fci
+
+    runtime = PlaneRuntime(DIMS, tick_ms=10)
+    s = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    transport = await start_udp_transport(runtime.ingest, "127.0.0.1", port)
+    try:
+        runtime.set_track(0, 0, published=True, is_video=True)
+        ssrc = transport.assign_ssrc(room=0, track=0, is_video=True)
+        pub = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
+        pub.bind(("127.0.0.1", 0))
+        pub.setblocking(False)
+
+        pub.sendto(rtp_packet(sn=100, ssrc=ssrc, payload=b"a"), ("127.0.0.1", port))
+        await asyncio.sleep(0.03)
+        # 101, 102 go missing:
+        pub.sendto(rtp_packet(sn=103, ssrc=ssrc, payload=b"d"), ("127.0.0.1", port))
+        await asyncio.sleep(0.03)
+        # Server sent a NACK for 101+102 back to the publisher's address.
+        data, _ = pub.recvfrom(2048)
+        assert data[1] == RTCP_RTPFB
+        assert sorted(parse_nack_fci(data[12:])) == [101, 102]
+        assert transport.stats["nacks_tx"] == 2
+
+        # The publisher retransmits 101; it must land in ingest and leave
+        # only 102 tracked as missing.
+        pub.sendto(rtp_packet(sn=101, ssrc=ssrc, payload=b"b"), ("127.0.0.1", port))
+        await asyncio.sleep(0.03)
+        assert 101 not in transport._rx_missing[ssrc]
+        assert 102 in transport._rx_missing[ssrc]
+        pub.close()
+    finally:
+        transport.transport.close()
+
+
+async def test_udp_remb_feeds_bwe_estimate():
+    """A REMB from the subscriber's own address lands as a BWE estimate
+    sample; one from a spoofed source is rejected."""
+    from livekit_server_tpu.runtime.udp import build_remb
+
+    runtime = PlaneRuntime(DIMS, tick_ms=10)
+    s = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    transport = await start_udp_transport(runtime.ingest, "127.0.0.1", port)
+    try:
+        sub = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
+        sub.bind(("127.0.0.1", 0))
+        transport.register_subscriber(0, 1, sub.getsockname())
+        dt_ssrc = transport.subscriber_ssrc(0, 1, 0)
+
+        sub.sendto(build_remb(0x1234, 2_500_000.0, [dt_ssrc]), ("127.0.0.1", port))
+        await asyncio.sleep(0.03)
+        assert runtime.ingest._estimate_valid[0, 1]
+        assert abs(runtime.ingest._estimate[0, 1] - 2_500_000.0) / 2_500_000.0 < 0.01
+
+        evil = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
+        evil.bind(("127.0.0.1", 0))
+        evil.sendto(build_remb(0x1234, 10.0, [dt_ssrc]), ("127.0.0.1", port))
+        await asyncio.sleep(0.03)
+        assert runtime.ingest._estimate[0, 1] > 1_000_000  # unchanged
+        assert transport.stats["addr_mismatch"] >= 1
+        evil.close()
+        sub.close()
+    finally:
+        transport.transport.close()
+
+
+async def test_udp_sender_report_and_rtt():
+    """The server emits SRs per downtrack SSRC; a subscriber's RR echoing
+    LSR/DLSR updates that sub's RTT (RFC 3550 A.8 → sequencer throttle)."""
+    from livekit_server_tpu.runtime.udp import RTCP_RR, RTCP_SR, ntp_mid32, ntp_now
+
+    runtime = PlaneRuntime(DIMS, tick_ms=10)
+    s = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    transport = await start_udp_transport(runtime.ingest, "127.0.0.1", port)
+    try:
+        runtime.set_track(0, 0, published=True, is_video=False)
+        runtime.set_subscription(0, 0, 1, subscribed=True)
+        ssrc = transport.assign_ssrc(room=0, track=0, is_video=False)
+        pub = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
+        pub.bind(("127.0.0.1", 0))
+        sub = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
+        sub.bind(("127.0.0.1", 0))
+        sub.setblocking(False)
+        transport.register_subscriber(0, 1, sub.getsockname())
+        transport._last_sr_ms = -1e9  # force the first SR immediately
+
+        pub.sendto(rtp_packet(sn=600, ts=960, ssrc=ssrc, payload=b"x"),
+                   ("127.0.0.1", port))
+        await asyncio.sleep(0.02)
+        res = await runtime.step_once()
+        transport.send_egress(res.egress)
+        await asyncio.sleep(0.02)
+
+        sr = None
+        while True:
+            try:
+                data, _ = sub.recvfrom(2048)
+            except BlockingIOError:
+                break
+            if data[1] == RTCP_SR:
+                sr = data
+        assert sr is not None, "no SR emitted alongside egress"
+        dt_ssrc = int.from_bytes(sr[4:8], "big")
+        lsr = ntp_mid32(int.from_bytes(sr[8:16], "big"))
+
+        # RR from the sub: fraction_lost 0, echoes LSR immediately (DLSR 0).
+        block = (
+            dt_ssrc.to_bytes(4, "big") + bytes([0]) + (0).to_bytes(3, "big")
+            + (600).to_bytes(4, "big") + (0).to_bytes(4, "big")
+            + lsr.to_bytes(4, "big") + (0).to_bytes(4, "big")
+        )
+        rr = bytes([0x80 | 1, RTCP_RR, 0, 7]) + (0x1234).to_bytes(4, "big") + block
+        sub.sendto(rr, ("127.0.0.1", port))
+        await asyncio.sleep(0.03)
+        # RTT = now - lsr - dlsr: tiny on loopback, so anything recorded
+        # below the 100 ms default proves the path ran.
+        assert runtime.ingest.rtt_ms[0, 1] < 100
+        pub.close()
         sub.close()
     finally:
         transport.transport.close()
