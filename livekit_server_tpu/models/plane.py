@@ -55,6 +55,8 @@ SPEAKER_TOP_K = 3
 NACK_SLOTS = 8          # max NACKed SNs resolvable per subscriber per tick
 SLAB_WINDOW = 64        # ticks of payload history the host retains for RTX
                         # (sequencer.go rtt-bounded ring; 64×10 ms = 640 ms)
+PAD_MAX = 8             # max probe-padding packets per subscriber per tick
+                        # (8 × 255 B / 10 ms ≈ 1.6 Mbps of probe headroom)
 # Cold-start per-temporal-sublayer bitrate shares, used only until measured
 # per-temporal byte attribution (state.temporal_bytes) accumulates — the
 # live path derives the [4][4] Bitrates matrix from observed traffic like
@@ -139,6 +141,9 @@ class TickInputs(NamedTuple):
     # NACK resolution requests, [R, S, NACK_SLOTS] (-1 = empty):
     nack_sn: jax.Array         # int32 — munged SNs subscribers NACKed
     nack_track: jax.Array      # int32 — track each NACK targets
+    # BWE probe padding (probe_controller → WritePaddingRTP), [R, S]:
+    pad_num: jax.Array         # int32 — padding packets to synthesize (≤ PAD_MAX)
+    pad_track: jax.Array       # int32 — track whose downtrack carries them (-1 none)
     # Scalars:
     tick_ms: jax.Array     # int32
     roll_quality: jax.Array  # int32 bool-ish — close the stats window this
@@ -190,6 +195,15 @@ class TickOutputs(NamedTuple):
     replay_key: jax.Array      # [R, S, NACK_SLOTS] int32 slab key; -1 = miss
     replay_ts: jax.Array       # [R, S, NACK_SLOTS] int32 original munged TS
     replay_meta: jax.Array     # [R, S, NACK_SLOTS] int32 packed VP8 desc
+    # Probe padding synthesized this tick (rtpmunger.padding_tick):
+    pad_sn: jax.Array          # [R, S, PAD_MAX] int32 — munged padding SNs
+    pad_ts: jax.Array          # [R, S, PAD_MAX] int32
+    pad_valid: jax.Array       # [R, S, PAD_MAX] bool
+    # Allocator budget per subscriber (probe goal baseline + telemetry):
+    committed_bps: jax.Array   # [R, S] float32
+    deficient: jax.Array       # [R, S] bool — allocation under-served this
+                               # sub (probe trigger; streamallocator
+                               # "deficient" state)
 
 
 def init_state(dims: PlaneDims) -> PlaneState:
@@ -378,6 +392,25 @@ def _room_tick(
         inp.now_ms,
     )
 
+    # ---- probe padding (WritePaddingRTP, downtrack.go:764) -------------
+    # The host probe controller asks for pad_num packets on pad_track's
+    # downtrack; padding continues the munged SN space after this tick's
+    # real sends, so it must run AFTER munge_tick.
+    pad_n = jnp.where(
+        jnp.arange(T, dtype=jnp.int32)[:, None] == inp.pad_track[None, :],
+        jnp.clip(inp.pad_num, 0, PAD_MAX)[None, :],
+        0,
+    )  # [T, S]
+    ts_adv = jnp.broadcast_to(inp.tick_ms * 90, (T, S)).astype(jnp.int32)
+    munger_state, t_pad_sn, t_pad_ts, t_pad_valid = jax.vmap(
+        lambda st, n, adv: rtpmunger.padding_tick(st, n, PAD_MAX, adv)
+    )(munger_state, pad_n, ts_adv)  # [T, PAD_MAX, S]
+    safe_track = jnp.clip(inp.pad_track, 0, T - 1)           # [S]
+    sub_ix = jnp.arange(S, dtype=jnp.int32)
+    pad_sn = t_pad_sn[safe_track, :, sub_ix]                  # [S, PAD_MAX]
+    pad_ts = t_pad_ts[safe_track, :, sub_ix]
+    pad_valid = t_pad_valid[safe_track, :, sub_ix] & (inp.pad_track >= 0)[:, None]
+
     # ---- BWE per subscriber (uses this tick's actual send counts) ------
     pkts_sent = jnp.sum(send, axis=(0, 1)).astype(jnp.float32)  # [S]
     bwe_state, congested, trend, budget = bwe.update_tick(
@@ -515,6 +548,11 @@ def _room_tick(
         replay_key=replay_key,
         replay_ts=replay_ts,
         replay_meta=replay_meta,
+        pad_sn=pad_sn,
+        pad_ts=pad_ts,
+        pad_valid=pad_valid,
+        committed_bps=budget,
+        deficient=any_deficient,
     )
     return new_state, outputs
 
@@ -574,7 +612,7 @@ _BOOL_FIELDS = {"keyframe", "layer_sync", "begin_pic", "end_frame", "valid"}
 
 
 def pack_tick_inputs(inp: TickInputs):
-    """Host-side: TickInputs → (pkt [F,R,T,K] i32, fb [4,R,S] f32,
+    """Host-side: TickInputs → (pkt [F,R,T,K] i32, fb [6,R,S] f32,
     nk [2,R,S,M] i32, tick_ms, roll_quality, slab_base, now_ms)."""
     import numpy as np
 
@@ -585,6 +623,8 @@ def pack_tick_inputs(inp: TickInputs):
             np.asarray(inp.estimate_valid).astype(np.float32),
             np.asarray(inp.nacks, np.float32),
             np.asarray(inp.rtt_ms, np.float32),
+            np.asarray(inp.pad_num, np.float32),
+            np.asarray(inp.pad_track, np.float32),
         ]
     )
     nk = np.stack(
@@ -616,6 +656,8 @@ def unpack_tick_inputs(
         estimate_valid=fb[1] > 0.5,
         nacks=fb[2],
         rtt_ms=fb[3].astype(jnp.int32),
+        pad_num=fb[4].astype(jnp.int32),
+        pad_track=fb[5].astype(jnp.int32),
         nack_sn=nk[0],
         nack_track=nk[1],
         tick_ms=tick_ms,
@@ -665,9 +707,15 @@ def unpack_tick_outputs(buf, dims: PlaneDims, egress_cap: int) -> TickOutputs:
         "replay_key": (R, S, NACK_SLOTS),
         "replay_ts": (R, S, NACK_SLOTS),
         "replay_meta": (R, S, NACK_SLOTS),
+        "pad_sn": (R, S, PAD_MAX),
+        "pad_ts": (R, S, PAD_MAX),
+        "pad_valid": (R, S, PAD_MAX),
+        "committed_bps": (R, S),
+        "deficient": (R, S),
     }
-    floats = {"speaker_levels", "track_mos", "track_loss_pct", "track_jitter_ms", "track_bps"}
-    bools = {"need_keyframe", "congested"}
+    floats = {"speaker_levels", "track_mos", "track_loss_pct", "track_jitter_ms",
+              "track_bps", "committed_bps"}
+    bools = {"need_keyframe", "congested", "pad_valid", "deficient"}
     buf = np.asarray(buf)
     pieces, off = {}, 0
     for name in TickOutputs._fields:

@@ -206,6 +206,8 @@ def next_tick(
         rtt_ms=np.full((R, S), 100, np.int32),
         nack_sn=np.full((R, S, plane.NACK_SLOTS), -1, np.int32),
         nack_track=np.full((R, S, plane.NACK_SLOTS), -1, np.int32),
+        pad_num=np.zeros((R, S), np.int32),
+        pad_track=np.full((R, S), -1, np.int32),
         tick_ms=np.int32(spec.tick_ms),
         roll_quality=np.int32(0),
         slab_base=np.int32((tick_index % plane.SLAB_WINDOW) * T * K),

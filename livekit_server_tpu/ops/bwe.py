@@ -35,6 +35,11 @@ class BWEParams(NamedTuple):
     nack_window_min_packets: int = 10
     estimate_required_downgrades: int = 3  # lowering samples to call a downtrend
     congested_min_estimate: float = 100_000.0  # floor on usable estimate
+    stale_ticks: int = 50  # a downtrend older than this many sample-less
+                           # ticks no longer holds the channel congested
+                           # (channelobserver windows age out; without this
+                           # a client that stops reporting would freeze the
+                           # congested state and starve the probe controller)
 
 
 class BWEState(NamedTuple):
@@ -47,6 +52,7 @@ class BWEState(NamedTuple):
     nack_count: jax.Array      # [..., S] float32 — window nack count
     congested: jax.Array       # [..., S] bool
     committed_channel_capacity: jax.Array  # [..., S] float32 — allocator budget
+    ticks_since_sample: jax.Array  # [..., S] int32 — staleness counter
 
 
 def init_state(num_subscribers: int, initial_estimate: float = 7_000_000.0) -> BWEState:
@@ -59,6 +65,7 @@ def init_state(num_subscribers: int, initial_estimate: float = 7_000_000.0) -> B
         nack_count=jnp.zeros(s, jnp.float32),
         congested=jnp.zeros(s, jnp.bool_),
         committed_channel_capacity=jnp.full(s, initial_estimate, jnp.float32),
+        ticks_since_sample=jnp.zeros(s, jnp.int32),
     )
 
 
@@ -111,8 +118,11 @@ def update_tick(
     )
 
     # --- congestion state machine (channelobserver GetTrend semantics:
-    # lowering estimate or high nack ratio ⇒ congested) ---
-    congested = (trend < 0) | nack_bad
+    # lowering estimate or high nack ratio ⇒ congested). A downtrend only
+    # counts while samples are fresh: with no reports the window is stale
+    # and must not pin the channel congested forever.
+    ticks_since = jnp.where(estimate_valid, 0, state.ticks_since_sample + 1)
+    congested = ((trend < 0) & (ticks_since < params.stale_ticks)) | nack_bad
     # Commit capacity on congestion onset; recover to estimate when clear.
     committed = jnp.where(
         congested,
@@ -132,6 +142,7 @@ def update_tick(
         nack_count=nack_count * 0.5,
         congested=congested,
         committed_channel_capacity=committed,
+        ticks_since_sample=ticks_since,
     )
     return new_state, congested, trend, committed
 

@@ -184,7 +184,12 @@ def padding_tick(
     ks = jnp.arange(max_num, dtype=jnp.int32)[:, None]  # [max_num, 1]
     valid = (ks < num[None, :]) & state.started[None, :]
     pad_sn = seqnum.add16(state.last_sn[None, :], ks + 1)
-    pad_ts = seqnum.add32(state.last_ts[None, :], ts_advance[None, :])
+    # All padding packets in one burst share the advanced TS (they carry no
+    # media; UpdateAndGetPaddingSnTs gives the whole run one timestamp).
+    pad_ts = jnp.broadcast_to(
+        seqnum.add32(state.last_ts[None, :], ts_advance[None, :]),
+        (max_num, num.shape[-1]),
+    )
     n = jnp.where(state.started, num, 0)
     new_state = MungerState(
         # Outgoing SN space advanced by n with no incoming packets ⇒ offset -= n.

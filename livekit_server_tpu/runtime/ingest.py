@@ -247,11 +247,19 @@ class IngestBuffer:
             self.dupes += n
 
     def drain(
-        self, roll_quality: bool = False, tick_index: int = 0
+        self,
+        roll_quality: bool = False,
+        tick_index: int = 0,
+        pad_num=None,
+        pad_track=None,
     ) -> tuple[plane.TickInputs, PayloadSlab]:
         """Snapshot this tick's tensors and reset for the next tick."""
         self._reorder_dedup()
-        R, T, K, _S = self.dims
+        R, T, K, S = self.dims
+        if pad_num is None:
+            pad_num = np.zeros((R, S), np.int32)
+        if pad_track is None:
+            pad_track = np.full((R, S), -1, np.int32)
         inp = plane.TickInputs(
             sn=self.sn.copy(), ts=self.ts.copy(), layer=self.layer.copy(),
             temporal=self.temporal.copy(), keyframe=self.keyframe.copy(),
@@ -268,6 +276,8 @@ class IngestBuffer:
             rtt_ms=self.rtt_ms.copy(),
             nack_sn=self._nack_sn.copy(),
             nack_track=self._nack_track.copy(),
+            pad_num=np.asarray(pad_num, np.int32),
+            pad_track=np.asarray(pad_track, np.int32),
             tick_ms=np.int32(self.tick_ms),
             roll_quality=np.int32(1 if roll_quality else 0),
             slab_base=np.int32((tick_index % plane.SLAB_WINDOW) * T * K),

@@ -35,6 +35,7 @@ import numpy as np
 
 from livekit_server_tpu.models import plane
 from livekit_server_tpu.runtime.ingest import IngestBuffer
+from livekit_server_tpu.runtime.probe import PAD_BYTES, ProbeController
 from livekit_server_tpu.runtime.slots import SlotAllocator
 
 
@@ -53,6 +54,7 @@ class EgressPacket:
     size: int
     payload: bytes
     marker: bool = False
+    padding: bool = False  # probe padding (RTP P-bit; no media payload)
 
 
 @dataclass
@@ -113,6 +115,7 @@ class TickResult:
     fwd_bytes: int
     tick_s: float                                    # wall time of the step
     replays: list[EgressPacket] = field(default_factory=list)  # NACK retransmits
+    padding: list[EgressPacket] = field(default_factory=list)  # probe padding
     # Quality / stats tensors (numpy views of TickOutputs; consumers index
     # by room row). None until the first tick completes.
     track_quality: Any = None     # [R, T] int32 ConnectionQuality enum
@@ -210,6 +213,12 @@ class PlaneRuntime:
         # reference slot tick % SLAB_WINDOW; sequencer.lookup_nacks age-gates
         # on device so a recycled slot is never dereferenced).
         self._slab_history: list = [None] * plane.SLAB_WINDOW
+        # BWE probe controller (probe_controller.go) + its inputs mirrored
+        # from the previous tick's outputs.
+        self.prober = ProbeController(dims, tick_ms)
+        self._last_committed = np.zeros((R, S), np.float32)
+        self._last_congested = np.zeros((R, S), bool)
+        self._last_deficient = np.zeros((R, S), bool)
         self._task: asyncio.Task | None = None
         self._on_tick: list[Callable[[TickResult], Awaitable[None] | None]] = []
         self.stats = {"ticks": 0, "fwd_packets": 0, "fwd_bytes": 0, "late_ticks": 0}
@@ -287,12 +296,37 @@ class PlaneRuntime:
         # (connectionquality windows; room.go:1318 worker cadence).
         q_ticks = max(1, 1000 // self.tick_ms)
         roll = (self.tick_index + 1) % q_ticks == 0
-        inp, payloads = self.ingest.drain(roll_quality=roll, tick_index=self.tick_index)
+        # Probe scheduling (probe_controller.go): padding rides the first
+        # live video track each subscriber is actually SUBSCRIBED to (its
+        # munger lane must be started for padding_tick to emit anything);
+        # results return as estimate samples.
+        vid = self.meta.is_video & self.meta.published & ~self.meta.pub_muted
+        cand = vid[:, :, None] & self.ctrl.subscribed          # [R, T, S]
+        pad_track = np.where(
+            cand.any(axis=1), cand.argmax(axis=1), -1
+        ).astype(np.int32)                                     # [R, S]
+        pad_num = self.prober.update(
+            now_ms=self.tick_index * self.tick_ms,
+            committed=self._last_committed,
+            congested=self._last_congested,
+            deficient=self._last_deficient,
+            estimate=self.ingest._estimate,
+            estimate_valid=self.ingest._estimate_valid,
+            pad_track=pad_track,
+        )
+        inp, payloads = self.ingest.drain(
+            roll_quality=roll, tick_index=self.tick_index,
+            pad_num=pad_num, pad_track=pad_track,
+        )
         # Retain the slab for the RTX window: replay keys minted this tick
         # reference slot (tick % SLAB_WINDOW) until it recycles.
         self._slab_history[self.tick_index % plane.SLAB_WINDOW] = payloads
         loop = asyncio.get_running_loop()
         out = await loop.run_in_executor(self._executor, self._device_step, inp)
+        # Mirror the probe controller's inputs for the next tick.
+        self._last_committed = np.asarray(out.committed_bps)
+        self._last_congested = np.asarray(out.congested)
+        self._last_deficient = np.asarray(out.deficient)
         result = self._fan_out(out, payloads, inp, time.perf_counter() - t0)
         result.quality_window_closed = roll
         self.tick_index += 1
@@ -340,6 +374,25 @@ class PlaneRuntime:
             )
         return replays
 
+    def _assemble_padding(self, out, inp) -> list[EgressPacket]:
+        """Device-synthesized probe padding → EgressPackets (the host half
+        of WritePaddingRTP; cold path — probing windows only)."""
+        pv = np.asarray(out.pad_valid)
+        hits = np.nonzero(pv)
+        if not len(hits[0]):
+            return []
+        psn, pts = np.asarray(out.pad_sn), np.asarray(out.pad_ts)
+        return [
+            EgressPacket(
+                room=int(r), track=int(inp.pad_track[r, s]), sub=int(s),
+                sn=int(psn[r, s, j]) & 0xFFFF,
+                ts=int(pts[r, s, j]) & 0xFFFFFFFF,
+                pid=0, tl0=0, keyidx=0,
+                size=PAD_BYTES, payload=b"", padding=True,
+            )
+            for r, s, j in zip(*hits)
+        ]
+
     def _fan_out(self, out, payloads, inp, tick_s: float) -> TickResult:
         # Compacted egress: [R, E] index lists (see plane.TickOutputs) →
         # column arrays. No per-packet Python objects here; the wire path
@@ -386,10 +439,14 @@ class PlaneRuntime:
         replays = self._assemble_replays(out, inp)
         if replays:
             self.stats["rtx_packets"] = self.stats.get("rtx_packets", 0) + len(replays)
+        padding = self._assemble_padding(out, inp)
+        if padding:
+            self.stats["pad_packets"] = self.stats.get("pad_packets", 0) + len(padding)
         return TickResult(
             tick_index=self.tick_index,
             egress_batch=batch,
             replays=replays,
+            padding=padding,
             speakers=speakers,
             need_keyframe=nk,
             congested=congested,

@@ -43,6 +43,9 @@ RTCP_RTPFB = 205   # FMT 1 = generic NACK
 RTCP_PSFB = 206    # FMT 1 = PLI, FMT 15 = REMB (application layer feedback)
 PLI_THROTTLE_MS = 500.0  # min spacing of upstream keyframe requests per
                          # track (pliThrottle — sfu/buffer config default)
+# Probe padding payload: a maximal RTP pad run — 254 zeros + the count
+# byte (255) that RFC 3550 §5.1 puts last when the P bit is set.
+PAD_RUN = bytes(254) + b"\xff"
 
 
 def build_nack(sender_ssrc: int, media_ssrc: int, sns) -> bytes:
@@ -674,27 +677,35 @@ class UDPMediaTransport(asyncio.DatagramProtocol):
         keyidxs: list[int] = []
         vp8_flags: list[int] = []
         addrs: list[tuple] = []
+        n_pad_sent = 0
         for pkt in packets:
             addr = self.sub_addrs.get((pkt.room, pkt.sub))
-            if addr is None or not pkt.payload:
+            is_padding = getattr(pkt, "padding", False)
+            if addr is None or (not pkt.payload and not is_padding):
                 continue
             is_video = self.track_kind.get((pkt.room, pkt.track), False)
             header = bytearray(12)
-            header[0] = 0x80
+            header[0] = 0x80 | (0x20 if is_padding else 0)  # P bit on padding
             header[1] = (0x80 if pkt.marker else 0) | (VP8_PT if is_video else OPUS_PT)
+            # Probe padding carries a pure pad run: N-1 zeros + the pad
+            # length byte (WritePaddingRTP's wire shape, downtrack.go:764).
+            payload = pkt.payload if pkt.payload else PAD_RUN
+            n_pad_sent += is_padding
             offsets.append(len(buf))
-            buf += header + pkt.payload
-            lengths.append(12 + len(pkt.payload))
+            buf += header + payload
+            lengths.append(12 + len(payload))
             sns.append(pkt.sn)
             tss.append(pkt.ts)
             ssrcs.append(self.subscriber_ssrc(pkt.room, pkt.sub, pkt.track))
             # Device-munged VP8 descriptor values reach the wire here
             # (codecmunger/vp8.go:161): after a simulcast switch or
             # temporal drop, receivers need contiguous picture ids.
-            pids.append(pkt.pid if is_video else -1)
-            tl0s.append(pkt.tl0 if is_video else -1)
-            keyidxs.append(pkt.keyidx if is_video else -1)
-            vp8_flags.append(1 if is_video else 0)
+            # Padding has no descriptor to rewrite.
+            has_vp8 = is_video and not is_padding
+            pids.append(pkt.pid if has_vp8 else -1)
+            tl0s.append(pkt.tl0 if has_vp8 else -1)
+            keyidxs.append(pkt.keyidx if has_vp8 else -1)
+            vp8_flags.append(1 if has_vp8 else 0)
             addrs.append(addr)
         if not offsets:
             return
@@ -715,7 +726,10 @@ class UDPMediaTransport(asyncio.DatagramProtocol):
             self.transport.sendto(bytes(view[off : off + ln]), addr)
             self.stats["tx"] += 1
         if rtx:
-            self.stats["rtx_tx"] += len(offsets)
+            if n_pad_sent:
+                self.stats["pad_tx"] = self.stats.get("pad_tx", 0) + n_pad_sent
+            if len(offsets) > n_pad_sent:
+                self.stats["rtx_tx"] = self.stats.get("rtx_tx", 0) + len(offsets) - n_pad_sent
         else:
             # SR bookkeeping rides the primary path only (replays re-send
             # old timestamps and must not advance the SR anchor).

@@ -286,6 +286,10 @@ class RoomManager:
             self.udp.send_egress(res.egress)
             if res.replays:
                 self.udp.send_egress(res.replays, rtx=True)  # NACK retransmits
+            if res.padding:
+                # BWE probe padding (UDP subscribers only — padding is a
+                # channel measurement, meaningless over the WS loopback).
+                self.udp.send_egress(res.padding, rtx=True)
         for pkt in res.egress:
             if (pkt.room, pkt.sub) in udp_subs:
                 continue  # delivered over UDP; don't double-send on WS

@@ -53,6 +53,8 @@ def make_inputs(dims: plane.PlaneDims, **over):
         rtt_ms=jnp.full((R, S), 100, jnp.int32),
         nack_sn=jnp.full((R, S, plane.NACK_SLOTS), -1, jnp.int32),
         nack_track=jnp.full((R, S, plane.NACK_SLOTS), -1, jnp.int32),
+        pad_num=jnp.zeros((R, S), jnp.int32),
+        pad_track=jnp.full((R, S), -1, jnp.int32),
         tick_ms=jnp.int32(20),
         roll_quality=jnp.int32(0),
         slab_base=jnp.int32(0),

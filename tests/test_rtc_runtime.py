@@ -411,6 +411,83 @@ def test_ingest_reorder_is_per_layer():
     assert pairs == [(0, 100), (0, 101), (1, 5000), (1, 5001)]
 
 
+async def test_bwe_probe_recovers_estimate(runtime):
+    """Induced congestion drops the committed budget; once the channel is
+    clear, the probe controller pads toward a goal and a goal-level
+    estimate sample recovers the budget — no waiting for organic samples
+    (probe_controller.go:33-295 + WritePaddingRTP)."""
+    import numpy as np
+
+    r, t, s = 0, 0, 1
+    runtime.set_track(r, t, published=True, is_video=True)
+    runtime.set_subscription(r, t, s, subscribed=True)
+
+    def push_video(i, size=1100):
+        # Periodic keyframes: the selector locks onto a layer only at a
+        # keyframe, like a real publisher answering PLIs.
+        kf = i % 5 == 0
+        runtime.ingest.push(PacketIn(
+            room=r, track=t, sn=2000 + i, ts=3000 * i, size=size,
+            payload=b"v" * 40, layer=0, keyframe=kf,
+            layer_sync=kf, begin_pic=True, frame_ms=0,
+        ))
+
+    # Warm up: traffic + healthy estimates → measured bitrates, high budget.
+    i = 0
+    for _ in range(10):
+        push_video(i); i += 1
+        runtime.ingest.push_feedback(r, s, estimate=5_000_000.0)
+        await runtime.step_once()
+
+    # Congest: steeply declining estimates (trend < 0) under load.
+    for est in np.linspace(4_000_000, 120_000, 12):
+        push_video(i); i += 1
+        runtime.ingest.push_feedback(r, s, estimate=float(est))
+        res = await runtime.step_once()
+    assert s in res.congested.get(r, []), "congestion never detected"
+    low_budget = runtime._last_committed[r, s]
+    assert low_budget < 1_000_000
+
+    # Clear channel, deficient allocation (video bps > budget): the probe
+    # controller must start padding on its own.
+    padded = []
+    for _ in range(80):
+        push_video(i); i += 1
+        res = await runtime.step_once()
+        padded.extend(res.padding)
+        if padded:
+            break
+    assert padded, "probe controller never started padding"
+    assert all(p.padding and p.sub == s and p.room == r for p in padded)
+    goal = runtime.prober.goal[r, s]
+    assert goal >= low_budget * 1.4
+
+    # The probed client answers each probe with a goal-level estimate;
+    # successive probe rounds ladder the budget up (320k → 480k → …)
+    # until the 440 kbps track fits and forwarding resumes — recovery
+    # driven entirely by probing, not organic estimate growth.
+    real = []
+    for _ in range(400):
+        push_video(i); i += 1
+        if runtime.prober.state[r, s] == 1:  # client "sees" the padding
+            runtime.ingest.push_feedback(
+                r, s, estimate=float(runtime.prober.goal[r, s])
+            )
+        res = await runtime.step_once()
+        padded.extend(res.padding)
+        real += [p for p in res.egress if p.sub == s and p.room == r]
+        if real:
+            break
+    assert runtime.prober.stats["succeeded"] >= 1
+    assert runtime._last_committed[r, s] > 440_000, "budget never recovered"
+    assert real, "forwarding never resumed after probe recovery"
+
+    # Padding advanced the munged SN space: real packets forwarded after
+    # the padding runs continue beyond their SNs (no SN reuse).
+    pad_sns = [p.sn for p in padded]
+    assert all(p.sn > max(pad_sns) for p in real)
+
+
 async def test_checkpoint_restore_mid_stream(runtime):
     """Munger state survives snapshot/restore (migration seeding, §5.4)."""
     room = Room("ckpt", runtime)
