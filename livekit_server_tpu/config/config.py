@@ -74,6 +74,9 @@ class RTCConfig:
 
     udp_port: int = 7882
     tcp_port: int = 7881
+    require_encryption: bool = True   # drop cleartext media datagrams; the
+                                      # sealed AEAD wire (runtime/crypto.py)
+                                      # is the DTLS-SRTP seat
     port_range_start: int = 50000
     port_range_end: int = 60000
     use_external_ip: bool = False

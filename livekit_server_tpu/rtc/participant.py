@@ -61,6 +61,7 @@ class Participant:
         self.metadata = ""
         self.attributes: dict[str, str] = {}
         self.sub_col: int = -1          # subscriber column in the room row
+        self.crypto_session = None      # media-wire AEAD session (join-minted)
         self.permission = pm.ParticipantPermission()
         self._apply_grant_permissions()
         self.published: dict[str, PublishedTrack] = {}   # track sid → entry

@@ -44,6 +44,7 @@ class Room:
         self.last_left_at = 0.0
         self.closed = False
         self.udp = None  # UDPMediaTransport when the node serves UDP media
+        self.crypto = None  # MediaCryptoRegistry (join-time key minting)
         # Incremental indexes for the per-tick hot path (no per-packet
         # dict rebuilds): sub col → participant, track col → track sid.
         self.sub_index: dict[int, Participant] = {}
@@ -86,12 +87,34 @@ class Room:
             for p in self.participants.values()
             if p.sid != participant.sid and not p.permission.hidden
         ]
-        return {
+        resp = {
             "room": self.info.to_dict(),
             "participant": participant.to_info().to_dict(),
             "other_participants": others,
             "server_info": {"edition": "tpu", "protocol": 12},
         }
+        if self.crypto is not None:
+            # Media-wire key exchange over the authenticated signal channel
+            # (the DTLS-SRTP handshake seat — transport.go:167): the
+            # session seals every UDP/TCP media datagram both directions.
+            import base64
+
+            from livekit_server_tpu.runtime.crypto import ALGO
+
+            session = self.crypto.mint()
+            session.room = self.slots.row
+            session.sub = participant.sub_col
+            participant.crypto_session = session
+            if self.udp is not None:
+                self.udp.bind_sub_session(
+                    self.slots.row, participant.sub_col, session
+                )
+            resp["media_crypto"] = {
+                "key_id": session.key_id,
+                "key": base64.b64encode(session.key).decode(),
+                "algo": ALGO,
+            }
+        return resp
 
     def remove_participant(
         self, participant: Participant, reason: pm.DisconnectReason
@@ -111,6 +134,8 @@ class Room:
             self.sub_index.pop(p.sub_col, None)
             if self.udp is not None:
                 self.udp.release_subscriber(self.slots.row, p.sub_col)
+        if self.crypto is not None and getattr(p, "crypto_session", None) is not None:
+            self.crypto.remove(p.crypto_session.key_id)
         del self.participants[p.identity]
         self.by_sid.pop(p.sid, None)
         self.info.num_participants = len(self.participants)

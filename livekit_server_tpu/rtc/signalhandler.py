@@ -41,7 +41,10 @@ def handle_participant_signal(room, participant: Participant, req: SignalRequest
                 # SSRC bookkeeping); single-layer tracks get exactly one.
                 n_layers = max(1, len(track.info.layers)) if track.is_video else 1
                 layer_ssrcs = [
-                    udp.assign_ssrc(room.slots.row, track.track_col, track.is_video, layer=l)
+                    udp.assign_ssrc(
+                        room.slots.row, track.track_col, track.is_video, layer=l,
+                        session=participant.crypto_session,
+                    )
                     for l in range(n_layers)
                 ]
                 track.ssrc = layer_ssrcs[0]

@@ -23,6 +23,12 @@ from dataclasses import dataclass
 import numpy as np
 
 from livekit_server_tpu.native import rtp
+from livekit_server_tpu.runtime.crypto import (
+    MAGIC as CRYPTO_MAGIC,
+    MediaCryptoRegistry,
+    MediaCryptoSession,
+    parse_key_id,
+)
 from livekit_server_tpu.runtime.ingest import IngestBuffer, PacketIn
 
 VP8_PT = 96
@@ -173,13 +179,26 @@ class SSRCBinding:
     track: int           # track col
     is_video: bool
     layer: int = 0       # simulcast spatial layer carried by this SSRC
+    session: MediaCryptoSession | None = None  # publisher's crypto session
 
 
 class UDPMediaTransport(asyncio.DatagramProtocol):
     """One socket for the whole node (the reference's single-port UDPMux)."""
 
-    def __init__(self, ingest: IngestBuffer):
+    def __init__(
+        self,
+        ingest: IngestBuffer,
+        crypto: MediaCryptoRegistry | None = None,
+        require_encryption: bool = False,
+    ):
         self.ingest = ingest
+        # AEAD media-wire crypto (runtime/crypto.py — the DTLS-SRTP seat).
+        # require_encryption drops every plaintext RTP/RTCP/punch datagram;
+        # False keeps the legacy cleartext path for in-process tooling.
+        self.crypto = crypto
+        self.require_encryption = require_encryption
+        self.sub_sessions: dict[tuple, MediaCryptoSession] = {}  # (room,sub)→session
+        self.tcp_sinks: dict[int, object] = {}  # key_id → TCP frame writer
         self.transport: asyncio.DatagramTransport | None = None
         self.bindings: dict[int, SSRCBinding] = {}       # ssrc → coords
         self.addrs: dict[int, tuple] = {}                # ssrc → latched addr
@@ -215,6 +234,7 @@ class UDPMediaTransport(asyncio.DatagramProtocol):
             "addr_mismatch": 0, "bad_punch": 0,
             "rtcp_rx": 0, "rtcp_bad": 0, "nacks_rx": 0, "nacks_tx": 0,
             "plis_rx": 0, "plis_tx": 0, "rtx_tx": 0,
+            "bad_frame": 0, "plaintext_drop": 0, "session_mismatch": 0,
         }
 
     # -- control-plane API ------------------------------------------------
@@ -226,14 +246,51 @@ class UDPMediaTransport(asyncio.DatagramProtocol):
             if ssrc not in self.bindings:
                 return ssrc
 
-    def assign_ssrc(self, room: int, track: int, is_video: bool, layer: int = 0) -> int:
+    def assign_ssrc(
+        self, room: int, track: int, is_video: bool, layer: int = 0,
+        session: MediaCryptoSession | None = None,
+    ) -> int:
         """Bind a fresh SSRC to one (track, simulcast layer); sent back in
         signal. Simulcast publishers get one SSRC per layer, matching the
-        reference's per-layer SSRCs (mediatrack.go layer SSRC bookkeeping)."""
+        reference's per-layer SSRCs (mediatrack.go layer SSRC bookkeeping).
+        `session` pins the SSRC to its publisher's crypto session: media
+        sealed under any other key is rejected even if the SSRC matches."""
         ssrc = self._new_ssrc()
-        self.bindings[ssrc] = SSRCBinding(room, track, is_video, layer)
+        self.bindings[ssrc] = SSRCBinding(room, track, is_video, layer, session)
         self.track_kind[(room, track)] = is_video
         return ssrc
+
+    def bind_sub_session(
+        self, room: int, sub: int, session: MediaCryptoSession
+    ) -> None:
+        """Attach a subscriber's crypto session: egress to (room, sub) is
+        sealed under it, and its key routes TCP-fallback frames."""
+        self.sub_sessions[(room, sub)] = session
+        session.room = room
+        session.sub = sub
+
+    def _sendto(self, data: bytes, addr, session=None) -> None:
+        """Single egress chokepoint: seal under the session, then route to
+        the UDP socket or a TCP-fallback sink. TCP sinks are addressed as
+        ("tcp", key_id) in the same addr maps the UDP path uses, so every
+        consumer of sub_addrs/addrs works unchanged.
+
+        Sealing is opportunistic in cleartext-allowed mode: a client that
+        has ever spoken sealed frames (session.client_active) gets sealed
+        egress; a legacy cleartext client gets cleartext. In
+        require_encryption mode everything is sealed. TCP is ALWAYS
+        sealed — its framing carries nothing else."""
+        if isinstance(addr, tuple) and addr and addr[0] == "tcp":
+            if session is None:
+                return
+            sink = self.tcp_sinks.get(addr[1])
+            if sink is not None:
+                sink(session.seal(data))
+            return
+        if session is not None and (self.require_encryption or session.client_active):
+            data = session.seal(data)
+        if self.transport is not None:
+            self.transport.sendto(data, addr)
 
     def release_ssrc(self, ssrc: int) -> None:
         self.bindings.pop(ssrc, None)
@@ -295,6 +352,9 @@ class UDPMediaTransport(asyncio.DatagramProtocol):
         """Subscriber left: stop egress and free its SSRC map (prevents
         media leaking to a stale address once the sub col is reused)."""
         self.sub_addrs.pop((room, sub), None)
+        sess = self.sub_sessions.pop((room, sub), None)
+        if sess is not None:
+            self.tcp_sinks.pop(sess.key_id, None)
         for ssrc in (self.sub_ssrc.pop((room, sub), None) or {}).values():
             self.egress_rev.pop(ssrc, None)
             self._tx_sr.pop(ssrc, None)
@@ -321,6 +381,9 @@ class UDPMediaTransport(asyncio.DatagramProtocol):
             del self._last_pli_ms[key]
         for key in [k for k in self._ts_delta if k[0] == room]:
             del self._ts_delta[key]
+        for key in [k for k in self.sub_sessions if k[0] == room]:
+            sess = self.sub_sessions.pop(key)
+            self.tcp_sinks.pop(sess.key_id, None)
         for key in [k for k in self._punch_by_sub if k[0] == room]:
             self.punch_ids.pop(self._punch_by_sub.pop(key), None)
 
@@ -338,8 +401,31 @@ class UDPMediaTransport(asyncio.DatagramProtocol):
 
     def datagram_received(self, data: bytes, addr) -> None:
         self.stats["rx"] += 1
+        if not data:
+            return
+        # Sealed frames lead with the crypto magic (0x01 — impossible as an
+        # RTP/RTCP version byte or the punch magic 'L').
+        if data[0] == CRYPTO_MAGIC and self.crypto is not None:
+            key_id = parse_key_id(data)
+            session = self.crypto.get(key_id) if key_id is not None else None
+            inner = session.open(data) if session is not None else None
+            if inner is None:
+                self.stats["bad_frame"] += 1
+                return
+            session.client_active = True
+            self._dispatch_inner(inner, addr, session)
+            return
+        if self.require_encryption:
+            # Secure mode: the cleartext media wire does not exist.
+            self.stats["plaintext_drop"] += 1
+            return
+        self._dispatch_inner(data, addr, None)
+
+    def _dispatch_inner(self, data: bytes, addr, session) -> None:
+        """Route one (decrypted) datagram: punch / RTCP / RTP. Shared by
+        the UDP socket and the TCP-fallback framing."""
         if data[:8] == PUNCH_REQ:
-            self._handle_punch(data, addr)
+            self._handle_punch(data, addr, session)
             return
         # rtcp-mux demux (RFC 5761): RTCP PTs land in byte1 192-223 — a
         # range RTP reserves — so one byte splits the flows.
@@ -350,7 +436,7 @@ class UDPMediaTransport(asyncio.DatagramProtocol):
         # parsed by ONE native parse_batch call (the batch design this
         # module documents; under media load the loop wakes with many
         # datagrams ready and the per-packet Python overhead amortizes).
-        self._rx_pending.append((data, addr))
+        self._rx_pending.append((data, addr, session))
         if not self._rx_scheduled:
             self._rx_scheduled = True
             asyncio.get_running_loop().call_soon(self._flush_rx)
@@ -480,14 +566,12 @@ class UDPMediaTransport(asyncio.DatagramProtocol):
             return
         self._last_pli_ms[(room, track)] = now_ms
         sent = False
-        if self.transport is not None:
+        if self.transport is not None or self.tcp_sinks:
             for ssrc, b in self.bindings.items():
                 if b.room == room and b.track == track:
                     addr = self.addrs.get(ssrc)
                     if addr is not None:
-                        self.transport.sendto(
-                            build_pli(self.node_ssrc, ssrc), addr
-                        )
+                        self._sendto(build_pli(self.node_ssrc, ssrc), addr, b.session)
                         self.stats["plis_tx"] += 1
                         sent = True
         if not sent and self.on_pli is not None:
@@ -517,7 +601,7 @@ class UDPMediaTransport(asyncio.DatagramProtocol):
             missing.pop(ext, None)
 
     def _send_upstream_nacks(self, now_ms: float) -> None:
-        if self.transport is None:
+        if self.transport is None and not self.tcp_sinks:
             return
         for ssrc, missing in self._rx_missing.items():
             if not missing:
@@ -537,10 +621,14 @@ class UDPMediaTransport(asyncio.DatagramProtocol):
                 else:
                     st[1] = now_ms + 30.0 * st[0]  # backoff between retries
             if due:
-                self.transport.sendto(build_nack(self.node_ssrc, ssrc, due), addr)
+                b = self.bindings.get(ssrc)
+                self._sendto(
+                    build_nack(self.node_ssrc, ssrc, due), addr,
+                    b.session if b is not None else None,
+                )
                 self.stats["nacks_tx"] += len(due)
 
-    def _handle_punch(self, data: bytes, addr) -> None:
+    def _handle_punch(self, data: bytes, addr, session=None) -> None:
         if len(data) < 12:
             self.stats["bad_punch"] += 1
             return
@@ -554,10 +642,13 @@ class UDPMediaTransport(asyncio.DatagramProtocol):
             # id already bound to another source: replay/hijack attempt
             self.stats["bad_punch"] += 1
             return
+        if session is not None and (session.room, session.sub) != key:
+            # sealed punch under the wrong participant's key
+            self.stats["bad_punch"] += 1
+            return
         entry[1] = addr
         self.sub_addrs[key] = addr
-        if self.transport is not None:
-            self.transport.sendto(PUNCH_ACK + data[8:12], addr)
+        self._sendto(PUNCH_ACK + data[8:12], addr, session)
 
     def _flush_rx(self) -> None:
         self._rx_scheduled = False
@@ -565,15 +656,15 @@ class UDPMediaTransport(asyncio.DatagramProtocol):
         if not pending:
             return
         now_ms = asyncio.get_event_loop().time() * 1000.0
-        lengths = np.asarray([len(d) for d, _ in pending], np.int32)
+        lengths = np.asarray([len(d) for d, _, _ in pending], np.int32)
         offsets = np.zeros(len(pending), np.int32)
         np.cumsum(lengths[:-1], out=offsets[1:])
-        blob = b"".join(d for d, _ in pending)
+        blob = b"".join(d for d, _, _ in pending)
         parsed = rtp.parse_batch(
             blob, offsets, lengths,
             audio_level_ext=AUDIO_LEVEL_EXT_ID, vp8_pts={VP8_PT},
         )
-        for i, (data, addr) in enumerate(pending):
+        for i, (data, addr, session) in enumerate(pending):
             p = parsed[i]
             if int(p["payload_len"]) < 0:
                 self.stats["parse_errors"] += 1
@@ -582,6 +673,17 @@ class UDPMediaTransport(asyncio.DatagramProtocol):
             binding = self.bindings.get(ssrc)
             if binding is None:
                 self.stats["unknown_ssrc"] += 1
+                continue
+            # SSRC pinned to its publisher's key: valid media sealed under
+            # a DIFFERENT participant's session must not inject here. In
+            # cleartext-allowed mode a plaintext packet (session None) is
+            # legal even for a keyed SSRC (legacy client).
+            if (
+                binding.session is not None
+                and binding.session is not session
+                and (session is not None or self.require_encryption)
+            ):
+                self.stats["session_mismatch"] += 1
                 continue
             # First packet latches the source address; later packets from a
             # different address are dropped (UDP-mux address learning —
@@ -651,7 +753,10 @@ class UDPMediaTransport(asyncio.DatagramProtocol):
             # receiver lip-sync drifts.
             clock = 90_000 if self.track_kind.get((dest[0], dest[2]), True) else 48_000
             rtp_ts = (st[2] + int((now_ms - st[3]) * clock / 1000.0)) & 0xFFFFFFFF
-            self.transport.sendto(build_sr(ssrc, ntp, rtp_ts, st[0], st[1]), addr)
+            self._sendto(
+                build_sr(ssrc, ntp, rtp_ts, st[0], st[1]), addr,
+                self.sub_sessions.get((dest[0], dest[1])),
+            )
             # Keep the last few mids: an RR may echo an SR one or two
             # behind; anything else is a stale/garbage LSR we must not
             # let poison rtt_ms (it throttles NACK replays).
@@ -664,8 +769,8 @@ class UDPMediaTransport(asyncio.DatagramProtocol):
         one buffer, ONE native rewrite call (headers + VP8 payload
         descriptors), then sendto per datagram (the batched write half of
         DownTrack.WriteRTP + pacer)."""
-        if self.transport is None:
-            return
+        if self.transport is None and not self.tcp_sinks:
+            return  # no UDP socket and no TCP-fallback connections
         buf = bytearray()
         offsets: list[int] = []
         lengths: list[int] = []
@@ -677,6 +782,7 @@ class UDPMediaTransport(asyncio.DatagramProtocol):
         keyidxs: list[int] = []
         vp8_flags: list[int] = []
         addrs: list[tuple] = []
+        sessions: list = []
         n_pad_sent = 0
         for pkt in packets:
             addr = self.sub_addrs.get((pkt.room, pkt.sub))
@@ -707,6 +813,7 @@ class UDPMediaTransport(asyncio.DatagramProtocol):
             keyidxs.append(pkt.keyidx if has_vp8 else -1)
             vp8_flags.append(1 if has_vp8 else 0)
             addrs.append(addr)
+            sessions.append(self.sub_sessions.get((pkt.room, pkt.sub)))
         if not offsets:
             return
         rtp.rewrite_vp8_batch(
@@ -722,8 +829,8 @@ class UDPMediaTransport(asyncio.DatagramProtocol):
             np.asarray(vp8_flags, np.uint8),
         )
         view = memoryview(buf)
-        for off, ln, addr in zip(offsets, lengths, addrs):
-            self.transport.sendto(bytes(view[off : off + ln]), addr)
+        for off, ln, addr, sess in zip(offsets, lengths, addrs, sessions):
+            self._sendto(bytes(view[off : off + ln]), addr, sess)
             self.stats["tx"] += 1
         if rtx:
             if n_pad_sent:
@@ -746,10 +853,15 @@ class UDPMediaTransport(asyncio.DatagramProtocol):
 
 
 async def start_udp_transport(
-    ingest: IngestBuffer, host: str = "0.0.0.0", port: int = 7882
+    ingest: IngestBuffer,
+    host: str = "0.0.0.0",
+    port: int = 7882,
+    crypto: MediaCryptoRegistry | None = None,
+    require_encryption: bool = False,
 ) -> UDPMediaTransport:
     loop = asyncio.get_running_loop()
     transport, protocol = await loop.create_datagram_endpoint(
-        lambda: UDPMediaTransport(ingest), local_addr=(host, port)
+        lambda: UDPMediaTransport(ingest, crypto, require_encryption),
+        local_addr=(host, port),
     )
     return protocol

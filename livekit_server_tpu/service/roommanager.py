@@ -69,6 +69,12 @@ class RoomManager:
         self.rooms: dict[str, Room] = {}
         self._row_to_room: dict[int, Room] = {}
         self.udp = None     # UDPMediaTransport, attached by the server at start
+        # Media-wire key registry (the DTLS-SRTP key-exchange seat): one
+        # AEAD session per participant, minted at join and delivered over
+        # the authenticated signal channel.
+        from livekit_server_tpu.runtime.crypto import MediaCryptoRegistry
+
+        self.crypto = MediaCryptoRegistry()
         self.agents = None  # AgentService; room/publisher job dispatch
         self.runtime.on_tick(self._dispatch_tick)
         self._reaper_task: asyncio.Task | None = None
@@ -83,6 +89,7 @@ class RoomManager:
         stored = await self.store.load_room(name)
         room = Room(name, self.runtime, info=info or stored)
         room.udp = self.udp
+        room.crypto = self.crypto
         if info is None and stored is None:
             room.info.empty_timeout = self.config.room.empty_timeout_s
             room.info.departure_timeout = self.config.room.departure_timeout_s

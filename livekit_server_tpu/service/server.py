@@ -154,11 +154,27 @@ class LivekitServer:
                     self.room_manager.runtime.ingest,
                     self.config.bind_addresses[0],
                     self.config.rtc.udp_port,
+                    crypto=self.room_manager.crypto,
+                    require_encryption=self.config.rtc.require_encryption,
                 )
                 # Client PLIs over RTCP reach signal-plane publishers too.
                 self.room_manager.udp.on_pli = self.room_manager.handle_pli
                 for room in self.room_manager.rooms.values():
                     room.udp = self.room_manager.udp
+                # TCP media fallback (transportmanager.go:73 ladder): same
+                # sealed frames, length-prefixed; always encrypted.
+                if self.config.rtc.tcp_port:
+                    from livekit_server_tpu.runtime.tcp import start_tcp_transport
+
+                    try:
+                        self.tcp_media = await start_tcp_transport(
+                            self.room_manager.udp,
+                            self.room_manager.crypto,
+                            self.config.bind_addresses[0],
+                            self.config.rtc.tcp_port,
+                        )
+                    except OSError:
+                        pass  # port busy: UDP path still works
             except OSError:
                 pass  # port busy: WS media path still works
         await self.egress.start()
@@ -186,6 +202,8 @@ class LivekitServer:
             self._stats_task.cancel()
         if self.room_manager.udp is not None and self.room_manager.udp.transport:
             self.room_manager.udp.transport.close()
+        if getattr(self, "tcp_media", None) is not None:
+            self.tcp_media.close()
         await self.egress.stop()
         await self.ingress.stop()
         await self.room_manager.stop()

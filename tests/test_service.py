@@ -22,7 +22,7 @@ from livekit_server_tpu.service.server import create_server
 API_KEY, API_SECRET = "testkey", "testsecret"
 
 
-def make_config(port: int, **plane_overrides):
+def make_config(port: int, require_encryption: bool = False, **plane_overrides):
     plane = {"rooms": 4, "tracks_per_room": 4, "pkts_per_track": 4, "subs_per_room": 4,
              "tick_ms": 10} | plane_overrides
     return load_config(
@@ -33,7 +33,14 @@ def make_config(port: int, **plane_overrides):
                 "bind_addresses": ["127.0.0.1"],
                 "plane": plane,
                 "room": {"empty_timeout_s": 2},
-                "rtc": {"udp_port": port + 1},  # avoid cross-test collisions
+                # Ports offset to avoid cross-test collisions. Most tests
+                # keep the legacy cleartext wire; the encrypted-path test
+                # opts in to the (production-default) sealed wire.
+                "rtc": {
+                    "udp_port": port + 1,
+                    "tcp_port": port + 2,
+                    "require_encryption": require_encryption,
+                },
             }
         )
     )
@@ -438,6 +445,121 @@ async def test_udp_media_through_full_server():
                 off, ln = int(out["payload_off"]), int(out["payload_len"])
                 assert data[off : off + ln].startswith(b"udp-opus")
             assert sns == list(range(900, 908))
+            pub_sock.close()
+            sub_sock.close()
+            await alice.close()
+            await bob.close()
+
+
+async def test_encrypted_udp_media_through_full_server():
+    """Production wire: join hands each participant an AEAD media key over
+    the authenticated WS; all UDP media (punch, RTP, egress) is sealed,
+    and cleartext datagrams are dropped (require_encryption default)."""
+    import base64
+    import socket
+
+    import numpy as np
+
+    from livekit_server_tpu.native import rtp as parser
+    from livekit_server_tpu.runtime.crypto import MediaCryptoClient
+    from livekit_server_tpu.runtime.udp import PUNCH_ACK, PUNCH_REQ
+    from tests.test_native import rtp_packet
+
+    async with running_server(require_encryption=True) as server:
+        udp_port = server.config.rtc.udp_port
+        async with aiohttp.ClientSession() as s:
+            alice = SignalClient(s, server.port)
+            bob = SignalClient(s, server.port)
+            join_a = await alice.connect("sec-room", "alice")
+            join_b = await bob.connect("sec-room", "bob")
+            for j in (join_a, join_b):
+                assert j["media_crypto"]["algo"] == "aes-128-gcm"
+            a_crypt = MediaCryptoClient(
+                join_a["media_crypto"]["key_id"],
+                base64.b64decode(join_a["media_crypto"]["key"]),
+            )
+            b_crypt = MediaCryptoClient(
+                join_b["media_crypto"]["key_id"],
+                base64.b64decode(join_b["media_crypto"]["key"]),
+            )
+
+            await alice.send_signal(
+                "add_track", {"cid": "mic", "type": 0, "name": "m", "transport": "udp"}
+            )
+            rr = await alice.wait_for("request_response")
+            ssrc = rr["udp_media"]["ssrc"]
+            track_sid = rr["udp_media"]["track_sid"]
+            await bob.wait_for("track_subscribed")
+
+            sub_sock = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
+            sub_sock.bind(("127.0.0.1", 0))
+            sub_sock.setblocking(False)
+            await bob.send_signal(
+                "subscription",
+                {"track_sids": [track_sid], "subscribe": True, "udp": True},
+            )
+            rr = await bob.wait_for("request_response")
+            punch_id = rr["udp_punch"]["punch_id"]
+            # Sealed punch — a cleartext one would be dropped.
+            sub_sock.sendto(
+                b_crypt.seal(PUNCH_REQ + int(punch_id).to_bytes(4, "big")),
+                ("127.0.0.1", udp_port),
+            )
+            deadline = asyncio.get_event_loop().time() + 2
+            ack = None
+            while asyncio.get_event_loop().time() < deadline:
+                await asyncio.sleep(0.02)
+                try:
+                    ack = b_crypt.open(sub_sock.recvfrom(2048)[0])
+                    break
+                except BlockingIOError:
+                    continue
+            assert ack == PUNCH_ACK + int(punch_id).to_bytes(4, "big")
+
+            pub_sock = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
+            got = []
+            for i in range(6):
+                pub_sock.sendto(
+                    a_crypt.seal(
+                        rtp_packet(sn=910 + i, ts=960 * i, ssrc=ssrc,
+                                   payload=b"sealed" + bytes([i]))
+                    ),
+                    ("127.0.0.1", udp_port),
+                )
+                await asyncio.sleep(0.04)
+                while True:
+                    try:
+                        inner = b_crypt.open(sub_sock.recvfrom(4096)[0])
+                        if inner is not None and not (192 <= inner[1] <= 223):
+                            got.append(inner)
+                    except BlockingIOError:
+                        break
+            deadline = asyncio.get_event_loop().time() + 3
+            while len(got) < 6 and asyncio.get_event_loop().time() < deadline:
+                await asyncio.sleep(0.02)
+                while True:
+                    try:
+                        inner = b_crypt.open(sub_sock.recvfrom(4096)[0])
+                        if inner is not None and not (192 <= inner[1] <= 223):
+                            got.append(inner)
+                    except BlockingIOError:
+                        break
+            assert len(got) == 6, f"got {len(got)} packets"
+            for i, m in enumerate(got):
+                out = parser.parse_batch(
+                    m, np.asarray([0], np.int32), np.asarray([len(m)], np.int32)
+                )[0]
+                assert int(out["sn"]) == 910 + i
+                off, ln = int(out["payload_off"]), int(out["payload_len"])
+                assert m[off : off + ln] == b"sealed" + bytes([i])
+
+            # Cleartext media is rejected on the secure wire.
+            pub_sock.sendto(
+                rtp_packet(sn=999, ssrc=ssrc, payload=b"plain"),
+                ("127.0.0.1", udp_port),
+            )
+            await asyncio.sleep(0.05)
+            assert server.room_manager.udp.stats["plaintext_drop"] >= 1
             pub_sock.close()
             sub_sock.close()
             await alice.close()
