@@ -18,6 +18,7 @@ from __future__ import annotations
 import argparse
 import asyncio
 import json
+import os
 import signal
 import sys
 
@@ -47,6 +48,16 @@ def _build_parser() -> argparse.ArgumentParser:
 
     sub.add_parser("ports", help="print the ports the server uses")
 
+    bus = sub.add_parser(
+        "bus", help="run the standalone message bus (the multi-node KV seat)"
+    )
+    bus.add_argument("--host", default="127.0.0.1",
+                     help="bind address; a non-loopback bind requires --token")
+    bus.add_argument("--port", type=int, default=7850)
+    bus.add_argument("--token", default=os.environ.get("LIVEKIT_BUS_TOKEN", ""),
+                     help="shared auth secret (env LIVEKIT_BUS_TOKEN); the bus "
+                          "is the cluster control plane — never expose it bare")
+
     nodes = sub.add_parser("list-nodes", help="list cluster nodes")
     nodes.add_argument("--config", help="path to YAML config")
     return p
@@ -57,6 +68,28 @@ def main(argv: list[str] | None = None) -> int:
     if args.command == "generate-keys":
         print(f"API Key: {ids.new_api_key()}")
         print(f"API Secret: {ids.new_api_secret()}")
+        return 0
+    if args.command == "bus":
+
+        if args.host not in ("127.0.0.1", "localhost", "::1") and not args.token:
+            print("refusing to bind the bus beyond loopback without --token",
+                  flush=True)
+            return 2
+
+        async def run_bus():
+            from livekit_server_tpu.routing.tcpbus import BusServer
+
+            srv = BusServer(token=args.token)
+            await srv.start(args.host, args.port)
+            print(f"bus listening on {args.host}:{srv.port}", flush=True)
+            stop = asyncio.Event()
+            loop = asyncio.get_running_loop()
+            for sig in (signal.SIGINT, signal.SIGTERM):
+                loop.add_signal_handler(sig, stop.set)
+            await stop.wait()
+            srv.close()
+
+        asyncio.run(run_bus())
         return 0
     if args.command == "ports":
         cfg = Config()
@@ -103,9 +136,9 @@ def main(argv: list[str] | None = None) -> int:
 
 
 async def _serve(cfg: Config) -> int:
-    from livekit_server_tpu.service.server import create_server
+    from livekit_server_tpu.service.server import connect_bus, create_server
 
-    server = create_server(cfg)
+    server = create_server(cfg, bus=await connect_bus(cfg))
     await server.start()
     print(
         f"livekit-server-tpu v{__version__} listening on "

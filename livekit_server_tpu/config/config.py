@@ -140,8 +140,9 @@ class KeyValueConfig:
     redisrouter.go / redisstore.go). kind=memory keeps single-node mode
     dependency-free (the reference's LocalRouter/LocalStore path)."""
 
-    kind: str = "memory"         # memory | external
-    address: str = ""
+    kind: str = "memory"         # memory | tcp (in-repo BusServer)
+    address: str = ""            # host:port for kind=tcp
+    auth_token: str = ""         # shared secret for the tcp bus (Redis AUTH seat)
 
 
 @dataclass

@@ -71,6 +71,8 @@ class Router(Protocol):
     async def get_node_for_room(self, room_name: str) -> str: ...
     async def set_node_for_room(self, room_name: str, node_id: str) -> None: ...
     async def clear_room_state(self, room_name: str) -> None: ...
+    async def try_takeover(self, room_name: str, dead_node_id: str = "") -> str: ...
+    async def is_node_alive(self, node_id: str) -> bool: ...
     def on_new_session(self, handler: SessionHandler) -> None: ...
     async def start_participant_signal(
         self, room_name: str, init: ParticipantInit
@@ -112,6 +114,15 @@ class LocalRouter:
 
     async def clear_room_state(self, room_name: str) -> None:
         self._room_nodes.pop(room_name, None)
+
+    async def try_takeover(self, room_name: str, dead_node_id: str = "") -> str:
+        """Re-home a room whose pinned node died; returns the node that
+        actually owns it afterwards. Single-node: always us."""
+        self._room_nodes[room_name] = self.local_node.node_id
+        return self.local_node.node_id
+
+    async def is_node_alive(self, node_id: str) -> bool:
+        return node_id == self.local_node.node_id
 
     def on_new_session(self, handler: SessionHandler) -> None:
         self._handler = handler
@@ -194,6 +205,42 @@ class KVRouter(LocalRouter):
 
     async def clear_room_state(self, room_name: str) -> None:
         await self.bus.hdel(NODE_ROOM_KEY, room_name)
+
+    async def try_takeover(self, room_name: str, dead_node_id: str = "") -> str:
+        """Serialized dead-node re-home: concurrent joins on different
+        live nodes race to a setnx lock; exactly one rewrites the pin and
+        releases the lock, the others route to the winner (prevents a
+        split-brain room existing on two nodes at once). If the winner
+        itself dies mid-takeover the lock TTL expires and the losers
+        re-race, so a crash can delay — but never wedge — the re-home."""
+        lock_key = f"takeover:{room_name}"
+        for _ in range(10):
+            if await self.bus.setnx(lock_key, self.local_node.node_id, 5.0):
+                await self.set_node_for_room(room_name, self.local_node.node_id)
+                await self.bus.delete(lock_key)
+                return self.local_node.node_id
+            # Lost the race: wait for the winner to release (or for its
+            # TTL to lapse if it crashed), then read the new pin.
+            for _ in range(300):
+                if await self.bus.get(lock_key) is None:
+                    break
+                await asyncio.sleep(0.02)
+            winner = await self.get_node_for_room(room_name)
+            if winner and winner != dead_node_id:
+                return winner
+            # Pin still points at the dead node ⇒ the lock holder crashed
+            # before repinning; race again.
+        return await self.get_node_for_room(room_name) or self.local_node.node_id
+
+    async def is_node_alive(self, node_id: str) -> bool:
+        """One-field liveness probe for the join hot path (vs. fetching
+        and parsing the whole registry)."""
+        if node_id == self.local_node.node_id:
+            return True
+        raw = await self.bus.hget(NODES_KEY, node_id)
+        if not raw:
+            return False
+        return LocalNode.from_dict(json.loads(raw)).is_available(STATS_MAX_AGE)
 
     # -- signal relay ---------------------------------------------------
     async def start_participant_signal(

@@ -1,0 +1,276 @@
+"""TCP message bus: the MessageBus protocol over real sockets.
+
+Reference parity: the Redis deployment seat — multi-node livekit runs N
+servers against one Redis for node registry, room pinning, and pub/sub
+signal relay (pkg/routing/redisrouter.go:48-311; test/multinode_test.go
+runs exactly this shape). This module ships both halves in-repo so a
+cluster needs no external dependency:
+
+  - BusServer — a standalone asyncio server holding the hash/KV/pub-sub
+    state (`livekit-server-tpu bus` runs it; tests embed it)
+  - TCPBusClient — a MessageBus implementation over one TCP connection;
+    drop-in for MemoryBus in KVRouter/KVStore (config: kv.kind = "tcp",
+    kv.address = "host:port")
+
+Wire protocol: 4-byte big-endian length + UTF-8 JSON.
+  request   {"i": id, "op": op, "a": [args]}
+  response  {"i": id, "r": result}  |  {"i": id, "e": "error"}
+  push      {"p": subscribed-pattern, "c": channel, "m": msg}
+
+Ordering matters for the router's subscribe-then-publish handshakes, so
+`subscribe()` writes its SUB frame synchronously on the shared writer —
+frames from one client are processed strictly in order by the server.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import fnmatch
+import hmac
+import json
+from typing import Any
+
+from livekit_server_tpu.routing.kv import MemoryBus, Subscription
+
+MAX_FRAME = 8 * 1024 * 1024  # room snapshots ride the bus; give them room
+MAX_BUFFERED = 4 * 1024 * 1024  # per-subscriber write backlog before drops
+
+
+def _frame(obj: Any) -> bytes:
+    raw = json.dumps(obj, separators=(",", ":")).encode()
+    return len(raw).to_bytes(4, "big") + raw
+
+
+async def _read_frame(reader: asyncio.StreamReader) -> Any:
+    hdr = await reader.readexactly(4)
+    n = int.from_bytes(hdr, "big")
+    if n == 0 or n > MAX_FRAME:
+        raise ConnectionError(f"bad frame length {n}")
+    return json.loads(await reader.readexactly(n))
+
+
+class BusServer:
+    """Standalone KV/pub-sub node (the 'run one Redis' deployment seat).
+
+    `token` is the Redis-AUTH seat: when set, a client's first frame must
+    be {"op": "auth", "a": [token]} or the connection is refused — the bus
+    carries room pins, node registry, signal relay, and room snapshots, so
+    an unauthenticated listener is cluster-control-plane takeover."""
+
+    def __init__(self, token: str = "") -> None:
+        self.state = MemoryBus()  # hashes + KV with TTL (pub/sub is ours)
+        self.token = token
+        self.server: asyncio.AbstractServer | None = None
+        # writer → {pattern, ...}
+        self._subs: dict[asyncio.StreamWriter, set[str]] = {}
+        self.stats = {"conns": 0, "ops": 0, "published": 0}
+
+    async def start(self, host: str = "127.0.0.1", port: int = 7850) -> None:
+        self.server = await asyncio.start_server(self._handle, host, port)
+
+    @property
+    def port(self) -> int:
+        return self.server.sockets[0].getsockname()[1]
+
+    def close(self) -> None:
+        if self.server is not None:
+            self.server.close()
+
+    async def _handle(self, reader: asyncio.StreamReader,
+                      writer: asyncio.StreamWriter) -> None:
+        self.stats["conns"] += 1
+        self._subs[writer] = set()
+        authed = not self.token
+        try:
+            while True:
+                req = await _read_frame(reader)
+                self.stats["ops"] += 1
+                if not authed:
+                    ok = req.get("op") == "auth" and hmac.compare_digest(
+                        str(req.get("a", [""])[0] or ""), self.token
+                    )
+                    writer.write(
+                        _frame({"i": req.get("i", 0), "r": True} if ok
+                               else {"i": req.get("i", 0), "e": "auth required"})
+                    )
+                    await writer.drain()
+                    if not ok:
+                        break
+                    authed = True
+                    continue
+                try:
+                    result = await self._dispatch(writer, req["op"], req.get("a", []))
+                    writer.write(_frame({"i": req["i"], "r": result}))
+                except Exception as e:  # noqa: BLE001 — survive bad ops
+                    writer.write(_frame({"i": req["i"], "e": str(e)}))
+                await writer.drain()
+        except (asyncio.IncompleteReadError, ConnectionError, ConnectionResetError):
+            pass
+        finally:
+            self._subs.pop(writer, None)
+            writer.close()
+
+    async def _dispatch(self, writer, op: str, a: list):
+        s = self.state
+        if op == "hset":
+            await s.hset(a[0], a[1], a[2])
+        elif op == "hget":
+            return await s.hget(a[0], a[1])
+        elif op == "hgetall":
+            return await s.hgetall(a[0])
+        elif op == "hdel":
+            await s.hdel(a[0], a[1])
+        elif op == "set":
+            await s.set(a[0], a[1], a[2])
+        elif op == "get":
+            return await s.get(a[0])
+        elif op == "del":
+            await s.delete(a[0])
+        elif op == "setnx":
+            return await s.setnx(a[0], a[1], a[2])
+        elif op == "pub":
+            return self._publish(a[0], a[1])
+        elif op == "sub":
+            self._subs[writer].add(a[0])
+        elif op == "unsub":
+            self._subs[writer].discard(a[0])
+        elif op == "auth":
+            return True  # already authed (token-less bus, or re-auth)
+        else:
+            raise ValueError(f"unknown op {op}")
+        return None
+
+    def _publish(self, channel: str, msg: Any) -> int:
+        n = 0
+        for w, patterns in list(self._subs.items()):
+            for pat in patterns:
+                if pat == channel or (
+                    ("*" in pat or "?" in pat) and fnmatch.fnmatch(channel, pat)
+                ):
+                    if w.is_closing():
+                        continue
+                    # Bounded like Subscription's drop-on-overflow queue: a
+                    # stalled subscriber drops pushes instead of growing
+                    # the bus process's write buffer without limit.
+                    if w.transport.get_write_buffer_size() > MAX_BUFFERED:
+                        self.stats["dropped"] = self.stats.get("dropped", 0) + 1
+                        continue
+                    w.write(_frame({"p": pat, "c": channel, "m": msg}))
+                    n += 1
+        self.stats["published"] += n
+        return n
+
+
+class TCPBusClient:
+    """MessageBus over one TCP connection (the Redis-client seat)."""
+
+    def __init__(self, reader: asyncio.StreamReader, writer: asyncio.StreamWriter):
+        self._reader = reader
+        self._writer = writer
+        self._next_id = 0
+        self._pending: dict[int, asyncio.Future] = {}
+        self._subs: dict[str, list[Subscription]] = {}
+        self._task = asyncio.ensure_future(self._read_loop())
+        self.closed = False
+
+    @classmethod
+    async def connect(cls, host: str, port: int, token: str = "") -> "TCPBusClient":
+        reader, writer = await asyncio.open_connection(host, port)
+        client = cls(reader, writer)
+        if token:
+            await client._call("auth", token)
+        return client
+
+    @classmethod
+    async def connect_address(cls, address: str, token: str = "") -> "TCPBusClient":
+        host, _, port = address.rpartition(":")
+        return await cls.connect(host or "127.0.0.1", int(port), token=token)
+
+    async def _read_loop(self) -> None:
+        try:
+            while True:
+                msg = await _read_frame(self._reader)
+                if "p" in msg:  # push
+                    for sub in list(self._subs.get(msg["p"], [])):
+                        sub._offer(msg["m"])
+                    continue
+                fut = self._pending.pop(msg["i"], None)
+                if fut is not None and not fut.done():
+                    if "e" in msg:
+                        fut.set_exception(RuntimeError(msg["e"]))
+                    else:
+                        fut.set_result(msg.get("r"))
+        except (asyncio.IncompleteReadError, ConnectionError, ConnectionResetError):
+            pass
+        finally:
+            self.closed = True
+            for fut in self._pending.values():
+                if not fut.done():
+                    fut.set_exception(ConnectionError("bus connection lost"))
+            self._pending.clear()
+
+    def _send(self, op: str, *args) -> asyncio.Future:
+        if self.closed:
+            raise ConnectionError("bus connection lost")
+        self._next_id += 1
+        fut = asyncio.get_event_loop().create_future()
+        self._pending[self._next_id] = fut
+        self._writer.write(_frame({"i": self._next_id, "op": op, "a": list(args)}))
+        return fut
+
+    async def _call(self, op: str, *args):
+        return await self._send(op, *args)
+
+    # -- MessageBus -----------------------------------------------------
+    async def hset(self, key, field, value):
+        await self._call("hset", key, field, value)
+
+    async def hget(self, key, field):
+        return await self._call("hget", key, field)
+
+    async def hgetall(self, key):
+        return await self._call("hgetall", key)
+
+    async def hdel(self, key, field):
+        await self._call("hdel", key, field)
+
+    async def set(self, key, value, ttl=None):
+        await self._call("set", key, value, ttl)
+
+    async def get(self, key):
+        return await self._call("get", key)
+
+    async def delete(self, key):
+        await self._call("del", key)
+
+    async def setnx(self, key, value, ttl=None):
+        return await self._call("setnx", key, value, ttl)
+
+    async def publish(self, channel, msg):
+        return await self._call("pub", channel, msg)
+
+    def subscribe(self, channel: str, size: int = 200) -> Subscription:
+        """Synchronous like MemoryBus.subscribe: the SUB frame goes on the
+        wire immediately (writer.write is sync), so a publish awaited
+        AFTER this call is ordered behind the subscription server-side."""
+        sub = Subscription(self, channel, size)
+        self._subs.setdefault(channel, []).append(sub)
+        # Fire-and-forget op (response discarded via the pending future).
+        self._send("sub", channel).add_done_callback(lambda f: f.exception())
+        return sub
+
+    def _unsubscribe(self, channel: str, sub: Subscription) -> None:
+        lst = self._subs.get(channel)
+        if lst and sub in lst:
+            lst.remove(sub)
+            if not lst:
+                del self._subs[channel]
+                if not self.closed:
+                    self._send("unsub", channel).add_done_callback(
+                        lambda f: f.exception()
+                    )
+
+    async def close(self) -> None:
+        self.closed = True
+        self._task.cancel()
+        self._writer.close()

@@ -81,6 +81,10 @@ class IngestBuffer:
         R, T, K, S = dims
         self._count = np.zeros((R, T), np.int32)
         self.dropped = 0
+        # Rows quiesced for migration: once a room's state snapshot is
+        # taken, admitting more packets would advance munger offsets past
+        # what the destination node restores (duplicate SNs on re-issue).
+        self.frozen_rows: set[int] = set()
         self._i32 = lambda: np.zeros((R, T, K), np.int32)
         self._bool = lambda: np.zeros((R, T, K), bool)
         self._alloc_fields()
@@ -128,6 +132,8 @@ class IngestBuffer:
 
     def push(self, pkt: PacketIn) -> bool:
         """Stage one packet; False (and counted) if the tick is full."""
+        if pkt.room in self.frozen_rows:
+            return False  # mid-migration: the row's state is already shipped
         k = self._count[pkt.room, pkt.track]
         if k >= self.dims.pkts:
             self.dropped += 1

@@ -220,6 +220,10 @@ class PlaneRuntime:
         self._last_congested = np.zeros((R, S), bool)
         self._last_deficient = np.zeros((R, S), bool)
         self._task: asyncio.Task | None = None
+        # Guards self.state across the donated device step vs. host-side
+        # snapshot/restore (room migration): donation deletes the old
+        # buffers mid-step, so concurrent readers would see dead arrays.
+        self.state_lock = asyncio.Lock()
         self._on_tick: list[Callable[[TickResult], Awaitable[None] | None]] = []
         self.stats = {"ticks": 0, "fwd_packets": 0, "fwd_bytes": 0, "late_ticks": 0}
         # Single worker: device steps are strictly ordered (donated state).
@@ -314,6 +318,10 @@ class PlaneRuntime:
             estimate_valid=self.ingest._estimate_valid,
             pad_track=pad_track,
         )
+        if self.ingest.frozen_rows:
+            # Probe padding also advances munger SN lanes; a row mid-
+            # migration must stay byte-for-byte at its snapshot.
+            pad_num[list(self.ingest.frozen_rows)] = 0
         inp, payloads = self.ingest.drain(
             roll_quality=roll, tick_index=self.tick_index,
             pad_num=pad_num, pad_track=pad_track,
@@ -322,7 +330,8 @@ class PlaneRuntime:
         # reference slot (tick % SLAB_WINDOW) until it recycles.
         self._slab_history[self.tick_index % plane.SLAB_WINDOW] = payloads
         loop = asyncio.get_running_loop()
-        out = await loop.run_in_executor(self._executor, self._device_step, inp)
+        async with self.state_lock:
+            out = await loop.run_in_executor(self._executor, self._device_step, inp)
         # Mirror the probe controller's inputs for the next tick.
         self._last_committed = np.asarray(out.committed_bps)
         self._last_congested = np.asarray(out.congested)
@@ -496,6 +505,83 @@ class PlaneRuntime:
             "tick_index": self.tick_index,
             "arrays": [np.asarray(x) for x in flat],
         }
+
+    def snapshot_room(self, row: int) -> dict[str, Any]:
+        """One room row's slice of the plane state — the cross-node room
+        handoff payload (participant.go:823 MaybeStartMigration seeds the
+        same per-forwarder state on the destination node).
+
+        Control tensors come from the HOST mirrors (authoritative: they may
+        hold un-uploaded mutations newer than the device copy); everything
+        else slices on device first so only one row crosses HBM→host."""
+        flat, treedef = jax.tree.flatten(self.state)
+        arrays = [np.asarray(x[row]) for x in flat]
+        tree = jax.tree.unflatten(treedef, arrays)
+        tree = tree._replace(
+            meta=plane.TrackMeta(*[np.array(m[row]) for m in self.meta]),
+            ctrl=plane.SubControl(*[np.array(c[row]) for c in self.ctrl]),
+        )
+        return {"arrays": jax.tree.flatten(tree)[0]}
+
+    @staticmethod
+    def encode_room_snapshot(snap: dict[str, Any]) -> str:
+        """Room snapshot → base64 npz string (rides the KV bus)."""
+        import base64
+        import io
+
+        buf = io.BytesIO()
+        np.savez_compressed(buf, *snap["arrays"])
+        return base64.b64encode(buf.getvalue()).decode()
+
+    @staticmethod
+    def decode_room_snapshot(payload: str) -> dict[str, Any]:
+        import base64
+        import io
+
+        z = np.load(io.BytesIO(base64.b64decode(payload)))
+        # savez names leaves arr_0..arr_N; z.files sorts lexically (arr_10
+        # before arr_2), so index numerically.
+        return {"arrays": [z[f"arr_{i}"] for i in range(len(z.files))]}
+
+    def restore_room(self, row: int, snap: dict[str, Any]) -> None:
+        """Seed `row` from a snapshot taken on another node: munger/vp8/
+        sequencer offsets continue mid-stream, so migrated subscribers see
+        contiguous SN/TS instead of a stream reset.
+
+        Subscription masks are NOT carried over: the destination's slot
+        allocator hands out sub columns fresh, and a restored subscribed
+        bit on a column later given to a different participant would leak
+        media to someone who never subscribed. Rejoining subscribers
+        re-subscribe; their (track, sub) munger lanes resume intact."""
+        import jax.numpy as jnp
+
+        flat, treedef = jax.tree.flatten(self.state)
+        if len(flat) != len(snap["arrays"]):
+            raise ValueError(
+                f"snapshot has {len(snap['arrays'])} leaves, plane has "
+                f"{len(flat)} — source/destination plane versions differ"
+            )
+        new_flat = [
+            leaf.at[row].set(jnp.asarray(a, leaf.dtype))
+            for leaf, a in zip(flat, snap["arrays"])
+        ]
+        self.state = jax.tree.unflatten(treedef, new_flat)
+        if self._mesh is not None:
+            from livekit_server_tpu.parallel import shard_tree
+
+            self.state = shard_tree(self.state, self._mesh)
+        # Mirror the migrated row's track metadata back to the host copies
+        # (other rows' possibly-dirty host state stays untouched)…
+        snap_tree = jax.tree.unflatten(treedef, snap["arrays"])
+        for host_arr, snap_arr in zip(self.meta, snap_tree.meta):
+            host_arr[row] = snap_arr
+        # …but clear the subscriber-facing control masks (see docstring);
+        # the next ctrl upload clears them on device too.
+        self.ctrl.subscribed[row] = False
+        self.ctrl.sub_muted[row] = False
+        self.ctrl.max_spatial[row] = plane.MAX_LAYERS - 1
+        self.ctrl.max_temporal[row] = 3
+        self._ctrl_dirty = True
 
     def restore(self, snap: dict[str, Any]) -> None:
         flat, treedef = jax.tree.flatten(self.state)

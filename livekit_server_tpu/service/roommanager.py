@@ -68,6 +68,7 @@ class RoomManager:
         )
         self.rooms: dict[str, Room] = {}
         self._row_to_room: dict[int, Room] = {}
+        self._create_locks: dict[str, asyncio.Lock] = {}
         self.udp = None     # UDPMediaTransport, attached by the server at start
         # Media-wire key registry (the DTLS-SRTP key-exchange seat): one
         # AEAD session per participant, minted at join and delivered over
@@ -86,18 +87,30 @@ class RoomManager:
         room = self.rooms.get(name)
         if room is not None:
             return room
-        stored = await self.store.load_room(name)
-        room = Room(name, self.runtime, info=info or stored)
-        room.udp = self.udp
-        room.crypto = self.crypto
-        if info is None and stored is None:
-            room.info.empty_timeout = self.config.room.empty_timeout_s
-            room.info.departure_timeout = self.config.room.departure_timeout_s
-            room.info.max_participants = self.config.room.max_participants
-        self.rooms[name] = room
-        self._row_to_room[room.slots.row] = room
-        await self.store.store_room(room.info)
-        await self.router.set_node_for_room(name, self.router.local_node.node_id)
+        # Serialize creation per name: a second joiner arriving during the
+        # awaits below (store load, migration-snapshot restore) must wait
+        # for the fully-initialized room — subscribing against a row whose
+        # ctrl masks a restore is about to overwrite would silently wipe
+        # the subscription.
+        lock = self._create_locks.setdefault(name, asyncio.Lock())
+        async with lock:
+            room = self.rooms.get(name)
+            if room is not None:
+                return room
+            stored = await self.store.load_room(name)
+            room = Room(name, self.runtime, info=info or stored)
+            room.udp = self.udp
+            room.crypto = self.crypto
+            if info is None and stored is None:
+                room.info.empty_timeout = self.config.room.empty_timeout_s
+                room.info.departure_timeout = self.config.room.departure_timeout_s
+                room.info.max_participants = self.config.room.max_participants
+            await self._maybe_restore_room(room)
+            self.rooms[name] = room
+            self._row_to_room[room.slots.row] = room
+            await self.store.store_room(room.info)
+            await self.router.set_node_for_room(name, self.router.local_node.node_id)
+        self._create_locks.pop(name, None)
         self._update_node_stats()
         self._notify("room_started", room=room.info.to_dict())
         if self.agents is not None:
@@ -278,6 +291,62 @@ class RoomManager:
                 pass  # slow subscriber: drop (pacer/leaky-bucket analog)
 
         participant.on_media(media_out)
+
+    # -- cross-node room migration (participant.go:823 analog) ------------
+    async def handoff_room(self, name: str, target_node_id: str = "") -> bool:
+        """Publish a room's media-plane row to the bus and unpin (or repin)
+        it, so another node's get_or_create_room resumes mid-stream with
+        intact munger/VP8/sequencer offsets — migrated subscribers see
+        contiguous SN/TS instead of a stream reset."""
+        room = self.rooms.get(name)
+        bus = getattr(self.router, "bus", None)
+        if room is None or bus is None:
+            return False
+        # Quiesce the row first: packets (or probe padding) forwarded after
+        # the snapshot would advance munger SN lanes past what the
+        # destination restores, and those SNs would be re-issued there.
+        self.runtime.ingest.frozen_rows.add(room.slots.row)
+        try:
+            async with self.runtime.state_lock:  # vs. the donated device step
+                snap = self.runtime.snapshot_room(room.slots.row)
+            await bus.set(
+                f"room_snapshot:{name}",
+                self.runtime.encode_room_snapshot(snap),
+                120.0,
+            )
+            if target_node_id:
+                await self.router.set_node_for_room(name, target_node_id)
+            else:
+                await self.router.clear_room_state(name)
+            # Local teardown only — the pin/store state now belongs to the
+            # destination node (clients reconnect there, reason MIGRATION).
+            self.rooms.pop(name, None)
+            self._row_to_room.pop(room.slots.row, None)
+            room.close(pm.DisconnectReason.MIGRATION)
+        finally:
+            # room.close released the row; its next tenant starts unfrozen.
+            self.runtime.ingest.frozen_rows.discard(room.slots.row)
+        self._update_node_stats()
+        return True
+
+    async def _maybe_restore_room(self, room: Room) -> None:
+        """Adopt a migrated room's device state if a snapshot is waiting on
+        the bus (the receiving half of handoff_room)."""
+        bus = getattr(self.router, "bus", None)
+        if bus is None:
+            return
+        raw = await bus.get(f"room_snapshot:{room.name}")
+        if not raw:
+            return
+        try:
+            snap = self.runtime.decode_room_snapshot(raw)
+            async with self.runtime.state_lock:  # vs. the donated device step
+                self.runtime.restore_room(room.slots.row, snap)
+        except Exception as e:  # noqa: BLE001 — a bad snapshot (version/
+            # dims drift, corruption) must not poison room creation; the
+            # room starts fresh instead (a stream reset, not an outage).
+            print(f"room snapshot for {room.name!r} rejected: {e}", flush=True)
+        await bus.delete(f"room_snapshot:{room.name}")
 
     def handle_pli(self, row: int, track_col: int) -> None:
         """RTCP PLI from a UDP subscriber → keyframe request toward the

@@ -14,7 +14,7 @@ import time
 
 from aiohttp import web
 
-from livekit_server_tpu.config.config import Config
+from livekit_server_tpu.config.config import Config, ConfigError
 from livekit_server_tpu.routing import (
     LocalNode,
     MemoryBus,
@@ -214,6 +214,29 @@ class LivekitServer:
     @property
     def port(self) -> int:
         return self.config.port
+
+
+async def connect_bus(config: Config):
+    """Resolve the configured multi-node bus (redisrouter's Redis client
+    seat): kv.kind == "tcp" dials the in-repo BusServer at kv.address."""
+    if config.kv.kind == "tcp":
+        if not config.kv.address:
+            # Booting a cluster-configured node standalone would silently
+            # split-brain it out of the cluster; fail loudly instead.
+            raise ConfigError("kv.kind is 'tcp' but kv.address is empty")
+        from livekit_server_tpu.routing.tcpbus import TCPBusClient
+
+        return await TCPBusClient.connect_address(
+            config.kv.address, token=config.kv.auth_token
+        )
+    if config.kv.kind in ("", "memory"):
+        return None
+    # An unknown kind must not fall through to a private in-process bus —
+    # the node would boot "clustered" against a registry only it can see.
+    raise ConfigError(
+        f"unsupported kv.kind {config.kv.kind!r}: no external KV client is "
+        "bundled; run `livekit-server-tpu bus` and use kv.kind='tcp'"
+    )
 
 
 def create_server(config: Config, bus=None, mesh=None) -> LivekitServer:
