@@ -31,6 +31,7 @@ class TrafficSpec(NamedTuple):
     tick_ms: int = 10
     video_kbps: int = 1500     # per track, summed over layers
     audio_kbps: int = 32
+    svc: bool = False          # video tracks are SVC (VP9/AV1 DD path)
 
 
 class TrafficState(NamedTuple):
@@ -73,7 +74,7 @@ def make_meta_ctrl(dims: plane.PlaneDims, spec: TrafficSpec):
         is_video=is_video,
         published=published,
         pub_muted=np.zeros((R, T), bool),
-        is_svc=np.zeros((R, T), bool),
+        is_svc=is_video.copy() if spec.svc else np.zeros((R, T), bool),
     )
     ctrl = plane.SubControl(
         subscribed=np.broadcast_to(published[:, :, None], (R, T, S)).copy(),
@@ -142,9 +143,12 @@ def next_tick(
     # Simulcast: packets cycle through spatial layers 0..2 weighted by size.
     layer = np.where(is_video[None, :, None], k_idx[None, None, :] % 3, 0)
     temporal = np.where(is_video[None, :, None], k_idx[None, None, :] % 2, 0)
+    # Keyframe ticks mark the first packet of EVERY spatial layer (real
+    # simulcast encoders key all layers together; the selector locks onto a
+    # spatial layer only at a keyframe of that layer — simulcast.go:42).
     keyframe = np.logical_and(
         is_video[None, :, None],
-        (tick_index % 100 == 0) & (k_idx[None, None, :] == 0),
+        (tick_index % 100 == 0) & (k_idx[None, None, :] < 3),
     )
     begin_pic = np.logical_and(is_video[None, :, None], new_frame[:, :, None])
     layer_sync = keyframe | (begin_pic & (temporal == 0))
