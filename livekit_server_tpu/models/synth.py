@@ -32,6 +32,11 @@ class TrafficSpec(NamedTuple):
     video_kbps: int = 1500     # per track, summed over layers
     audio_kbps: int = 32
     svc: bool = False          # video tracks are SVC (VP9/AV1 DD path)
+    # Per-subscriber channel estimate fed as BWE samples. 0 = auto: 1.25×
+    # the full offered bitrate, so throughput configs measure an
+    # UNCONGESTED channel (congestion behavior is exercised by tests and
+    # by setting this explicitly).
+    estimate_bps: float = 0.0
 
 
 class TrafficState(NamedTuple):
@@ -176,7 +181,10 @@ def next_tick(
 
     arrival = (ts + rng.integers(0, 90, (R, T, K))) & 0xFFFFFFFF
 
-    estimate = rng.normal(5e6, 5e5, (R, S)).clip(1e5)
+    est0 = spec.estimate_bps or 1.25 * 1000.0 * (
+        spec.video_tracks * spec.video_kbps + spec.audio_tracks * spec.audio_kbps
+    )
+    estimate = rng.normal(est0, est0 * 0.05, (R, S)).clip(1e5)
 
     def full(x, dtype):
         return np.broadcast_to(x, (R, T, K)).astype(dtype)

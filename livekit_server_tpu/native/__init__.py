@@ -23,6 +23,7 @@ from pathlib import Path
 import numpy as np
 
 _SRC = Path(__file__).resolve().parents[2] / "native" / "rtp_parser.cpp"
+_EGRESS_SRC = Path(__file__).resolve().parents[2] / "native" / "egress.cpp"
 _CACHE = Path(__file__).resolve().parent / "_build"
 
 # Keep in sync with struct ParsedPacket in rtp_parser.cpp.
@@ -289,6 +290,108 @@ class _PythonRTP:
                 j += 1
 
 
+def _build_egress() -> Path | None:
+    _CACHE.mkdir(exist_ok=True)
+    so = _CACHE / "libegress.so"
+    if so.exists() and so.stat().st_mtime >= _EGRESS_SRC.stat().st_mtime:
+        return so
+    try:
+        subprocess.run(
+            ["g++", "-O2", "-shared", "-fPIC", "-pthread", "-o", str(so),
+             str(_EGRESS_SRC), "-l:libcrypto.so.3"],
+            check=True, capture_output=True, timeout=120,
+        )
+        return so
+    except (subprocess.SubprocessError, FileNotFoundError):
+        return None
+
+
+class NativeEgress:
+    """One-call-per-tick egress: datagram assembly + VP8 descriptor patch +
+    AES-128-GCM seal + sendmmsg, fanned over a few threads (the native
+    replacement for the per-packet Python send loop — downtrack.go WriteRTP
+    + pion/srtp + pacer socket writes)."""
+
+    SEAL_OVERHEAD = 30  # 14-byte frame header + 16-byte GCM tag
+
+    def __init__(self, so: Path):
+        self.lib = ctypes.CDLL(str(so))
+        self.lib.egress_batch_send.restype = ctypes.c_int64
+        self.lib.egress_batch_send.argtypes = [ctypes.c_int, ctypes.c_int] + [
+            ctypes.c_void_p, ctypes.c_int32
+        ] + [ctypes.c_void_p] * 21
+        # Exercise the library once so a broken libcrypto link is caught at
+        # load time (and the fallback engaged), not on the first media tick.
+        self._selftest()
+
+    def _selftest(self) -> None:
+        slab = b"\x90\xe0\x80\x01\x02\x20\x00hello"
+        out, out_off, out_len, sent = self.send(
+            fd=-1, n_threads=1, slab=slab,
+            pay_off=np.array([0], np.int64),
+            pay_len=np.array([len(slab)], np.int32),
+            marker=np.array([1], np.uint8),
+            pt=np.array([96], np.uint8),
+            vp8=np.array([1], np.uint8),
+            sn=np.array([7], np.uint16),
+            ts=np.array([9], np.uint32),
+            ssrc=np.array([3], np.uint32),
+            pid=np.array([5], np.int32),
+            tl0=np.array([6], np.int32),
+            kidx=np.array([2], np.int32),
+            ip=np.array([0x7F000001], np.uint32),
+            port=np.array([1], np.uint16),
+            seal=np.array([1], np.uint8),
+            key_idx=np.array([0], np.int32),
+            keys=np.zeros((1, 16), np.uint8),
+            key_ids=np.array([42], np.uint32),
+            counters=np.array([0], np.uint64),
+        )
+        frame = bytes(out[: out_len[0]])
+        if sent != 1 or frame[0] != 0x01 or len(frame) != 14 + 12 + len(slab) + 16:
+            raise OSError("egress self-test failed")
+        from livekit_server_tpu.runtime.crypto import MediaCryptoClient
+
+        inner = MediaCryptoClient(42, bytes(16)).open(frame)
+        # VP8 descriptor patched: 15-bit pid=5, tl0=6, keyidx=2 in T/K byte.
+        if inner is None or inner[12:19] != bytes(
+            [0x90, 0xE0, 0x80, 0x05, 0x06, 0x22, 0x00]
+        ):
+            raise OSError("egress seal self-test failed")
+
+    def send(self, fd, n_threads, slab, pay_off, pay_len, marker, pt, vp8,
+             sn, ts, ssrc, pid, tl0, kidx, ip, port, seal, key_idx, keys,
+             key_ids, counters):
+        """Returns (out, out_off, out_len, sent). With fd < 0 nothing hits
+        the network and `out` holds the built frames (tests / TCP path)."""
+        n = len(pay_off)
+        clear_len = 12 + pay_len.astype(np.int64)
+        out_len = np.where(
+            (seal != 0) & (key_idx >= 0), clear_len + self.SEAL_OVERHEAD, clear_len
+        ).astype(np.int32)
+        out_off = np.zeros(n, np.int64)
+        np.cumsum(out_len[:-1], out=out_off[1:])
+        out = np.zeros(int(out_off[-1]) + int(out_len[-1]) if n else 0, np.uint8)
+        slab_arr = np.frombuffer(slab, np.uint8) if len(slab) else np.zeros(1, np.uint8)
+
+        def c(a, dt):
+            return np.ascontiguousarray(a, dt).ctypes.data
+
+        sent = self.lib.egress_batch_send(
+            int(fd), int(n_threads), slab_arr.ctypes.data, n,
+            c(pay_off, np.int64), c(pay_len, np.int32), c(marker, np.uint8),
+            c(pt, np.uint8), c(vp8, np.uint8), c(sn, np.uint16),
+            c(ts, np.uint32), c(ssrc, np.uint32), c(pid, np.int32),
+            c(tl0, np.int32), c(kidx, np.int32), c(ip, np.uint32),
+            c(port, np.uint16), c(seal, np.uint8), c(key_idx, np.int32),
+            c(np.ascontiguousarray(keys, np.uint8), np.uint8),
+            c(key_ids, np.uint32), c(counters, np.uint64),
+            out.ctypes.data, out_off.ctypes.data,
+            np.ascontiguousarray(out_len).ctypes.data,
+        )
+        return out, out_off, out_len, int(sent)
+
+
 def _load():
     so = _build()
     if so is not None:
@@ -299,4 +402,15 @@ def _load():
     return _PythonRTP()
 
 
+def _load_egress():
+    so = _build_egress()
+    if so is not None:
+        try:
+            return NativeEgress(so)
+        except OSError:
+            return None
+    return None
+
+
 rtp = _load()
+egress = _load_egress()

@@ -22,14 +22,14 @@ from dataclasses import dataclass
 
 import numpy as np
 
-from livekit_server_tpu.native import rtp
+from livekit_server_tpu.native import egress as native_egress, rtp
 from livekit_server_tpu.runtime.crypto import (
     MAGIC as CRYPTO_MAGIC,
     MediaCryptoRegistry,
     MediaCryptoSession,
     parse_key_id,
 )
-from livekit_server_tpu.runtime.ingest import IngestBuffer, PacketIn
+from livekit_server_tpu.runtime.ingest import IngestBuffer
 
 VP8_PT = 96
 OPUS_PT = 111
@@ -229,6 +229,19 @@ class UDPMediaTransport(asyncio.DatagramProtocol):
         self.pub_sr: dict[int, tuple[int, int]] = {}
         self._ts_delta: dict[tuple, int] = {}
         self._last_pli_ms: dict[tuple, float] = {}       # (room,track) → throttle
+        # Vectorized egress mirrors (the batch path reads arrays, not
+        # dicts): per-(room, sub, track) downtrack SSRC, per-(room, track)
+        # payload type, and SR accumulators folded at SR cadence.
+        dims = ingest.dims
+        R, T, S = dims.rooms, dims.tracks, dims.subs
+        self._egress_ssrc_arr = np.zeros((R, S, T), np.uint32)
+        self._track_pt = np.full((R, T), OPUS_PT, np.uint8)
+        self._track_is_video = np.zeros((R, T), bool)
+        self._txsr_pkts = np.zeros((R, S, T), np.int64)
+        self._txsr_oct = np.zeros((R, S, T), np.int64)
+        self._txsr_ts = np.zeros((R, S, T), np.uint32)
+        self._txsr_ms = np.zeros((R, S, T), np.float64)
+        self.egress_threads = 4
         self.stats = {
             "rx": 0, "tx": 0, "unknown_ssrc": 0, "parse_errors": 0,
             "addr_mismatch": 0, "bad_punch": 0,
@@ -258,6 +271,8 @@ class UDPMediaTransport(asyncio.DatagramProtocol):
         ssrc = self._new_ssrc()
         self.bindings[ssrc] = SSRCBinding(room, track, is_video, layer, session)
         self.track_kind[(room, track)] = is_video
+        self._track_pt[room, track] = VP8_PT if is_video else OPUS_PT
+        self._track_is_video[room, track] = is_video
         return ssrc
 
     def bind_sub_session(
@@ -359,6 +374,9 @@ class UDPMediaTransport(asyncio.DatagramProtocol):
             self.egress_rev.pop(ssrc, None)
             self._tx_sr.pop(ssrc, None)
             self._sr_sent.pop(ssrc, None)
+        self._egress_ssrc_arr[room, sub, :] = 0
+        self._txsr_pkts[room, sub, :] = 0
+        self._txsr_oct[room, sub, :] = 0
         pid = self._punch_by_sub.pop((room, sub), None)
         if pid is not None:
             self.punch_ids.pop(pid, None)
@@ -379,6 +397,11 @@ class UDPMediaTransport(asyncio.DatagramProtocol):
             del self.track_kind[key]
         for key in [k for k in self._last_pli_ms if k[0] == room]:
             del self._last_pli_ms[key]
+        self._egress_ssrc_arr[room] = 0
+        self._track_pt[room] = OPUS_PT
+        self._track_is_video[room] = False
+        self._txsr_pkts[room] = 0
+        self._txsr_oct[room] = 0
         for key in [k for k in self._ts_delta if k[0] == room]:
             del self._ts_delta[key]
         for key in [k for k in self.sub_sessions if k[0] == room]:
@@ -393,6 +416,7 @@ class UDPMediaTransport(asyncio.DatagramProtocol):
         if track not in m:
             m[track] = self._new_ssrc()
             self.egress_rev[m[track]] = (room, sub, track)
+            self._egress_ssrc_arr[room, sub, track] = m[track]
         return m[track]
 
     # -- datagram path ----------------------------------------------------
@@ -651,83 +675,154 @@ class UDPMediaTransport(asyncio.DatagramProtocol):
         self._sendto(PUNCH_ACK + data[8:12], addr, session)
 
     def _flush_rx(self) -> None:
+        """One native parse + one vectorized ingest stage per event-loop
+        coalesce window. Per-PACKET Python is limited to unique-SSRC
+        binding resolution and video loss tracking; everything else is
+        numpy group math (the batch design this module documents)."""
         self._rx_scheduled = False
         pending, self._rx_pending = self._rx_pending, []
         if not pending:
             return
         now_ms = asyncio.get_event_loop().time() * 1000.0
-        lengths = np.asarray([len(d) for d, _, _ in pending], np.int32)
-        offsets = np.zeros(len(pending), np.int32)
-        np.cumsum(lengths[:-1], out=offsets[1:])
+        n = len(pending)
+        lengths = np.empty(n, np.int32)
+        offsets = np.empty(n, np.int32)
+        addr_ids = np.empty(n, np.int64)
+        sess_ids = np.empty(n, np.int64)
+        addr_map: dict = {}
+        addr_list: list = []
+        sess_map: dict = {}
+        sess_list: list = [None]  # index 0 = no session (plaintext)
+        off = 0
+        for i, (d, addr, session) in enumerate(pending):
+            offsets[i] = off
+            lengths[i] = len(d)
+            off += len(d)
+            ai = addr_map.get(addr)
+            if ai is None:
+                ai = addr_map[addr] = len(addr_list)
+                addr_list.append(addr)
+            addr_ids[i] = ai
+            if session is None:
+                sess_ids[i] = 0
+            else:
+                si = sess_map.get(id(session))
+                if si is None:
+                    si = sess_map[id(session)] = len(sess_list)
+                    sess_list.append(session)
+                sess_ids[i] = si
         blob = b"".join(d for d, _, _ in pending)
         parsed = rtp.parse_batch(
             blob, offsets, lengths,
             audio_level_ext=AUDIO_LEVEL_EXT_ID, vp8_pts={VP8_PT},
         )
-        for i, (data, addr, session) in enumerate(pending):
-            p = parsed[i]
-            if int(p["payload_len"]) < 0:
-                self.stats["parse_errors"] += 1
+
+        plen = parsed["payload_len"].astype(np.int64)
+        ok = plen >= 0
+        self.stats["parse_errors"] += int((~ok).sum())
+
+        # Binding / alignment resolution per UNIQUE SSRC (dict work scales
+        # with streams, not packets).
+        ssrcs = parsed["ssrc"]
+        uniq, inv = np.unique(ssrcs, return_inverse=True)
+        U = len(uniq)
+        u_known = np.zeros(U, bool)
+        u_room = np.zeros(U, np.int32)
+        u_track = np.zeros(U, np.int32)
+        u_layer = np.zeros(U, np.int32)
+        u_video = np.zeros(U, bool)
+        u_keyed = np.zeros(U, bool)
+        u_sess = np.full(U, -1, np.int64)     # bound session's index this flush
+        u_aligned = np.zeros(U, bool)
+        u_delta = np.zeros(U, np.int64)
+        u_latch = np.full(U, -1, np.int64)    # latched addr id (-2: not seen)
+        for j, sv in enumerate(uniq.tolist()):
+            b = self.bindings.get(sv)
+            if b is None:
                 continue
-            ssrc = int(p["ssrc"])
-            binding = self.bindings.get(ssrc)
-            if binding is None:
-                self.stats["unknown_ssrc"] += 1
-                continue
-            # SSRC pinned to its publisher's key: valid media sealed under
-            # a DIFFERENT participant's session must not inject here. In
-            # cleartext-allowed mode a plaintext packet (session None) is
-            # legal even for a keyed SSRC (legacy client).
-            if (
-                binding.session is not None
-                and binding.session is not session
-                and (session is not None or self.require_encryption)
-            ):
-                self.stats["session_mismatch"] += 1
-                continue
-            # First packet latches the source address; later packets from a
-            # different address are dropped (UDP-mux address learning —
-            # without this, anyone who learns an SSRC could inject media).
-            latched = self.addrs.setdefault(ssrc, addr)
-            if latched != addr:
-                self.stats["addr_mismatch"] += 1
-                continue
-            if binding.is_video:
-                # NACK generation is video-only (the reference negotiates
-                # NACK for video; audio loss is concealed, never replayed).
-                self._track_upstream_loss(ssrc, int(p["sn"]), now_ms)
-            off, ln = int(p["payload_off"]), int(p["payload_len"])
+            u_known[j] = True
+            u_room[j] = b.room
+            u_track[j] = b.track
+            u_layer[j] = b.layer
+            u_video[j] = b.is_video
+            if b.session is not None:
+                u_keyed[j] = True
+                u_sess[j] = sess_map.get(id(b.session), -1)
+            delta = self._ts_delta.get((b.room, b.track, b.layer))
+            if delta is not None:
+                u_aligned[j] = True
+                u_delta[j] = delta
+            latched = self.addrs.get(sv)
+            if latched is not None:
+                u_latch[j] = addr_map.get(latched, -2)
+
+        known = ok & u_known[inv]
+        self.stats["unknown_ssrc"] += int((ok & ~u_known[inv]).sum())
+        # SSRC pinned to its publisher's key: valid media sealed under a
+        # DIFFERENT participant's session must not inject here. In
+        # cleartext-allowed mode a plaintext packet (session index 0) is
+        # legal even for a keyed SSRC (legacy client).
+        keyed = u_keyed[inv]
+        same = (sess_ids == u_sess[inv]) & (u_sess[inv] > 0)
+        mismatch = keyed & ~same & ((sess_ids != 0) | self.require_encryption)
+        self.stats["session_mismatch"] += int((known & mismatch).sum())
+        cand = known & ~mismatch
+
+        # First packet latches the source address; later packets from a
+        # different address are dropped (UDP-mux address learning — without
+        # this, anyone who learns an SSRC could inject media).
+        first = np.full(U, -1, np.int64)
+        pos = np.nonzero(cand)[0]
+        first[inv[pos][::-1]] = pos[::-1]  # smallest position wins
+        for j in np.nonzero((u_latch == -1) & (first >= 0))[0]:
+            aid = addr_ids[first[j]]
+            self.addrs[int(uniq[j])] = addr_list[int(aid)]
+            u_latch[j] = aid
+        addr_ok = addr_ids == u_latch[inv]
+        self.stats["addr_mismatch"] += int((cand & ~addr_ok).sum())
+        final = cand & addr_ok
+
+        # NACK generation is video-only (the reference negotiates NACK for
+        # video; audio loss is concealed, never replayed).
+        sn_arr = parsed["sn"]
+        for i in np.nonzero(final & u_video[inv])[0]:
+            self._track_upstream_loss(int(ssrcs[i]), int(sn_arr[i]), now_ms)
+
+        idx = np.nonzero(final)[0]
+        if len(idx):
+            e_inv = inv[idx]
+            raw_ts = parsed["ts"][idx].astype(np.int64)
+            aligned = u_aligned[e_inv]
             # SR-based cross-layer alignment: subtract this layer's delta so
             # all simulcast layers share layer 0's timeline; the munger then
             # carries TS straight through a source switch (ts_aligned ⇒
             # ts_jump = -1 on device).
-            raw_ts = int(p["ts"])
-            delta = self._ts_delta.get(
-                (binding.room, binding.track, binding.layer)
-            )
-            ts = (raw_ts - delta) & 0xFFFFFFFF if delta is not None else raw_ts
-            self.ingest.push(
-                PacketIn(
-                    room=binding.room,
-                    track=binding.track,
-                    sn=int(p["sn"]),
-                    ts=ts,
-                    ts_aligned=delta is not None,
-                    size=ln,
-                    payload=data[off : off + ln],
-                    marker=bool(p["marker"]),
-                    layer=binding.layer,
-                    temporal=int(p["tid"]),
-                    keyframe=bool(p["keyframe"]),
-                    layer_sync=bool(p["layer_sync"]) or bool(p["keyframe"]),
-                    begin_pic=bool(p["begin_pic"]),
-                    pid=max(int(p["picture_id"]), 0),
-                    tl0=max(int(p["tl0picidx"]), 0),
-                    keyidx=max(int(p["keyidx"]), 0),
-                    frame_ms=20 if not binding.is_video else 0,
-                    audio_level=int(p["audio_level"]),
-                    arrival_rtp=int(p["ts"]),
-                )
+            ts = np.where(aligned, (raw_ts - u_delta[e_inv]) & 0xFFFFFFFF, raw_ts)
+            kf = parsed["keyframe"][idx].astype(bool)
+            is_vid = u_video[e_inv]
+            self.ingest.push_batch(
+                room=u_room[e_inv],
+                track=u_track[e_inv],
+                layer=u_layer[e_inv],
+                sn=sn_arr[idx].astype(np.int64),
+                ts=ts,
+                ts_aligned=aligned,
+                temporal=parsed["tid"][idx].astype(np.int32),
+                keyframe=kf,
+                layer_sync=parsed["layer_sync"][idx].astype(bool) | kf,
+                begin_pic=parsed["begin_pic"][idx].astype(bool),
+                marker=parsed["marker"][idx].astype(bool),
+                pid=np.maximum(parsed["picture_id"][idx], 0),
+                tl0=np.maximum(parsed["tl0picidx"][idx], 0),
+                keyidx=np.maximum(parsed["keyidx"][idx], 0),
+                size=plen[idx].astype(np.int32),
+                frame_ms=np.where(is_vid, 0, 20).astype(np.int32),
+                audio_level=parsed["audio_level"][idx].astype(np.int32),
+                arrival_rtp=parsed["ts"][idx].astype(np.int64),
+                pay_start=offsets[idx].astype(np.int64)
+                + parsed["payload_off"][idx].astype(np.int64),
+                pay_length=plen[idx],
+                blob=blob,
             )
         self._send_upstream_nacks(now_ms)
 
@@ -737,6 +832,7 @@ class UDPMediaTransport(asyncio.DatagramProtocol):
         if now_ms - self._last_sr_ms < 1000.0:
             return
         self._last_sr_ms = now_ms
+        self._fold_txsr()
         ntp = ntp_now()
         mid = ntp_mid32(ntp)
         for ssrc, st in self._tx_sr.items():
@@ -763,6 +859,166 @@ class UDPMediaTransport(asyncio.DatagramProtocol):
             mids = self._sr_sent.setdefault(ssrc, [])
             mids.append(mid)
             del mids[:-4]
+
+    def send_egress_batch(self, batch) -> np.ndarray:
+        """Vectorized tick egress (the hot half of DownTrack.WriteRTP +
+        pion/srtp + pacer socket writes): per-entry field arrays are
+        assembled with numpy index math and handed to ONE native call that
+        builds datagrams, patches VP8 descriptors, seals, and sendmmsg()s
+        across a small thread fan-out. No per-packet Python objects.
+
+        Returns a [N] bool mask of entries that have a UDP/TCP media
+        destination — the caller delivers the complement over WebSocket.
+        """
+        import socket as _socket
+
+        n = len(batch)
+        if n == 0:
+            return np.zeros(0, bool)
+        r, t, k, s = batch.rooms, batch.tracks, batch.ks, batch.subs
+        S = self.ingest.dims.subs
+        # Destination resolution per UNIQUE (room, sub) — dict lookups
+        # scale with subscribers, not with (packet × subscriber) entries.
+        pairkey = r.astype(np.int64) * S + s
+        uniq, inv = np.unique(pairkey, return_inverse=True)
+        u_ip = np.zeros(len(uniq), np.uint32)
+        u_port = np.zeros(len(uniq), np.uint16)
+        u_tcp = np.zeros(len(uniq), bool)
+        u_sess = np.full(len(uniq), -1, np.int32)
+        sessions: list = []
+        for j, q in enumerate(uniq):
+            rr, ss = divmod(int(q), S)
+            sess = self.sub_sessions.get((rr, ss))
+            if sess is not None:
+                u_sess[j] = len(sessions)
+                sessions.append(sess)
+            addr = self.sub_addrs.get((rr, ss))
+            if addr is None:
+                continue
+            if addr[0] == "tcp":
+                u_tcp[j] = True
+            else:
+                u_ip[j] = int.from_bytes(_socket.inet_aton(addr[0]), "big")
+                u_port[j] = addr[1]
+        e_port = u_port[inv]
+        e_tcp = u_tcp[inv]
+        has_dest = (e_port != 0) | e_tcp
+
+        if native_egress is None or self.transport is None:
+            # Toolchain-free fallback: the per-packet Python path.
+            if self.transport is not None or self.tcp_sinks:
+                self.send_egress(batch.to_packets(has_dest))
+            return has_dest
+
+        po = batch.payloads.off[r, t, k]
+        pl = batch.payloads.length[r, t, k]
+        idx = np.nonzero((e_port != 0) & (po >= 0))[0]
+        if len(idx):
+            rr_, tt_, ss_ = r[idx], t[idx], s[idx]
+            ssrc = self._egress_ssrc_arr[rr_, ss_, tt_].copy()
+            for m_ in np.nonzero(ssrc == 0)[0]:  # first tick of a new sub only
+                ssrc[m_] = self.subscriber_ssrc(int(rr_[m_]), int(ss_[m_]), int(tt_[m_]))
+            e_sess = u_sess[inv][idx]
+            if sessions:
+                active = np.array(
+                    [self.require_encryption or x.client_active for x in sessions],
+                    bool,
+                )
+                seal = (e_sess >= 0) & active[np.maximum(e_sess, 0)]
+            else:
+                seal = np.zeros(len(idx), bool)
+            key_idx = np.where(seal, e_sess, -1).astype(np.int32)
+            ctr = np.zeros(len(idx), np.uint64)
+            if seal.any():
+                # Allocate each session a contiguous counter block for this
+                # batch (sessions also seal RTCP from Python between ticks;
+                # the authoritative cursor stays on the session object).
+                sealed_pos = np.nonzero(seal)[0]
+                es = e_sess[sealed_pos]
+                cnts = np.bincount(es, minlength=len(sessions))
+                base = np.zeros(len(sessions), np.uint64)
+                for j, x in enumerate(sessions):
+                    base[j] = x.tx_counter
+                    x.tx_counter += int(cnts[j])
+                order = np.argsort(es, kind="stable")
+                sorted_es = es[order]
+                grp_start = np.r_[0, np.nonzero(np.diff(sorted_es))[0] + 1]
+                sizes = np.diff(np.r_[grp_start, len(es)])
+                ranks = np.empty(len(es), np.int64)
+                ranks[order] = np.arange(len(es)) - np.repeat(grp_start, sizes)
+                ctr[sealed_pos] = base[es] + ranks.astype(np.uint64)
+            keys = (
+                np.frombuffer(b"".join(x.key for x in sessions), np.uint8)
+                .reshape(-1, 16)
+                if sessions else np.zeros((1, 16), np.uint8)
+            )
+            key_ids = (
+                np.array([x.key_id for x in sessions], np.uint32)
+                if sessions else np.zeros(1, np.uint32)
+            )
+            fd = self.transport.get_extra_info("socket").fileno()
+            _, _, _, sent = native_egress.send(
+                fd=fd, n_threads=self.egress_threads,
+                slab=batch.payloads.data,
+                pay_off=po[idx], pay_len=pl[idx],
+                marker=batch.payloads.marker[r, t, k][idx].astype(np.uint8),
+                pt=self._track_pt[rr_, tt_],
+                vp8=self._track_is_video[rr_, tt_].astype(np.uint8),
+                sn=(batch.sn[idx] & 0xFFFF).astype(np.uint16),
+                ts=(batch.ts[idx].astype(np.int64) & 0xFFFFFFFF).astype(np.uint32),
+                ssrc=ssrc,
+                pid=batch.pid[idx], tl0=batch.tl0[idx], kidx=batch.keyidx[idx],
+                ip=u_ip[inv][idx], port=e_port[idx],
+                seal=seal.astype(np.uint8), key_idx=key_idx,
+                keys=keys, key_ids=key_ids, counters=ctr,
+            )
+            self.stats["tx"] += sent
+            if sent < len(idx):
+                self.stats["tx_drop"] = self.stats.get("tx_drop", 0) + len(idx) - sent
+            # SR bookkeeping accumulators, folded at SR cadence. bincount
+            # allocates plane-sized temporaries — only worth it when the
+            # batch is a sizable fraction of the plane; otherwise add.at
+            # scales with entries sent.
+            R, T = self.ingest.dims.rooms, self.ingest.dims.tracks
+            flat = (rr_.astype(np.int64) * S + ss_) * T + tt_
+            if R * S * T <= 4 * len(flat):
+                self._txsr_pkts += np.bincount(
+                    flat, minlength=R * S * T
+                ).reshape(R, S, T)
+                self._txsr_oct += np.bincount(
+                    flat, weights=pl[idx].astype(np.float64), minlength=R * S * T
+                ).astype(np.int64).reshape(R, S, T)
+            else:
+                np.add.at(self._txsr_pkts.reshape(-1), flat, 1)
+                np.add.at(self._txsr_oct.reshape(-1), flat, pl[idx])
+            self._txsr_ts[rr_, ss_, tt_] = (
+                batch.ts[idx].astype(np.int64) & 0xFFFFFFFF
+            ).astype(np.uint32)
+            now_ms = asyncio.get_event_loop().time() * 1000.0
+            self._txsr_ms[rr_, ss_, tt_] = now_ms
+            self._send_srs(now_ms)
+        if (e_tcp & (po >= 0)).any():
+            # TCP-fallback subscribers: cold path, per-frame sealing.
+            self.send_egress(batch.to_packets(e_tcp & (po >= 0)))
+        return has_dest
+
+    def _fold_txsr(self) -> None:
+        """Merge batch-path SR accumulators into the per-SSRC table (runs
+        at SR cadence, so the per-SSRC loop is 1/s, not per tick)."""
+        nz = np.nonzero(self._txsr_pkts)
+        for rr, ss, tt in zip(*nz):
+            ssrc = int(self._egress_ssrc_arr[rr, ss, tt])
+            if ssrc == 0:
+                continue
+            st = self._tx_sr.get(ssrc)
+            if st is None:
+                st = self._tx_sr[ssrc] = [0, 0, 0, 0.0]
+            st[0] += int(self._txsr_pkts[rr, ss, tt])
+            st[1] += int(self._txsr_oct[rr, ss, tt])
+            st[2] = int(self._txsr_ts[rr, ss, tt])
+            st[3] = float(self._txsr_ms[rr, ss, tt])
+        self._txsr_pkts[:] = 0
+        self._txsr_oct[:] = 0
 
     def send_egress(self, packets, rtx: bool = False) -> None:
         """Rewrite + send a tick's EgressPackets: assemble all datagrams in

@@ -357,18 +357,21 @@ class RoomManager:
 
     # -- tick fan-out -----------------------------------------------------
     def _dispatch_tick(self, res: TickResult) -> None:
-        udp_subs = self.udp.sub_addrs if self.udp is not None else {}
         if self.udp is not None:
-            self.udp.send_egress(res.egress)
+            # Batch wire path: one native call assembles/seals/sends every
+            # UDP-destined entry; only WS-destined entries materialize as
+            # Python packet objects.
+            handled = self.udp.send_egress_batch(res.egress_batch)
             if res.replays:
                 self.udp.send_egress(res.replays, rtx=True)  # NACK retransmits
             if res.padding:
                 # BWE probe padding (UDP subscribers only — padding is a
                 # channel measurement, meaningless over the WS loopback).
                 self.udp.send_egress(res.padding, rtx=True)
-        for pkt in res.egress:
-            if (pkt.room, pkt.sub) in udp_subs:
-                continue  # delivered over UDP; don't double-send on WS
+            ws_pkts = res.egress_batch.to_packets(~handled) if len(handled) else []
+        else:
+            ws_pkts = res.egress
+        for pkt in ws_pkts:
             room = self._row_to_room.get(pkt.room)
             if room is not None:
                 room.deliver_egress(pkt)

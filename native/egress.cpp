@@ -1,0 +1,280 @@
+// Native batch egress — datagram assembly, AEAD sealing, and kernel send.
+//
+// Reference parity: the per-packet egress work the reference does per
+// DownTrack in Go — header construction + payload write
+// (pkg/sfu/downtrack.go:680 WriteRTP), VP8 descriptor munge application
+// (pkg/sfu/codecmunger/vp8.go:161), SRTP protection (pion/srtp under
+// pkg/rtc/transport.go), and the socket write behind the pacer
+// (pkg/sfu/pacer) — executed as ONE native call per tick over the device
+// plane's compacted egress arrays:
+//
+//   for each entry: 12-byte RTP header (SN/TS/SSRC/PT/M) + payload gather
+//   from the ingest slab + in-place VP8 descriptor patch; optionally an
+//   AES-128-GCM seal (frame layout must match runtime/crypto.py:
+//   0x01 | key_id(4 BE) | dir(1)=S2C | counter(8 BE) | ct || tag,
+//   nonce = dir | counter | 0^3, AAD = the 14-byte header); then
+//   sendmmsg() in chunks, fanned over a few threads (seal + syscall both
+//   parallelize; entries are pre-partitioned so threads never share
+//   output ranges).
+//
+// AES-GCM uses OpenSSL's stable EVP C ABI. This image ships
+// libcrypto.so.3 but not the headers, so the handful of prototypes used
+// are declared here directly.
+//
+// Build: g++ -O2 -shared -fPIC -pthread -o libegress.so egress.cpp -l:libcrypto.so.3
+// ABI: plain C, loaded via ctypes (no pybind11 in this image).
+
+#include <cstdint>
+#include <cstring>
+#include <thread>
+#include <vector>
+
+#include <errno.h>
+#include <netinet/in.h>
+#include <sys/socket.h>
+#include <unistd.h>
+
+// ---- OpenSSL EVP prototypes (libcrypto.so.3; EVP ABI is stable) -----------
+extern "C" {
+typedef struct evp_cipher_ctx_st EVP_CIPHER_CTX;
+typedef struct evp_cipher_st EVP_CIPHER;
+typedef struct engine_st ENGINE;
+EVP_CIPHER_CTX* EVP_CIPHER_CTX_new(void);
+void EVP_CIPHER_CTX_free(EVP_CIPHER_CTX*);
+const EVP_CIPHER* EVP_aes_128_gcm(void);
+int EVP_EncryptInit_ex(EVP_CIPHER_CTX*, const EVP_CIPHER*, ENGINE*,
+                       const unsigned char*, const unsigned char*);
+int EVP_EncryptUpdate(EVP_CIPHER_CTX*, unsigned char*, int*,
+                      const unsigned char*, int);
+int EVP_EncryptFinal_ex(EVP_CIPHER_CTX*, unsigned char*, int*);
+int EVP_CIPHER_CTX_ctrl(EVP_CIPHER_CTX*, int, int, void*);
+}
+#define EVP_CTRL_GCM_GET_TAG 0x10
+
+namespace {
+
+constexpr int SEAL_HEADER = 14;  // magic + key_id(4) + dir(1) + counter(8)
+constexpr int SEAL_TAG = 16;
+constexpr uint8_t SEAL_MAGIC = 0x01;
+constexpr uint8_t DIR_S2C = 1;
+constexpr int MAX_DGRAM = 2048;
+constexpr int MMSG_CHUNK = 512;
+
+struct Args {
+  uint8_t* skip;  // [n] — entries the builder refused (oversized sealed)
+  const uint8_t* slab;
+  const int64_t* pay_off;
+  const int32_t* pay_len;
+  const uint8_t* marker;
+  const uint8_t* pt;
+  const uint8_t* vp8;
+  const uint16_t* sn;
+  const uint32_t* ts;
+  const uint32_t* ssrc;
+  const int32_t* pid;
+  const int32_t* tl0;
+  const int32_t* kidx;
+  const uint32_t* ip;    // host byte order
+  const uint16_t* port;  // host byte order
+  const uint8_t* seal;
+  const int32_t* key_idx;
+  const uint8_t* keys;      // [nkeys][16]
+  const uint32_t* key_ids;  // [nkeys]
+  const uint64_t* counters;
+  uint8_t* out;
+  const int64_t* out_off;
+  const int32_t* out_len;
+  int fd;
+};
+
+void be16(uint8_t* p, uint16_t v) { p[0] = v >> 8; p[1] = v & 0xFF; }
+void be32(uint8_t* p, uint32_t v) {
+  p[0] = v >> 24; p[1] = (v >> 16) & 0xFF; p[2] = (v >> 8) & 0xFF; p[3] = v & 0xFF;
+}
+void be64(uint8_t* p, uint64_t v) {
+  for (int i = 0; i < 8; i++) p[i] = (v >> (56 - 8 * i)) & 0xFF;
+}
+
+// VP8 payload-descriptor patch on an assembled payload (same semantics as
+// rewrite_rtp_vp8_batch in rtp_parser.cpp, but the payload location is
+// already known). Field widths preserved; negative values skip a field.
+void patch_vp8(uint8_t* d, int dl, int32_t pid, int32_t tl0, int32_t kidx) {
+  if (dl < 1) return;
+  int q = 0;
+  uint8_t b0 = d[q++];
+  if (!(b0 & 0x80)) return;  // no X ⇒ no pid/tl0/keyidx fields
+  if (q >= dl) return;
+  uint8_t xb = d[q++];
+  bool I = xb & 0x80, L = xb & 0x40, T = xb & 0x20, K = xb & 0x10;
+  if (I) {
+    if (q >= dl) return;
+    if (d[q] & 0x80) {  // 15-bit picture id
+      if (q + 1 >= dl) return;
+      if (pid >= 0) {
+        d[q] = 0x80 | ((pid >> 8) & 0x7F);
+        d[q + 1] = pid & 0xFF;
+      }
+      q += 2;
+    } else {
+      if (pid >= 0) d[q] = pid & 0x7F;
+      q += 1;
+    }
+  }
+  if (L) {
+    if (q >= dl) return;
+    if (tl0 >= 0) d[q] = tl0 & 0xFF;
+    q += 1;
+  }
+  if (T || K) {
+    if (q >= dl) return;
+    if (kidx >= 0) d[q] = (d[q] & 0xE0) | (kidx & 0x1F);
+    q += 1;
+  }
+}
+
+// Build entries [lo, hi) into the shared out buffer (disjoint ranges) and
+// send them. Returns datagrams handed to the kernel.
+int64_t worker(const Args& a, int lo, int hi) {
+  EVP_CIPHER_CTX* ctx = EVP_CIPHER_CTX_new();
+  const EVP_CIPHER* cipher = EVP_aes_128_gcm();
+  bool ctx_inited = false;
+  uint8_t scratch[MAX_DGRAM];
+
+  for (int i = lo; i < hi; i++) {
+    uint8_t* dst = a.out + a.out_off[i];
+    int plen = a.pay_len[i];
+    int clear_len = 12 + plen;
+    bool sealed = a.seal[i] && a.key_idx[i] >= 0;
+    if (plen < 0 || (sealed && clear_len > MAX_DGRAM)) {
+      // The sealed path stages cleartext in a fixed stack scratch; an
+      // attacker-sized jumbo datagram must be refused, never overflowed.
+      a.skip[i] = 1;
+      continue;
+    }
+    uint8_t* build = sealed ? scratch : dst;
+    build[0] = 0x80;
+    build[1] = (a.marker[i] ? 0x80 : 0) | (a.pt[i] & 0x7F);
+    be16(build + 2, a.sn[i]);
+    be32(build + 4, a.ts[i]);
+    be32(build + 8, a.ssrc[i]);
+    std::memcpy(build + 12, a.slab + a.pay_off[i], plen);
+    if (a.vp8[i]) patch_vp8(build + 12, plen, a.pid[i], a.tl0[i], a.kidx[i]);
+
+    if (sealed) {
+      const uint8_t* key = a.keys + 16 * a.key_idx[i];
+      uint8_t* h = dst;
+      h[0] = SEAL_MAGIC;
+      be32(h + 1, a.key_ids[a.key_idx[i]]);
+      h[5] = DIR_S2C;
+      be64(h + 6, a.counters[i]);
+      uint8_t nonce[12];
+      nonce[0] = DIR_S2C;
+      std::memcpy(nonce + 1, h + 6, 8);
+      std::memset(nonce + 9, 0, 3);
+      int outl = 0, fl = 0;
+      // First init binds the cipher; later inits reuse it (key/IV only).
+      EVP_EncryptInit_ex(ctx, ctx_inited ? nullptr : cipher, nullptr, key, nonce);
+      ctx_inited = true;
+      EVP_EncryptUpdate(ctx, nullptr, &outl, h, SEAL_HEADER);  // AAD
+      EVP_EncryptUpdate(ctx, dst + SEAL_HEADER, &outl, build, clear_len);
+      EVP_EncryptFinal_ex(ctx, dst + SEAL_HEADER + outl, &fl);
+      EVP_CIPHER_CTX_ctrl(ctx, EVP_CTRL_GCM_GET_TAG, SEAL_TAG,
+                          dst + SEAL_HEADER + clear_len);
+    }
+  }
+  EVP_CIPHER_CTX_free(ctx);
+
+  int64_t sent = 0;
+  if (a.fd >= 0) {
+    mmsghdr msgs[MMSG_CHUNK];
+    iovec iovs[MMSG_CHUNK];
+    sockaddr_in sas[MMSG_CHUNK];
+    int i = lo;
+    while (i < hi) {
+      int cnt = 0;
+      while (i < hi && a.skip[i]) i++;
+      for (; cnt < MMSG_CHUNK && i + cnt < hi && !a.skip[i + cnt]; cnt++) {
+        int j = i + cnt;
+        std::memset(&sas[cnt], 0, sizeof(sockaddr_in));
+        sas[cnt].sin_family = AF_INET;
+        sas[cnt].sin_addr.s_addr = htonl(a.ip[j]);
+        sas[cnt].sin_port = htons(a.port[j]);
+        iovs[cnt].iov_base = a.out + a.out_off[j];
+        iovs[cnt].iov_len = (size_t)a.out_len[j];
+        std::memset(&msgs[cnt].msg_hdr, 0, sizeof(msghdr));
+        msgs[cnt].msg_hdr.msg_name = &sas[cnt];
+        msgs[cnt].msg_hdr.msg_namelen = sizeof(sockaddr_in);
+        msgs[cnt].msg_hdr.msg_iov = &iovs[cnt];
+        msgs[cnt].msg_hdr.msg_iovlen = 1;
+      }
+      int done = 0;
+      int spins = 0;
+      while (done < cnt) {
+        int r = sendmmsg(a.fd, msgs + done, cnt - done, 0);
+        if (r > 0) {
+          done += r;
+          sent += r;
+          continue;
+        }
+        if ((errno == EAGAIN || errno == EWOULDBLOCK || errno == ENOBUFS) &&
+            spins < 64) {
+          spins++;
+          usleep(50);  // socket buffer full: brief backoff, then drop rest
+          continue;
+        }
+        break;  // hard error (or spun out): drop the remainder of the chunk
+      }
+      i += cnt;
+    }
+  }
+  return sent;
+}
+
+}  // namespace
+
+extern "C" {
+
+// Assemble (and seal, and send when fd >= 0) one tick's egress datagrams.
+// All arrays have n entries; out/out_off/out_len are caller-allocated with
+// per-entry destination ranges (disjoint). Returns datagrams sent, or n
+// when fd < 0 (build-only mode, used by tests).
+int64_t egress_batch_send(
+    int fd, int n_threads, const uint8_t* slab, int32_t n,
+    const int64_t* pay_off, const int32_t* pay_len, const uint8_t* marker,
+    const uint8_t* pt, const uint8_t* vp8, const uint16_t* sn,
+    const uint32_t* ts, const uint32_t* ssrc, const int32_t* pid,
+    const int32_t* tl0, const int32_t* kidx, const uint32_t* ip,
+    const uint16_t* port, const uint8_t* seal, const int32_t* key_idx,
+    const uint8_t* keys, const uint32_t* key_ids, const uint64_t* counters,
+    uint8_t* out, const int64_t* out_off, const int32_t* out_len) {
+  if (n <= 0) return 0;
+  std::vector<uint8_t> skip(n, 0);
+  Args a{skip.data(), slab, pay_off, pay_len, marker, pt,   vp8,     sn,  ts,
+         ssrc,  pid,     tl0,     kidx,   ip,       port,    seal, key_idx,
+         keys,  key_ids, counters, out,   out_off,  out_len, fd};
+  if (n_threads < 1) n_threads = 1;
+  if (n_threads > 8) n_threads = 8;
+  if (n < 2 * n_threads) n_threads = 1;
+
+  int64_t total = 0;
+  if (n_threads == 1) {
+    total = worker(a, 0, n);
+  } else {
+    std::vector<int64_t> sent(n_threads, 0);
+    std::vector<std::thread> th;
+    int per = (n + n_threads - 1) / n_threads;
+    for (int w = 0; w < n_threads; w++) {
+      int lo = w * per, hi = lo + per < n ? lo + per : n;
+      if (lo >= hi) break;
+      th.emplace_back([&a, &sent, w, lo, hi] { sent[w] = worker(a, lo, hi); });
+    }
+    for (auto& t : th) t.join();
+    for (int64_t s : sent) total += s;
+  }
+  if (fd >= 0) return total;
+  int64_t built = 0;
+  for (int i = 0; i < n; i++) built += skip[i] ? 0 : 1;
+  return built;
+}
+
+}  // extern "C"
