@@ -40,6 +40,7 @@ from livekit_server_tpu.ops import (
     audio,
     bwe,
     quality,
+    red,
     rtpmunger,
     rtpstats,
     selector,
@@ -103,6 +104,7 @@ class PlaneState(NamedTuple):
     bwe_state: bwe.BWEState              # [R, S]
     tracker: streamtracker.TrackerState  # [R, T*L] per (track, layer) stream
     seq: sequencer.SequencerState        # [R, S, RING] — NACK replay rings
+    red_state: red.REDState              # [R, T, D] — RED history rings
     temporal_bytes: jax.Array            # [R, T, L, MAX_TEMPORAL] float32 —
                                          # per-temporal byte/tick EMA (the
                                          # measured Bitrates attribution)
@@ -204,6 +206,13 @@ class TickOutputs(NamedTuple):
     deficient: jax.Array       # [R, S] bool — allocation under-served this
                                # sub (probe trigger; streamallocator
                                # "deficient" state)
+    # RED encapsulation plan for audio packets (redreceiver.go): per
+    # packet, the D candidate redundancy blocks by source SN, their 14-bit
+    # TS offsets, and RFC 2198 fit. Host egress assembles bytes for
+    # RED-negotiated subscribers from its payload ring.
+    red_sn: jax.Array          # [R, T, K, D] int32
+    red_off: jax.Array         # [R, T, K, D] int32
+    red_ok: jax.Array          # [R, T, K, D] bool
 
 
 def init_state(dims: PlaneDims) -> PlaneState:
@@ -236,6 +245,7 @@ def init_state(dims: PlaneDims) -> PlaneState:
         bwe_state=jax.tree.map(lambda x: tile(x, R), bwe.init_state(S)),
         tracker=jax.tree.map(lambda x: tile(x, R), streamtracker.init_state(T * L)),
         seq=jax.tree.map(lambda x: tile(x, R), sequencer.init_state(S)),
+        red_state=jax.tree.map(lambda x: tile(x, R), red.init_state(T)),
         temporal_bytes=jnp.zeros((R, T, L, MAX_TEMPORAL), jnp.float32),
     )
 
@@ -246,6 +256,7 @@ def _room_tick(
     audio_params: audio.AudioLevelParams,
     bwe_params: bwe.BWEParams,
     egress_cap: int,
+    red_enabled: bool = True,
 ):
     """Tick for ONE room; every field has its leading R axis stripped."""
     T, K = inp.sn.shape
@@ -468,6 +479,22 @@ def _room_tick(
         snap_expected=jnp.where(roll, expected, stats.snap_expected),
     )
 
+    # ---- RED encapsulation plan (redreceiver.go) -----------------------
+    # Audio-only: which previous packets can ride as RFC 2198 redundancy
+    # blocks on each primary; the host assembles bytes per RED subscriber.
+    # Statically gated: with audio/red not in the enabled codecs, the plan
+    # tensors are zero-K so the per-tick device→host transfer pays nothing.
+    if red_enabled:
+        red_state, red_sn, red_off, _red_len, red_ok = red.encode_plan_tick(
+            state.red_state, inp.sn, inp.ts, inp.size,
+            inp.valid & ~state.meta.is_video[:, None],
+        )
+    else:
+        red_state = state.red_state
+        red_sn = jnp.zeros((T, 0, red.RED_DISTANCE), jnp.int32)
+        red_off = jnp.zeros((T, 0, red.RED_DISTANCE), jnp.int32)
+        red_ok = jnp.zeros((T, 0, red.RED_DISTANCE), jnp.bool_)
+
     # ---- 7. audio levels + active speakers -----------------------------
     is_audio_pkt = inp.valid & ~state.meta.is_video[:, None]
     audio_state, linear, is_active = audio.observe_tick(
@@ -507,6 +534,7 @@ def _room_tick(
         bwe_state=bwe_state,
         tracker=tracker,
         seq=seq,
+        red_state=red_state,
         temporal_bytes=temporal_bytes,
     )
     # ---- device-side egress compaction ---------------------------------
@@ -553,6 +581,9 @@ def _room_tick(
         pad_valid=pad_valid,
         committed_bps=budget,
         deficient=any_deficient,
+        red_sn=red_sn.astype(jnp.int32),
+        red_off=red_off.astype(jnp.int32),
+        red_ok=red_ok,
     )
     return new_state, outputs
 
@@ -571,12 +602,13 @@ def media_plane_tick(
     audio_params: audio.AudioLevelParams = audio.AudioLevelParams(),
     bwe_params: bwe.BWEParams = bwe.BWEParams(),
     egress_cap: int | None = None,
+    red_enabled: bool = True,
 ):
     """One tick of the full media plane, vmapped over the room axis.
 
     jit this (donating `state`) and step it from the runtime loop;
-    `egress_cap` is static per compile. The [R] axis is the mesh-sharded
-    axis (see livekit_server_tpu.parallel.mesh).
+    `egress_cap` and `red_enabled` are static per compile. The [R] axis is
+    the mesh-sharded axis (see livekit_server_tpu.parallel.mesh).
     """
     if egress_cap is None:
         T, K, S = inp.sn.shape[1], inp.sn.shape[2], inp.estimate.shape[1]
@@ -584,7 +616,7 @@ def media_plane_tick(
 
     # Scalars (tick_ms) broadcast; everything else has a leading R axis.
     def tick_one(st, i):
-        return _room_tick(st, i, audio_params, bwe_params, egress_cap)
+        return _room_tick(st, i, audio_params, bwe_params, egress_cap, red_enabled)
 
     inp_axes = TickInputs(**{f: 0 for f in TickInputs._fields})._replace(
         tick_ms=None, roll_quality=None, slab_base=None, now_ms=None
@@ -680,7 +712,9 @@ def pack_tick_outputs(out: TickOutputs) -> jax.Array:
     return jnp.concatenate([flat(getattr(out, f)) for f in TickOutputs._fields])
 
 
-def unpack_tick_outputs(buf, dims: PlaneDims, egress_cap: int) -> TickOutputs:
+def unpack_tick_outputs(
+    buf, dims: PlaneDims, egress_cap: int, red_enabled: bool = True
+) -> TickOutputs:
     """Host-side: flat int32 numpy buffer → TickOutputs of numpy arrays."""
     import numpy as np
 
@@ -712,10 +746,13 @@ def unpack_tick_outputs(buf, dims: PlaneDims, egress_cap: int) -> TickOutputs:
         "pad_valid": (R, S, PAD_MAX),
         "committed_bps": (R, S),
         "deficient": (R, S),
+        "red_sn": (R, T, K if red_enabled else 0, red.RED_DISTANCE),
+        "red_off": (R, T, K if red_enabled else 0, red.RED_DISTANCE),
+        "red_ok": (R, T, K if red_enabled else 0, red.RED_DISTANCE),
     }
     floats = {"speaker_levels", "track_mos", "track_loss_pct", "track_jitter_ms",
               "track_bps", "committed_bps"}
-    bools = {"need_keyframe", "congested", "pad_valid", "deficient"}
+    bools = {"need_keyframe", "congested", "pad_valid", "deficient", "red_ok"}
     buf = np.asarray(buf)
     pieces, off = {}, 0
     for name in TickOutputs._fields:

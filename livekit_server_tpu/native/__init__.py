@@ -317,9 +317,12 @@ class NativeEgress:
     def __init__(self, so: Path):
         self.lib = ctypes.CDLL(str(so))
         self.lib.egress_batch_send.restype = ctypes.c_int64
-        self.lib.egress_batch_send.argtypes = [ctypes.c_int, ctypes.c_int] + [
-            ctypes.c_void_p, ctypes.c_int32
-        ] + [ctypes.c_void_p] * 21
+        self.lib.egress_batch_send.argtypes = (
+            [ctypes.c_int, ctypes.c_int, ctypes.c_void_p, ctypes.c_int32]
+            + [ctypes.c_void_p] * 6      # pay_off..vp8, pd
+            + [ctypes.c_int]             # pd_ext_id
+            + [ctypes.c_void_p] * 16     # sn..out_len
+        )
         # Exercise the library once so a broken libcrypto link is caught at
         # load time (and the fallback engaged), not on the first media tick.
         self._selftest()
@@ -361,11 +364,15 @@ class NativeEgress:
 
     def send(self, fd, n_threads, slab, pay_off, pay_len, marker, pt, vp8,
              sn, ts, ssrc, pid, tl0, kidx, ip, port, seal, key_idx, keys,
-             key_ids, counters):
+             key_ids, counters, pd=None, pd_ext_id=6):
         """Returns (out, out_off, out_len, sent). With fd < 0 nothing hits
-        the network and `out` holds the built frames (tests / TCP path)."""
+        the network and `out` holds the built frames (tests / TCP path).
+        `pd` (optional uint32 per entry) adds a playout-delay header
+        extension: (min_10ms << 12) | max_10ms, 0 = none."""
         n = len(pay_off)
-        clear_len = 12 + pay_len.astype(np.int64)
+        if pd is None:
+            pd = np.zeros(n, np.uint32)
+        clear_len = 12 + (pd != 0) * 8 + pay_len.astype(np.int64)
         out_len = np.where(
             (seal != 0) & (key_idx >= 0), clear_len + self.SEAL_OVERHEAD, clear_len
         ).astype(np.int32)
@@ -380,7 +387,9 @@ class NativeEgress:
         sent = self.lib.egress_batch_send(
             int(fd), int(n_threads), slab_arr.ctypes.data, n,
             c(pay_off, np.int64), c(pay_len, np.int32), c(marker, np.uint8),
-            c(pt, np.uint8), c(vp8, np.uint8), c(sn, np.uint16),
+            c(pt, np.uint8), c(vp8, np.uint8),
+            c(pd, np.uint32), int(pd_ext_id),
+            c(sn, np.uint16),
             c(ts, np.uint32), c(ssrc, np.uint32), c(pid, np.int32),
             c(tl0, np.int32), c(kidx, np.int32), c(ip, np.uint32),
             c(port, np.uint16), c(seal, np.uint8), c(key_idx, np.int32),

@@ -81,6 +81,10 @@ def handle_participant_signal(room, participant: Participant, req: SignalRequest
                 rotate=bool(data.get("udp_repunch", False)),
             )
             participant.send("request_response", {"udp_punch": {"punch_id": punch}})
+        if udp is not None and participant.sub_col >= 0 and "red" in data:
+            # RED capability opt-in (RFC 2198 Opus redundancy; the
+            # reference negotiates RED in SDP — redreceiver.go).
+            udp.set_sub_red(room.slots.row, participant.sub_col, bool(data["red"]))
         for sid in data.get("track_sids", []):
             if data.get("subscribe", True):
                 room.subscribe(participant, sid)

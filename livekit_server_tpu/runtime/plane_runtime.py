@@ -124,6 +124,11 @@ class TickResult:
     layer_live: Any = None        # [R, T, L] int32
     track_loss_pct: Any = None    # [R, T] float32
     track_jitter_ms: Any = None   # [R, T] float32
+    # RED plan (ops/red): per-packet redundancy candidates for the host
+    # egress to assemble (redreceiver.go seat).
+    red_sn: Any = None            # [R, T, K, D] int32
+    red_off: Any = None           # [R, T, K, D] int32
+    red_ok: Any = None            # [R, T, K, D] bool
     track_bps: Any = None         # [R, T] float32
     quality_window_closed: bool = False  # this tick rolled the stats window
     _egress_cache: list[EgressPacket] | None = None
@@ -138,7 +143,7 @@ class TickResult:
 
 
 @functools.lru_cache(maxsize=None)
-def _build_step(audio_params, bwe_params, egress_cap):
+def _build_step(audio_params, bwe_params, egress_cap, red_enabled=True):
     """Packed-wire step: ONE input upload, ONE output fetch per tick
     (plane.pack_tick_inputs / pack_tick_outputs)."""
 
@@ -147,7 +152,8 @@ def _build_step(audio_params, bwe_params, egress_cap):
             pkt, fb, nk, tick_ms, roll_quality, slab_base, now_ms
         )
         state, out = plane.media_plane_tick(
-            state, inp, audio_params, bwe_params, egress_cap=egress_cap
+            state, inp, audio_params, bwe_params, egress_cap=egress_cap,
+            red_enabled=red_enabled,
         )
         return state, plane.pack_tick_outputs(out)
 
@@ -165,12 +171,14 @@ class PlaneRuntime:
         audio_params=None,
         bwe_params=None,
         egress_cap: int | None = None,
+        red_enabled: bool = True,
     ):
         from livekit_server_tpu.ops import audio as audio_ops, bwe as bwe_ops
 
         self.dims = dims
         self.tick_ms = tick_ms
         self.egress_cap = egress_cap or plane.default_egress_cap(dims)
+        self.red_enabled = red_enabled
         self.slots = SlotAllocator(dims.rooms, dims.tracks, dims.subs)
         self.ingest = IngestBuffer(dims, tick_ms)
         self.tick_index = 0
@@ -201,13 +209,14 @@ class PlaneRuntime:
 
             self.state = shard_tree(self.state, mesh)
             self._step = make_sharded_tick(
-                mesh, self._ap, self._bp, donate=True, egress_cap=self.egress_cap
+                mesh, self._ap, self._bp, donate=True, egress_cap=self.egress_cap,
+                red_enabled=red_enabled,
             )
         else:
             # Shared across PlaneRuntime instances with identical params so
             # repeated construction (tests, restarts) reuses the XLA
             # compilation cache instead of re-tracing a fresh closure.
-            self._step = _build_step(self._ap, self._bp, self.egress_cap)
+            self._step = _build_step(self._ap, self._bp, self.egress_cap, red_enabled)
 
         # Rolling payload history for NACK replay (sequencer slab keys
         # reference slot tick % SLAB_WINDOW; sequencer.lookup_nacks age-gates
@@ -288,7 +297,9 @@ class PlaneRuntime:
             return jax.tree.map(np.asarray, out)
         packed = plane.pack_tick_inputs(inp)
         self.state, buf = self._step(self.state, *packed)
-        return plane.unpack_tick_outputs(np.asarray(buf), self.dims, self.egress_cap)
+        return plane.unpack_tick_outputs(
+            np.asarray(buf), self.dims, self.egress_cap, self.red_enabled
+        )
 
     async def step_once(self) -> TickResult:
         """One tick; the device round trip runs in a worker thread so the
@@ -469,6 +480,9 @@ class PlaneRuntime:
             track_loss_pct=out.track_loss_pct,
             track_jitter_ms=out.track_jitter_ms,
             track_bps=out.track_bps,
+            red_sn=out.red_sn,
+            red_off=out.red_off,
+            red_ok=out.red_ok,
         )
 
     # -- loop ------------------------------------------------------------

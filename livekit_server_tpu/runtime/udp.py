@@ -33,7 +33,9 @@ from livekit_server_tpu.runtime.ingest import IngestBuffer
 
 VP8_PT = 96
 OPUS_PT = 111
+RED_PT = 63           # RFC 2198 redundancy for Opus (redreceiver.go seat)
 AUDIO_LEVEL_EXT_ID = 1
+PLAYOUT_DELAY_EXT_ID = 6  # one-byte ext id for playout-delay (playoutdelay.go)
 
 # Subscriber address punch: a client proves it owns the address it wants
 # media sent to by sending this magic + its 32-bit punch id from that
@@ -52,6 +54,26 @@ PLI_THROTTLE_MS = 500.0  # min spacing of upstream keyframe requests per
 # Probe padding payload: a maximal RTP pad run — 254 zeros + the count
 # byte (255) that RFC 3550 §5.1 puts last when the P bit is set.
 PAD_RUN = bytes(254) + b"\xff"
+
+
+def _red_primary(blob: bytes, start: int, length: int) -> tuple[int, int]:
+    """RFC 2198 walk: (absolute offset, length) of the primary block's
+    payload, or (-1, -1) if malformed (redprimaryreceiver.go decap)."""
+    end = start + length
+    q = start
+    blocks = 0
+    while q < end and blob[q] & 0x80:
+        if q + 4 > end:
+            return -1, -1
+        blocks += ((blob[q + 2] & 0x03) << 8) | blob[q + 3]
+        q += 4
+    if q >= end:
+        return -1, -1
+    q += 1  # primary's 1-byte header (F=0 | PT)
+    data_off = q + blocks
+    if data_off > end:
+        return -1, -1
+    return data_off, end - data_off
 
 
 def build_nack(sender_ssrc: int, media_ssrc: int, sns) -> bytes:
@@ -242,6 +264,14 @@ class UDPMediaTransport(asyncio.DatagramProtocol):
         self._txsr_ts = np.zeros((R, S, T), np.uint32)
         self._txsr_ms = np.zeros((R, S, T), np.float64)
         self.egress_threads = 4
+        # RED (RFC 2198) opt-in per subscriber + per-(room, audio track)
+        # ring of recent primary payloads (the byte half of the device's
+        # encode plan; redreceiver.go).
+        self.sub_red: set[tuple] = set()
+        self._red_ring: dict[tuple, object] = {}
+        # Playout-delay header extension on video egress
+        # (rtpextension/playoutdelay.go): (min_ms, max_ms) or None.
+        self.playout_delay: tuple[int, int] | None = None
         self.stats = {
             "rx": 0, "tx": 0, "unknown_ssrc": 0, "parse_errors": 0,
             "addr_mismatch": 0, "bad_punch": 0,
@@ -329,6 +359,15 @@ class UDPMediaTransport(asyncio.DatagramProtocol):
         """Record media kind for egress PT selection (any transport)."""
         self.track_kind[(room, track)] = is_video
 
+    def set_sub_red(self, room: int, sub: int, enabled: bool) -> None:
+        """Subscriber negotiated RED audio (subscription signal field):
+        audio egress to it is RFC 2198-encapsulated with the device plan's
+        redundancy blocks (redreceiver.go; toggled per capability)."""
+        if enabled:
+            self.sub_red.add((room, sub))
+        else:
+            self.sub_red.discard((room, sub))
+
     def register_subscriber(self, room: int, sub: int, addr: tuple) -> None:
         """Trusted-caller egress registration (tests / in-process tooling).
         The signal plane must NOT call this with a client-supplied address —
@@ -377,6 +416,7 @@ class UDPMediaTransport(asyncio.DatagramProtocol):
         self._egress_ssrc_arr[room, sub, :] = 0
         self._txsr_pkts[room, sub, :] = 0
         self._txsr_oct[room, sub, :] = 0
+        self.sub_red.discard((room, sub))
         pid = self._punch_by_sub.pop((room, sub), None)
         if pid is not None:
             self.punch_ids.pop(pid, None)
@@ -402,6 +442,9 @@ class UDPMediaTransport(asyncio.DatagramProtocol):
         self._track_is_video[room] = False
         self._txsr_pkts[room] = 0
         self._txsr_oct[room] = 0
+        self.sub_red = {k for k in self.sub_red if k[0] != room}
+        for key in [k for k in self._red_ring if k[0] == room]:
+            del self._red_ring[key]
         for key in [k for k in self._ts_delta if k[0] == room]:
             del self._ts_delta[key]
         for key in [k for k in self.sub_sessions if k[0] == room]:
@@ -717,6 +760,21 @@ class UDPMediaTransport(asyncio.DatagramProtocol):
             audio_level_ext=AUDIO_LEVEL_EXT_ID, vp8_pts={VP8_PT},
         )
 
+        # RED-publishing clients (pt 63): strip to the primary block before
+        # staging (redprimaryreceiver.go; redundancy recovery rides NACK).
+        if (parsed["pt"] == RED_PT).any():
+            for i in np.nonzero(
+                (parsed["payload_len"] > 0) & (parsed["pt"] == RED_PT)
+            )[0]:
+                st = int(offsets[i]) + int(parsed["payload_off"][i])
+                po2, pl2 = _red_primary(blob, st, int(parsed["payload_len"][i]))
+                if pl2 < 0:
+                    parsed["payload_len"][i] = -1
+                    continue
+                parsed["payload_off"][i] = po2 - int(offsets[i])
+                parsed["payload_len"][i] = pl2
+                self.stats["red_rx"] = self.stats.get("red_rx", 0) + 1
+
         plen = parsed["payload_len"].astype(np.int64)
         ok = plen >= 0
         self.stats["parse_errors"] += int((~ok).sum())
@@ -787,6 +845,26 @@ class UDPMediaTransport(asyncio.DatagramProtocol):
         sn_arr = parsed["sn"]
         for i in np.nonzero(final & u_video[inv])[0]:
             self._track_upstream_loss(int(ssrcs[i]), int(sn_arr[i]), now_ms)
+
+        if self.sub_red:
+            # Primary-payload ring per audio track — the bytes the RED
+            # egress plan references by source SN.
+            from collections import deque
+
+            for i in np.nonzero(final & ~u_video[inv])[0]:
+                key = (int(u_room[inv[i]]), int(u_track[inv[i]]))
+                ring = self._red_ring.get(key)
+                if ring is None:
+                    from livekit_server_tpu.ops.red import RED_DISTANCE
+
+                    # Depth: the plan references packets up to D behind the
+                    # CURRENT tick's packets, which also enter this ring —
+                    # a flush can stage up to K packets, so keep D + K.
+                    ring = self._red_ring[key] = deque(
+                        maxlen=RED_DISTANCE + self.ingest.dims.pkts
+                    )
+                st = int(offsets[i]) + int(parsed["payload_off"][i])
+                ring.appendleft((int(sn_arr[i]), blob[st : st + int(plen[i])]))
 
         idx = np.nonzero(final)[0]
         if len(idx):
@@ -860,7 +938,7 @@ class UDPMediaTransport(asyncio.DatagramProtocol):
             mids.append(mid)
             del mids[:-4]
 
-    def send_egress_batch(self, batch) -> np.ndarray:
+    def send_egress_batch(self, batch, red_plan=None) -> np.ndarray:
         """Vectorized tick egress (the hot half of DownTrack.WriteRTP +
         pion/srtp + pacer socket writes): per-entry field arrays are
         assembled with numpy index math and handed to ONE native call that
@@ -885,9 +963,12 @@ class UDPMediaTransport(asyncio.DatagramProtocol):
         u_port = np.zeros(len(uniq), np.uint16)
         u_tcp = np.zeros(len(uniq), bool)
         u_sess = np.full(len(uniq), -1, np.int32)
+        u_red = np.zeros(len(uniq), bool)
         sessions: list = []
         for j, q in enumerate(uniq):
             rr, ss = divmod(int(q), S)
+            if (rr, ss) in self.sub_red:
+                u_red[j] = True
             sess = self.sub_sessions.get((rr, ss))
             if sess is not None:
                 u_sess[j] = len(sessions)
@@ -912,7 +993,18 @@ class UDPMediaTransport(asyncio.DatagramProtocol):
 
         po = batch.payloads.off[r, t, k]
         pl = batch.payloads.length[r, t, k]
-        idx = np.nonzero((e_port != 0) & (po >= 0))[0]
+        # RED-negotiated audio entries leave the fast path: their payloads
+        # are re-encapsulated per RFC 2198 from the device's plan.
+        now_ms = asyncio.get_event_loop().time() * 1000.0
+        red_mask = np.zeros(n, bool)
+        if self.sub_red and red_plan is not None and red_plan[0].size:
+            red_mask = (
+                u_red[inv] & (e_port != 0) & (po >= 0)
+                & ~self._track_is_video[r, t]
+            )
+            if red_mask.any():
+                self._send_red(batch, red_plan, red_mask, po, pl, now_ms)
+        idx = np.nonzero((e_port != 0) & (po >= 0) & ~red_mask)[0]
         if len(idx):
             rr_, tt_, ss_ = r[idx], t[idx], s[idx]
             ssrc = self._egress_ssrc_arr[rr_, ss_, tt_].copy()
@@ -956,6 +1048,16 @@ class UDPMediaTransport(asyncio.DatagramProtocol):
                 np.array([x.key_id for x in sessions], np.uint32)
                 if sessions else np.zeros(1, np.uint32)
             )
+            pd = None
+            if self.playout_delay is not None:
+                mn, mx = self.playout_delay
+                # Clamp to the extension's 12-bit fields (playoutdelay.go).
+                val = np.uint32(
+                    (min(mn // 10, 4095) << 12) | min(mx // 10, 4095)
+                )
+                pd = np.where(self._track_is_video[rr_, tt_], val, 0).astype(
+                    np.uint32
+                )
             fd = self.transport.get_extra_info("socket").fileno()
             _, _, _, sent = native_egress.send(
                 fd=fd, n_threads=self.egress_threads,
@@ -971,6 +1073,7 @@ class UDPMediaTransport(asyncio.DatagramProtocol):
                 ip=u_ip[inv][idx], port=e_port[idx],
                 seal=seal.astype(np.uint8), key_idx=key_idx,
                 keys=keys, key_ids=key_ids, counters=ctr,
+                pd=pd, pd_ext_id=PLAYOUT_DELAY_EXT_ID,
             )
             self.stats["tx"] += sent
             if sent < len(idx):
@@ -994,13 +1097,65 @@ class UDPMediaTransport(asyncio.DatagramProtocol):
             self._txsr_ts[rr_, ss_, tt_] = (
                 batch.ts[idx].astype(np.int64) & 0xFFFFFFFF
             ).astype(np.uint32)
-            now_ms = asyncio.get_event_loop().time() * 1000.0
             self._txsr_ms[rr_, ss_, tt_] = now_ms
-            self._send_srs(now_ms)
         if (e_tcp & (po >= 0)).any():
             # TCP-fallback subscribers: cold path, per-frame sealing.
             self.send_egress(batch.to_packets(e_tcp & (po >= 0)))
+        self._send_srs(now_ms)
         return has_dest
+
+    def _send_red(self, batch, red_plan, red_mask, po, pl, now_ms) -> None:
+        """RFC 2198 encapsulation for RED subscribers (redreceiver.go):
+        primary payload + up to D redundancy blocks chosen by the device
+        plan, bytes from the per-track primary ring. Cold-ish path — runs
+        only for opted-in subscribers' audio packets."""
+        red_sn, red_off, red_ok = red_plan
+        data = batch.payloads.data
+        r, t, k, s = batch.rooms, batch.tracks, batch.ks, batch.subs
+        mk = batch.payloads.marker
+        D = red_sn.shape[-1]
+        rings: dict[tuple, dict] = {}
+        for i in np.nonzero(red_mask)[0]:
+            rr, tt, kk, ss = int(r[i]), int(t[i]), int(k[i]), int(s[i])
+            addr = self.sub_addrs.get((rr, ss))
+            if addr is None:
+                continue
+            prim = data[int(po[i]) : int(po[i]) + int(pl[i])]
+            ring = rings.get((rr, tt))
+            if ring is None:
+                ring = rings[(rr, tt)] = dict(self._red_ring.get((rr, tt), ()))
+            blocks = []
+            for d in range(D - 1, -1, -1):  # oldest first (RFC 2198 order)
+                if not red_ok[rr, tt, kk, d]:
+                    continue
+                pay = ring.get(int(red_sn[rr, tt, kk, d]) & 0xFFFF)
+                if pay is not None and len(pay) <= 1023:
+                    blocks.append((int(red_off[rr, tt, kk, d]), pay))
+            payload = bytearray()
+            for off_, pay in blocks:
+                payload += bytes([
+                    0x80 | OPUS_PT, (off_ >> 6) & 0xFF,
+                    ((off_ & 0x3F) << 2) | (len(pay) >> 8), len(pay) & 0xFF,
+                ])
+            payload.append(OPUS_PT)
+            for _, pay in blocks:
+                payload += pay
+            payload += prim
+            hdr = bytearray(12)
+            hdr[0] = 0x80
+            hdr[1] = (0x80 if mk[rr, tt, kk] else 0) | RED_PT
+            hdr[2:4] = (int(batch.sn[i]) & 0xFFFF).to_bytes(2, "big")
+            hdr[4:8] = (int(batch.ts[i]) & 0xFFFFFFFF).to_bytes(4, "big")
+            ssrc = self.subscriber_ssrc(rr, ss, tt)
+            hdr[8:12] = ssrc.to_bytes(4, "big")
+            self._sendto(bytes(hdr + payload), addr, self.sub_sessions.get((rr, ss)))
+            self.stats["tx"] += 1
+            self.stats["red_tx"] = self.stats.get("red_tx", 0) + 1
+            # SR bookkeeping (same accumulators the fast path feeds).
+            self._txsr_pkts[rr, ss, tt] += 1
+            self._txsr_oct[rr, ss, tt] += len(payload)
+            self._txsr_ts[rr, ss, tt] = int(batch.ts[i]) & 0xFFFFFFFF
+            self._txsr_ms[rr, ss, tt] = now_ms
 
     def _fold_txsr(self) -> None:
         """Merge batch-path SR accumulators into the per-SSRC table (runs

@@ -53,6 +53,7 @@ class RoomManager:
             plane.PlaneDims(p.rooms, p.tracks_per_room, p.pkts_per_track, p.subs_per_room),
             tick_ms=p.tick_ms,
             mesh=mesh,
+            red_enabled="audio/red" in config.room.enabled_codecs,
             audio_params=audio_ops.AudioLevelParams(
                 active_level=config.audio.active_level,
                 min_percentile=config.audio.min_percentile,
@@ -361,7 +362,9 @@ class RoomManager:
             # Batch wire path: one native call assembles/seals/sends every
             # UDP-destined entry; only WS-destined entries materialize as
             # Python packet objects.
-            handled = self.udp.send_egress_batch(res.egress_batch)
+            handled = self.udp.send_egress_batch(
+                res.egress_batch, red_plan=(res.red_sn, res.red_off, res.red_ok)
+            )
             if res.replays:
                 self.udp.send_egress(res.replays, rtx=True)  # NACK retransmits
             if res.padding:

@@ -159,6 +159,13 @@ class LivekitServer:
                 )
                 # Client PLIs over RTCP reach signal-plane publishers too.
                 self.room_manager.udp.on_pli = self.room_manager.handle_pli
+                if self.config.room.playout_delay_max_ms > 0:
+                    # Video egress carries the playout-delay extension
+                    # (rtpextension/playoutdelay.go; config room section).
+                    self.room_manager.udp.playout_delay = (
+                        self.config.room.playout_delay_min_ms,
+                        self.config.room.playout_delay_max_ms,
+                    )
                 for room in self.room_manager.rooms.values():
                     room.udp = self.room_manager.udp
                 # TCP media fallback (transportmanager.go:73 ladder): same

@@ -68,6 +68,10 @@ struct Args {
   const uint8_t* marker;
   const uint8_t* pt;
   const uint8_t* vp8;
+  // Playout-delay header extension (rtpextension/playoutdelay.go):
+  // per-entry packed (min_10ms << 12) | max_10ms; 0 = no extension.
+  const uint32_t* pd;
+  int pd_ext_id;
   const uint16_t* sn;
   const uint32_t* ts;
   const uint32_t* ssrc;
@@ -143,7 +147,9 @@ int64_t worker(const Args& a, int lo, int hi) {
   for (int i = lo; i < hi; i++) {
     uint8_t* dst = a.out + a.out_off[i];
     int plen = a.pay_len[i];
-    int clear_len = 12 + plen;
+    int ext_len = a.pd[i] ? 8 : 0;  // BEDE header (4) + one-byte ext (4)
+    int hdr_len = 12 + ext_len;
+    int clear_len = hdr_len + plen;
     bool sealed = a.seal[i] && a.key_idx[i] >= 0;
     if (plen < 0 || (sealed && clear_len > MAX_DGRAM)) {
       // The sealed path stages cleartext in a fixed stack scratch; an
@@ -152,13 +158,21 @@ int64_t worker(const Args& a, int lo, int hi) {
       continue;
     }
     uint8_t* build = sealed ? scratch : dst;
-    build[0] = 0x80;
+    build[0] = 0x80 | (ext_len ? 0x10 : 0);
     build[1] = (a.marker[i] ? 0x80 : 0) | (a.pt[i] & 0x7F);
     be16(build + 2, a.sn[i]);
     be32(build + 4, a.ts[i]);
     be32(build + 8, a.ssrc[i]);
-    std::memcpy(build + 12, a.slab + a.pay_off[i], plen);
-    if (a.vp8[i]) patch_vp8(build + 12, plen, a.pid[i], a.tl0[i], a.kidx[i]);
+    if (ext_len) {
+      // RFC 8285 one-byte extension carrying the 24-bit playout delay.
+      build[12] = 0xBE; build[13] = 0xDE; build[14] = 0; build[15] = 1;
+      build[16] = (uint8_t)((a.pd_ext_id << 4) | 2);  // len-1 = 2 → 3 bytes
+      build[17] = (a.pd[i] >> 16) & 0xFF;
+      build[18] = (a.pd[i] >> 8) & 0xFF;
+      build[19] = a.pd[i] & 0xFF;
+    }
+    std::memcpy(build + hdr_len, a.slab + a.pay_off[i], plen);
+    if (a.vp8[i]) patch_vp8(build + hdr_len, plen, a.pid[i], a.tl0[i], a.kidx[i]);
 
     if (sealed) {
       const uint8_t* key = a.keys + 16 * a.key_idx[i];
@@ -241,7 +255,8 @@ extern "C" {
 int64_t egress_batch_send(
     int fd, int n_threads, const uint8_t* slab, int32_t n,
     const int64_t* pay_off, const int32_t* pay_len, const uint8_t* marker,
-    const uint8_t* pt, const uint8_t* vp8, const uint16_t* sn,
+    const uint8_t* pt, const uint8_t* vp8, const uint32_t* pd, int pd_ext_id,
+    const uint16_t* sn,
     const uint32_t* ts, const uint32_t* ssrc, const int32_t* pid,
     const int32_t* tl0, const int32_t* kidx, const uint32_t* ip,
     const uint16_t* port, const uint8_t* seal, const int32_t* key_idx,
@@ -249,7 +264,8 @@ int64_t egress_batch_send(
     uint8_t* out, const int64_t* out_off, const int32_t* out_len) {
   if (n <= 0) return 0;
   std::vector<uint8_t> skip(n, 0);
-  Args a{skip.data(), slab, pay_off, pay_len, marker, pt,   vp8,     sn,  ts,
+  Args a{skip.data(), slab, pay_off, pay_len, marker, pt,   vp8, pd, pd_ext_id,
+         sn,  ts,
          ssrc,  pid,     tl0,     kidx,   ip,       port,    seal, key_idx,
          keys,  key_ids, counters, out,   out_off,  out_len, fd};
   if (n_threads < 1) n_threads = 1;

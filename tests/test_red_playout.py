@@ -1,0 +1,223 @@
+"""RED (RFC 2198) audio redundancy + playout-delay extension end-to-end.
+
+Reference parity: pkg/sfu/redreceiver.go (primary → RED encapsulation for
+RED subscribers), redprimaryreceiver.go (RED publisher → primary decap),
+and pkg/sfu/rtpextension/playoutdelay.go (min/max playout-delay header
+extension on video egress).
+"""
+
+import asyncio
+import socket
+
+import numpy as np
+
+from livekit_server_tpu.models import plane
+from livekit_server_tpu.runtime import PlaneRuntime
+from livekit_server_tpu.runtime.udp import (
+    OPUS_PT,
+    PLAYOUT_DELAY_EXT_ID,
+    RED_PT,
+    start_udp_transport,
+)
+from tests.test_native import rtp_packet, vp8_payload
+
+DIMS = plane.PlaneDims(rooms=1, tracks=4, pkts=8, subs=4)
+
+
+def parse_red(payload: bytes):
+    """→ (blocks [(pt, ts_off, bytes)], primary_bytes)."""
+    q = 0
+    hdrs = []
+    while payload[q] & 0x80:
+        pt = payload[q] & 0x7F
+        off = (payload[q + 1] << 6) | (payload[q + 2] >> 2)
+        ln = ((payload[q + 2] & 0x03) << 8) | payload[q + 3]
+        hdrs.append((pt, off, ln))
+        q += 4
+    prim_pt = payload[q] & 0x7F
+    q += 1
+    blocks = []
+    for pt, off, ln in hdrs:
+        blocks.append((pt, off, payload[q : q + ln]))
+        q += ln
+    return blocks, payload[q:], prim_pt
+
+
+async def _setup(tick_ms=10):
+    runtime = PlaneRuntime(DIMS, tick_ms=tick_ms)
+    s = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    transport = await start_udp_transport(runtime.ingest, "127.0.0.1", port)
+    return runtime, transport, port
+
+
+async def test_red_encapsulation_toggles_per_subscriber():
+    runtime, transport, port = await _setup()
+    try:
+        runtime.set_track(0, 0, published=True, is_video=False)
+        runtime.set_subscription(0, 0, 1, subscribed=True)   # RED sub
+        runtime.set_subscription(0, 0, 2, subscribed=True)   # plain sub
+        ssrc = transport.assign_ssrc(0, 0, is_video=False)
+        transport.set_sub_red(0, 1, True)
+
+        pub = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
+        pub.bind(("127.0.0.1", 0))
+        socks = {}
+        for col in (1, 2):
+            ss = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
+            ss.bind(("127.0.0.1", 0))
+            ss.setblocking(False)
+            socks[col] = ss
+            transport.register_subscriber(0, col, ss.getsockname())
+
+        payloads = [b"opus-frame-%d" % i for i in range(6)]
+        got = {1: [], 2: []}
+        for i, pay in enumerate(payloads):
+            pub.sendto(
+                rtp_packet(sn=100 + i, ts=960 * i, ssrc=ssrc, pt=OPUS_PT,
+                           audio_level=30, payload=pay),
+                ("127.0.0.1", port),
+            )
+            await asyncio.sleep(0.02)
+            res = await runtime.step_once()
+            transport.send_egress_batch(
+                res.egress_batch, red_plan=(res.red_sn, res.red_off, res.red_ok)
+            )
+            await asyncio.sleep(0.01)
+            for col, ss in socks.items():
+                while True:
+                    try:
+                        d = ss.recvfrom(4096)[0]
+                        if not 192 <= d[1] <= 223:
+                            got[col].append(d)
+                    except BlockingIOError:
+                        break
+
+        assert len(got[1]) >= 5 and len(got[2]) >= 5
+        # Plain subscriber: normal Opus PT, raw payload.
+        for d in got[2]:
+            assert d[1] & 0x7F == OPUS_PT
+        assert any(p in d for p in payloads for d in got[2])
+        # RED subscriber: RED PT; primary == original; later packets carry
+        # redundancy blocks with the PREVIOUS payloads.
+        saw_redundancy = False
+        for d in got[1]:
+            assert d[1] & 0x7F == RED_PT
+            blocks, prim, prim_pt = parse_red(d[12:])
+            assert prim_pt == OPUS_PT
+            assert prim in payloads
+            for pt, off, blk in blocks:
+                assert pt == OPUS_PT and blk in payloads and off > 0
+                # redundancy precedes its primary
+                assert payloads.index(blk) < payloads.index(prim)
+                saw_redundancy = True
+        assert saw_redundancy, "no RED packet carried a redundancy block"
+        pub.close()
+        for ss in socks.values():
+            ss.close()
+    finally:
+        transport.transport.close()
+        await runtime.stop()
+
+
+async def test_red_publisher_decap():
+    """A RED-publishing client's packets are stripped to the primary block
+    before staging (redprimaryreceiver.go)."""
+    runtime, transport, port = await _setup()
+    try:
+        runtime.set_track(0, 0, published=True, is_video=False)
+        runtime.set_subscription(0, 0, 1, subscribed=True)
+        ssrc = transport.assign_ssrc(0, 0, is_video=False)
+
+        pub = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
+        pub.bind(("127.0.0.1", 0))
+        sub = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
+        sub.bind(("127.0.0.1", 0))
+        sub.setblocking(False)
+        transport.register_subscriber(0, 1, sub.getsockname())
+
+        prev = b"previous-opus"
+        prim = b"current-opus!"
+        # RED payload: one redundancy block (prev, off 960) + primary.
+        red = bytes([0x80 | OPUS_PT, 960 >> 6, ((960 & 0x3F) << 2) | 0,
+                     len(prev)]) + bytes([OPUS_PT]) + prev + prim
+        got = []
+        for i in range(4):
+            pub.sendto(
+                rtp_packet(sn=300 + i, ts=960 * (i + 1), ssrc=ssrc, pt=RED_PT,
+                           payload=red),
+                ("127.0.0.1", port),
+            )
+            await asyncio.sleep(0.02)
+            res = await runtime.step_once()
+            transport.send_egress_batch(res.egress_batch)
+            await asyncio.sleep(0.01)
+            while True:
+                try:
+                    d = sub.recvfrom(4096)[0]
+                    if not 192 <= d[1] <= 223:
+                        got.append(d)
+                except BlockingIOError:
+                    break
+        assert transport.stats.get("red_rx", 0) >= 4
+        assert got, "no forwarded packets"
+        for d in got:
+            assert d[12:] == prim        # primary only; RED shell stripped
+        pub.close()
+        sub.close()
+    finally:
+        transport.transport.close()
+        await runtime.stop()
+
+
+async def test_playout_delay_extension_on_video_egress():
+    runtime, transport, port = await _setup()
+    try:
+        transport.playout_delay = (100, 400)  # ms
+        runtime.set_track(0, 0, published=True, is_video=True)
+        runtime.set_subscription(0, 0, 1, subscribed=True)
+        ssrc = transport.assign_ssrc(0, 0, is_video=True)
+
+        pub = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
+        pub.bind(("127.0.0.1", 0))
+        sub = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
+        sub.bind(("127.0.0.1", 0))
+        sub.setblocking(False)
+        transport.register_subscriber(0, 1, sub.getsockname())
+
+        got = []
+        for i in range(10):
+            pub.sendto(
+                rtp_packet(sn=500 + i, ts=3000 * i, ssrc=ssrc, pt=96,
+                           payload=vp8_payload(pid=100 + i, tl0=1, tid=0,
+                                               keyframe=True)),
+                ("127.0.0.1", port),
+            )
+            await asyncio.sleep(0.02)
+            res = await runtime.step_once()
+            transport.send_egress_batch(res.egress_batch)
+            await asyncio.sleep(0.01)
+            while True:
+                try:
+                    d = sub.recvfrom(4096)[0]
+                    if not 192 <= d[1] <= 223:
+                        got.append(d)
+                except BlockingIOError:
+                    break
+        assert got, "no forwarded video"
+        for d in got:
+            assert d[0] & 0x10, "X bit missing"
+            assert d[12:14] == b"\xbe\xde"
+            ext_words = int.from_bytes(d[14:16], "big")
+            assert ext_words == 1
+            assert d[16] >> 4 == PLAYOUT_DELAY_EXT_ID
+            assert d[16] & 0x0F == 2  # 3-byte value
+            val = int.from_bytes(d[17:20], "big")
+            assert val >> 12 == 100 // 10 and val & 0xFFF == 400 // 10
+        pub.close()
+        sub.close()
+    finally:
+        transport.transport.close()
+        await runtime.stop()
