@@ -398,8 +398,34 @@ class RoomManager:
                     res.track_quality[row], res.track_mos[row], res.sub_quality[row]
                 )
                 room.reconcile_dynacast()
+            if self.telemetry is not None:
+                # Windowed device reductions → quality histograms + one
+                # analytics record per published track (statsworker.go).
+                pub = self.runtime.meta.published
+                if pub.any():
+                    self.telemetry.observe_tracks(
+                        res.track_loss_pct[pub],
+                        res.track_jitter_ms[pub],
+                        res.track_bps[pub],
+                    )
+                for row, room in self._row_to_room.items():
+                    for col, sid in room.col_to_sid.items():
+                        if not pub[row, col]:
+                            continue
+                        self.telemetry.track_stat(
+                            room=room.name, track=sid,
+                            kind="video" if self.runtime.meta.is_video[row, col] else "audio",
+                            loss_pct=round(float(res.track_loss_pct[row, col]), 3),
+                            jitter_ms=round(float(res.track_jitter_ms[row, col]), 3),
+                            bps=round(float(res.track_bps[row, col]), 1),
+                            mos=round(float(res.track_mos[row, col]), 2),
+                            quality=int(res.track_quality[row, col]),
+                        )
         if self.telemetry is not None:
             self.telemetry.observe_plane(self.runtime.stats)
+            self.telemetry.observe_tick_latency(res.tick_s)
+            if self.udp is not None:
+                self.telemetry.observe_transport(self.udp.stats)
 
     # -- periodic reaping (server.go backgroundWorker) --------------------
     def start(self) -> None:

@@ -64,6 +64,7 @@ class LivekitServer:
         self.app.router.add_post("/twirp/livekit.SIP/{method}", self.sip.handle)
         self.app.router.add_get("/metrics", self.metrics)
         self.app.router.add_get("/debug/rooms", self.debug_rooms)
+        self.app.router.add_get("/debug/analytics", self.debug_analytics)
         self._runner: web.AppRunner | None = None
         self._sites: list[web.TCPSite] = []
         self._stats_task: asyncio.Task | None = None
@@ -115,6 +116,16 @@ class LivekitServer:
     async def metrics(self, request: web.Request) -> web.Response:
         return web.Response(
             text=self.telemetry.prometheus_text(), content_type="text/plain"
+        )
+
+    async def debug_analytics(self, request: web.Request) -> web.Response:
+        """Recent per-track analytics records (statsworker.go stream seat)."""
+        try:
+            n = max(0, int(request.query.get("n", 100)))
+        except ValueError:
+            return web.Response(status=400, text="n must be an integer")
+        return web.json_response(
+            {"track_stats": self.telemetry.track_stats[-n:] if n else []}
         )
 
     async def debug_rooms(self, request: web.Request) -> web.Response:

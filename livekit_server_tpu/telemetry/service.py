@@ -14,6 +14,8 @@ import time
 from collections import defaultdict
 from typing import Any
 
+import numpy as np
+
 from livekit_server_tpu.config.config import Config
 from livekit_server_tpu.telemetry.webhook import WebhookNotifier
 
@@ -33,12 +35,56 @@ EVENTS = {
 }
 
 
+class Histogram:
+    """Prometheus histogram fed with numpy batches (the batched analog of
+    prometheus/packets.go's per-packet observations)."""
+
+    def __init__(self, buckets: tuple[float, ...]):
+        self.buckets = np.asarray(buckets, np.float64)
+        # One extra slot for overflow (> last finite bucket → +Inf only).
+        self.counts = np.zeros(len(buckets) + 1, np.int64)
+        self.sum = 0.0
+        self.count = 0
+
+    def observe(self, values) -> None:
+        v = np.atleast_1d(np.asarray(values, np.float64))
+        if not len(v):
+            return
+        self.count += len(v)
+        self.sum += float(v.sum())
+        idx = np.searchsorted(self.buckets, v, side="left")
+        self.counts += np.bincount(idx, minlength=len(self.buckets) + 1)
+
+    def render(self, name: str, lines: list[str]) -> None:
+        cum = 0
+        for b, c in zip(self.buckets, self.counts[:-1]):
+            cum += int(c)
+            lines.append(f'{name}_bucket{{le="{b:g}"}} {cum}')
+        lines.append(f'{name}_bucket{{le="+Inf"}} {self.count}')
+        lines.append(f"{name}_sum {self.sum:g}")
+        lines.append(f"{name}_count {self.count}")
+
+
+# Bucket ladders (prometheus/packets.go + connectionquality histograms).
+_HIST_SPECS = {
+    "livekit_track_loss_percent": (0.0, 0.5, 1, 2, 5, 10, 20, 50, 100),
+    "livekit_track_jitter_ms": (0.5, 1, 2, 5, 10, 20, 50, 100, 200),
+    "livekit_track_bitrate_kbps": (16, 64, 150, 500, 1000, 2000, 4000, 8000),
+    "livekit_forward_latency_ms": (1, 2, 5, 10, 20, 50, 100, 250, 1000),
+}
+
+
 class TelemetryService:
     def __init__(self, config: Config):
         self.config = config
         self.counters: dict[str, float] = defaultdict(float)
         self.gauges: dict[str, float] = {}
+        self.histograms = {k: Histogram(v) for k, v in _HIST_SPECS.items()}
         self.events: list[dict[str, Any]] = []  # ring of recent events
+        # Per-track analytics records (~1/s per published track — the
+        # statsworker.go → analytics stream seat; ring-buffered, served at
+        # /debug/analytics).
+        self.track_stats: list[dict[str, Any]] = []
         self.webhook = WebhookNotifier(config)
 
     # -- events (events.go) ----------------------------------------------
@@ -66,12 +112,41 @@ class TelemetryService:
         self.set_gauge("livekit_bytes_forwarded_total", stats.get("fwd_bytes", 0))
         self.set_gauge("livekit_plane_late_ticks_total", stats.get("late_ticks", 0))
 
+    def observe_transport(self, stats: dict[str, Any]) -> None:
+        """UDP/TCP media-wire counters (prometheus/packets.go direction
+        labels: rx/tx, plus NACK/PLI/RTX feedback volumes)."""
+        for k in ("rx", "tx", "rtx_tx", "nacks_rx", "nacks_tx",
+                  "plis_rx", "plis_tx", "bad_frame", "red_tx", "red_rx"):
+            if k in stats:
+                self.set_gauge(f"livekit_media_{k}_total", stats[k])
+
+    def observe_tick_latency(self, tick_s: float) -> None:
+        self.histograms["livekit_forward_latency_ms"].observe(tick_s * 1000.0)
+
+    def observe_tracks(self, loss_pct, jitter_ms, bps) -> None:
+        """Windowed per-track receive stats (device reductions) → quality
+        histograms; called when the ~1 s stats window rolls."""
+        self.histograms["livekit_track_loss_percent"].observe(loss_pct)
+        self.histograms["livekit_track_jitter_ms"].observe(jitter_ms)
+        self.histograms["livekit_track_bitrate_kbps"].observe(
+            np.asarray(bps, np.float64) / 1000.0
+        )
+
+    def track_stat(self, **record: Any) -> None:
+        """One per-track analytics record (statsworker.go AnalyticsStat)."""
+        record["ts"] = int(time.time())
+        self.track_stats.append(record)
+        if len(self.track_stats) > 2000:
+            del self.track_stats[: len(self.track_stats) - 2000]
+
     def prometheus_text(self) -> str:
         lines = []
         for key, v in sorted(self.counters.items()):
             lines.append(f"{key} {v:g}")
         for key, v in sorted(self.gauges.items()):
             lines.append(f"{key} {v:g}")
+        for name, h in sorted(self.histograms.items()):
+            h.render(name, lines)
         return "\n".join(lines) + "\n"
 
     async def close(self) -> None:

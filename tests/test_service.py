@@ -445,6 +445,37 @@ async def test_udp_media_through_full_server():
                 off, ln = int(out["payload_off"]), int(out["payload_len"])
                 assert data[off : off + ln].startswith(b"udp-opus")
             assert sns == list(range(900, 908))
+
+            # Telemetry depth under load: quality histograms and per-track
+            # analytics move once the ~1 s stats window rolls (VERDICT #9;
+            # prometheus/packets.go + statsworker.go seats).
+            deadline = asyncio.get_event_loop().time() + 4
+            seen_hist = seen_stats = False
+            while not (seen_hist and seen_stats):
+                assert asyncio.get_event_loop().time() < deadline, (
+                    "histograms/analytics never moved under load"
+                )
+                pub_sock.sendto(
+                    rtp_packet(sn=950, ts=96000, ssrc=ssrc, audio_level=25,
+                               payload=b"late"),
+                    ("127.0.0.1", udp_port),
+                )
+                await asyncio.sleep(0.2)
+                async with s.get(f"http://127.0.0.1:{server.port}/metrics") as r:
+                    text = await r.text()
+                    assert "livekit_forward_latency_ms_count" in text
+                    assert "livekit_media_tx_total" in text
+                    for line in text.splitlines():
+                        if line.startswith("livekit_track_bitrate_kbps_count"):
+                            seen_hist = float(line.split()[-1]) > 0
+                async with s.get(
+                    f"http://127.0.0.1:{server.port}/debug/analytics"
+                ) as r:
+                    stats = (await r.json())["track_stats"]
+                    seen_stats = any(
+                        rec["track"] == track_sid and rec["bps"] > 0
+                        for rec in stats
+                    )
             pub_sock.close()
             sub_sock.close()
             await alice.close()
