@@ -48,6 +48,7 @@ class Participant:
         grants: dict | None = None,
         name: str = "",
         auto_subscribe: bool = True,
+        client_info: dict | None = None,
     ):
         self.sid = ids.new_participant_id()
         self.identity = identity
@@ -56,6 +57,15 @@ class Participant:
         self.response_sink = response_sink
         self.grants = grants or {}
         self.auto_subscribe = auto_subscribe
+        self.client_info = client_info or {}
+        # Device/SDK quirk config matched at join (pkg/clientconfiguration
+        # conf.go GetConfiguration); rides the JoinResponse and gates
+        # resume + publish codecs server-side.
+        from livekit_server_tpu.clientconfig import ClientConfigurationManager
+
+        self.client_config = ClientConfigurationManager().get_configuration(
+            self.client_info
+        )
         self.state = pm.ParticipantState.JOINING
         self.joined_at = int(time.time())
         self.metadata = ""
@@ -66,6 +76,8 @@ class Participant:
         self._apply_grant_permissions()
         self.published: dict[str, PublishedTrack] = {}   # track sid → entry
         self.pending_tracks: dict[str, pm.TrackInfo] = {}  # cid → info
+        self.pending_since: dict[str, float] = {}  # cid → announce time
+        # (supervisor/participant_supervisor.go publication watchdog)
         self.subscribed_tracks: set[str] = set()         # track sids
         self.disconnected = asyncio.Event()
         self.close_reason = pm.DisconnectReason.UNKNOWN_REASON
@@ -100,6 +112,7 @@ class Participant:
             for sid in list(self.published):
                 self.unpublish_track(sid)
             self.pending_tracks.clear()  # announced-but-unbound tracks too
+            self.pending_since.clear()
         self.version += 1
         return True
 
@@ -139,6 +152,21 @@ class Participant:
         cid = req.get("cid", "")
         if not cid or cid in self.pending_tracks:
             return None
+        mime = str(req.get("mime_type", "")).lower()
+        if self.client_config is not None and mime and mime in {
+            m.lower()
+            for m in self.client_config.disabled_codecs
+            + self.client_config.disabled_publish_codecs
+        }:
+            # Codec publish disabled for this device/SDK combination
+            # (clientconfiguration staticconfiguration.go). Answer
+            # explicitly — dead air would hang the SDK's publish().
+            self.send(
+                "request_response",
+                {"error": {"reason": "codec_disabled_for_client", "cid": cid,
+                           "mime_type": mime}},
+            )
+            return None
         try:
             track_type = pm.TrackType(int(req.get("type", 0)))
             source = pm.TrackSource(int(req.get("source", 0)))
@@ -166,8 +194,30 @@ class Participant:
             disable_red=req.get("disable_red", False),
         )
         self.pending_tracks[cid] = info
+        self.pending_since[cid] = time.time()
         self.send("track_published", {"cid": cid, "track": info.to_dict()})
         return info
+
+    def reap_stale_publications(self, wait_s: float = 30.0) -> list[str]:
+        """Publication watchdog (supervisor/publication_monitor.go:30
+        publishWaitDuration): an announced track whose media never arrived
+        is abandoned and the client told, instead of a ghost entry living
+        in pending_tracks forever. Returns the reaped cids."""
+        now = time.time()
+        stale = [
+            cid for cid, t0 in self.pending_since.items()
+            if now - t0 > wait_s and cid in self.pending_tracks
+        ]
+        for cid in stale:
+            info = self.pending_tracks.pop(cid, None)
+            self.pending_since.pop(cid, None)
+            if info is not None:
+                self.send(
+                    "track_unpublished",
+                    {"track_sid": info.sid, "participant_sid": self.sid,
+                     "reason": "publish_timeout"},
+                )
+        return stale
 
     def publish_pending(self, cid: str) -> PublishedTrack | None:
         """Media arrived for a pending track (the reference's onMediaTrack
@@ -175,6 +225,7 @@ class Participant:
         if not self.permission.can_publish:
             # Permission may have been revoked between announce and media.
             self.pending_tracks.pop(cid, None)
+            self.pending_since.pop(cid, None)
             return None
         info = self.pending_tracks.pop(cid, None)
         if info is None:
@@ -182,7 +233,11 @@ class Participant:
         track = self.room.publish_track(self, info)
         if track is None:
             self.pending_tracks[cid] = info  # no capacity; retry later
+            # Media IS arriving — restart the watchdog clock so an active
+            # publish blocked on capacity is never reaped as abandoned.
+            self.pending_since[cid] = time.time()
             return None
+        self.pending_since.pop(cid, None)
         track.cid = cid
         self.published[info.sid] = track
         self.state = pm.ParticipantState.ACTIVE

@@ -150,6 +150,22 @@ def parse_sr(chunk: bytes):
     )
 
 
+def build_rr(sender_ssrc: int, media_ssrc: int, fraction_lost: int) -> bytes:
+    """Receiver report with one block carrying only fraction_lost (the
+    upstream loss signal of medialossproxy.go → buffer
+    SetLastFractionLostReport: publishers enable Opus FEC on it)."""
+    block = (
+        (media_ssrc & 0xFFFFFFFF).to_bytes(4, "big")
+        + bytes([fraction_lost & 0xFF])
+        + b"\x00" * 19
+    )
+    return (
+        bytes([0x80 | 1, RTCP_RR, 0, 7])
+        + (sender_ssrc & 0xFFFFFFFF).to_bytes(4, "big")
+        + block
+    )
+
+
 def build_pli(sender_ssrc: int, media_ssrc: int) -> bytes:
     return (
         bytes([0x80 | 1, RTCP_PSFB, 0, 2])
@@ -272,6 +288,10 @@ class UDPMediaTransport(asyncio.DatagramProtocol):
         # Playout-delay header extension on video egress
         # (rtpextension/playoutdelay.go): (min_ms, max_ms) or None.
         self.playout_delay: tuple[int, int] | None = None
+        # Media-loss proxy (medialossproxy.go): max subscriber-reported
+        # fraction_lost per audio track, relayed upstream ~1/s so the
+        # publisher's Opus encoder can enable FEC.
+        self._down_frac_lost: dict[tuple, int] = {}  # (room, track) → byte
         self.stats = {
             "rx": 0, "tx": 0, "unknown_ssrc": 0, "parse_errors": 0,
             "addr_mismatch": 0, "bad_punch": 0,
@@ -594,6 +614,17 @@ class UDPMediaTransport(asyncio.DatagramProtocol):
                     room, sub, _track = dest
                     if self.sub_addrs.get((room, sub)) != addr:
                         continue
+                    # Media-loss proxy (medialossproxy.go HandleMaxLoss
+                    # Feedback): audio downstream loss aggregates to the
+                    # per-track max and is relayed upstream at SR cadence.
+                    if not self.track_kind.get((room, _track), False):
+                        # Zero-loss reports are recorded too: the relay
+                        # must tell the publisher when loss RECOVERS, or
+                        # its Opus FEC latches on forever.
+                        key = (room, _track)
+                        self._down_frac_lost[key] = max(
+                            self._down_frac_lost.get(key, 0), b[4]
+                        )
                     # Loss itself is NOT fed to BWE here: the NACK path
                     # already counts it (push_nack → _nacks); adding
                     # fraction_lost would double-count the same event.
@@ -937,6 +968,18 @@ class UDPMediaTransport(asyncio.DatagramProtocol):
             mids = self._sr_sent.setdefault(ssrc, [])
             mids.append(mid)
             del mids[:-4]
+        # Media-loss proxy upstream relay (medialossproxy.go:82
+        # maybeUpdateLoss, downLostUpdateDelta = 1 s): one RR per audio
+        # publisher SSRC carrying the window's max subscriber loss.
+        if self._down_frac_lost:
+            window, self._down_frac_lost = self._down_frac_lost, {}
+            for ssrc, b in self.bindings.items():
+                frac = window.get((b.room, b.track))
+                if frac is None:
+                    continue
+                addr = self.addrs.get(ssrc)
+                if addr is not None:
+                    self._sendto(build_rr(self.node_ssrc, ssrc, frac), addr, b.session)
 
     def send_egress_batch(self, batch, red_plan=None) -> np.ndarray:
         """Vectorized tick egress (the hot half of DownTrack.WriteRTP +

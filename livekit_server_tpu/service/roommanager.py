@@ -158,6 +158,15 @@ class RoomManager:
         identity = init.get("identity", "")
 
         existing = room.participants.get(identity)
+        if (
+            existing is not None
+            and existing.client_config is not None
+            and existing.client_config.resume_connection == "disabled"
+        ):
+            # Client-quirk config forbids resume for this device/SDK
+            # (clientconfiguration → ResumeConnection DISABLED): force a
+            # full rejoin instead of session resumption.
+            existing = None
         if existing is not None and init.get("reconnect"):
             # resume: swap the signal sinks onto the live participant
             # (roommanager.go:266-316); bump the epoch so the OLD worker's
@@ -185,6 +194,7 @@ class RoomManager:
             grants=init.get("grants"),
             name=init.get("name", ""),
             auto_subscribe=init.get("auto_subscribe", True),
+            client_info=init.get("client_info"),
         )
         self._attach_media_queue(room, participant)
         try:
@@ -193,6 +203,8 @@ class RoomManager:
             # subscriber-column tensor full (slots.alloc_sub)
             self._reject_session(response_sink, request_source)
             return
+        if participant.client_config is not None:
+            join["client_configuration"] = participant.client_config.to_dict()
         participant.send("join", join)
         await self.store.store_participant(room_name, participant.to_info())
         self._update_node_stats()
@@ -438,6 +450,12 @@ class RoomManager:
             await asyncio.sleep(1.0)
             for name in [n for n, r in self.rooms.items() if r.should_close()]:
                 await self.delete_room(name)
+            # Publication watchdog (participant_supervisor.go monitor loop):
+            # announced tracks whose media never arrived get reaped and the
+            # client notified.
+            for room in list(self.rooms.values()):
+                for p in list(room.participants.values()):
+                    p.reap_stale_publications()
 
     async def stop(self) -> None:
         if self._reaper_task is not None:

@@ -71,17 +71,31 @@ class RTCService:
                 node_id = await router.try_takeover(room_name, node_id)
         if not node_id:
             if not self.server.config.room.auto_create:
-                return web.Response(status=404, text="room not found")
+                # ValidateCreateRoom (roomallocator.go:147): with
+                # auto-create off, an admin-created room (store record,
+                # no pin yet) must still be joinable; only a room that
+                # exists nowhere is a 404.
+                if await self.server.store.load_room(room_name) is None:
+                    return web.Response(status=404, text="room not found")
             node = self.server.select_node()
             if node is None:
                 return web.Response(status=503, text="no nodes available")
             await router.set_node_for_room(room_name, node.node_id)
+        # ClientInfo rides the connect query (SDKs send sdk/version/os/...;
+        # rtcservice.go ParseClientInfo) → clientconfiguration matching.
+        client_info = {
+            k: request.query[k]
+            for k in ("sdk", "version", "protocol", "os", "os_version",
+                      "browser", "browser_version", "device_model")
+            if k in request.query
+        }
         init = ParticipantInit(
             identity=claims.identity,
             name=claims.name,
             auto_subscribe=auto_subscribe,
             reconnect=request.query.get("reconnect") == "1",
             grants={"video": claims.video.to_claim()},
+            client_info=client_info or None,
         )
         try:
             cid, req_sink, resp_source = await router.start_participant_signal(room_name, init)

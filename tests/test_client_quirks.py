@@ -1,0 +1,148 @@
+"""Client-quirk configuration, publication watchdog, and media-loss proxy.
+
+Reference parity: pkg/clientconfiguration (device/SDK rules → per-client
+config at join), pkg/rtc/supervisor (announced-but-never-published track
+reaping), pkg/rtc/medialossproxy.go (max subscriber audio loss relayed
+upstream so publishers enable Opus FEC).
+"""
+
+import asyncio
+import socket
+
+from livekit_server_tpu.clientconfig import ClientConfigurationManager
+from livekit_server_tpu.models import plane
+from livekit_server_tpu.protocol import decode_signal_response
+from livekit_server_tpu.routing.messagechannel import MessageChannel
+from livekit_server_tpu.rtc import Participant, Room
+from livekit_server_tpu.runtime import PlaneRuntime
+
+DIMS = plane.PlaneDims(rooms=2, tracks=4, pkts=4, subs=4)
+
+FIREFOX_LINUX = {"browser": "Firefox", "os": "Linux", "sdk": "js"}
+
+
+def drain(sink):
+    out = []
+    while True:
+        try:
+            out.append(decode_signal_response(sink._q.get_nowait()))
+        except asyncio.QueueEmpty:
+            return out
+
+
+def test_clientconfig_rules():
+    m = ClientConfigurationManager()
+    cfg = m.get_configuration(FIREFOX_LINUX)
+    assert cfg is not None and "video/h264" in cfg.disabled_publish_codecs
+    cfg = m.get_configuration({"browser": "firefox mobile", "os": "Android"})
+    assert cfg is not None
+    assert m.get_configuration({"browser": "chrome", "os": "linux"}) is None
+    assert m.get_configuration({"device_model": "xiaomi 2201117ti", "os": "android"}) is not None
+    assert m.get_configuration({"device_model": "xiaomi 2201117ti", "os": "ios"}) is None
+    assert m.get_configuration(None) is None
+
+
+async def test_quirk_blocks_h264_publish_and_rides_join():
+    runtime = PlaneRuntime(DIMS, tick_ms=10)
+    try:
+        room = Room("quirk", runtime)
+        sink = MessageChannel(size=100)
+        p = Participant("ff", room, response_sink=sink, client_info=FIREFOX_LINUX)
+        room.join(p)
+        assert p.client_config is not None
+
+        # H.264 publish is rejected for this client; VP8 is fine.
+        assert p.add_track_request(
+            {"cid": "c1", "type": 1, "mime_type": "video/H264"}
+        ) is None
+        assert p.add_track_request(
+            {"cid": "c2", "type": 1, "mime_type": "video/VP8"}
+        ) is not None
+    finally:
+        await runtime.stop()
+
+
+async def test_publication_watchdog_reaps_stale_pending():
+    runtime = PlaneRuntime(DIMS, tick_ms=10)
+    try:
+        room = Room("watchdog", runtime)
+        sink = MessageChannel(size=100)
+        p = Participant("pub", room, response_sink=sink)
+        room.join(p)
+        info = p.add_track_request({"cid": "ghost", "type": 0, "name": "mic"})
+        assert info is not None and "ghost" in p.pending_tracks
+        assert p.reap_stale_publications(wait_s=30.0) == []  # not stale yet
+        p.pending_since["ghost"] -= 31.0
+        assert p.reap_stale_publications(wait_s=30.0) == ["ghost"]
+        assert "ghost" not in p.pending_tracks
+        kinds = [r.kind for r in drain(sink)]
+        assert "track_unpublished" in kinds
+    finally:
+        await runtime.stop()
+
+
+async def test_media_loss_proxy_relays_max_audio_loss_upstream():
+    from livekit_server_tpu.runtime.udp import RTCP_RR, build_rr, start_udp_transport
+    from tests.test_native import rtp_packet
+
+    runtime = PlaneRuntime(DIMS, tick_ms=10)
+    s = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    transport = await start_udp_transport(runtime.ingest, "127.0.0.1", port)
+    try:
+        runtime.set_track(0, 0, published=True, is_video=False)
+        runtime.set_subscription(0, 0, 1, subscribed=True)
+        pub_ssrc = transport.assign_ssrc(0, 0, is_video=False)
+
+        pub = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
+        pub.bind(("127.0.0.1", 0))
+        pub.setblocking(False)
+        sub = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
+        sub.bind(("127.0.0.1", 0))
+        sub.setblocking(False)
+        transport.register_subscriber(0, 1, sub.getsockname())
+
+        # Publisher media latches its source address; egress mints the
+        # downtrack SSRC the subscriber reports against.
+        for i in range(3):
+            pub.sendto(
+                rtp_packet(sn=100 + i, ts=960 * i, ssrc=pub_ssrc,
+                           payload=b"op" + bytes([i])),
+                ("127.0.0.1", port),
+            )
+            await asyncio.sleep(0.02)
+            res = await runtime.step_once()
+            transport.send_egress_batch(res.egress_batch)
+            await asyncio.sleep(0.01)
+        down_ssrc = transport.subscriber_ssrc(0, 1, 0)
+
+        # Subscriber reports 25% loss (fraction_lost = 64/256) via RR
+        # from its registered address.
+        transport._handle_rtcp(
+            build_rr(0xABC, down_ssrc, 64), sub.getsockname()
+        )
+        assert transport._down_frac_lost.get((0, 0)) == 64
+
+        # Next SR window relays the max loss upstream to the publisher.
+        transport._last_sr_ms = -10_000
+        transport._send_srs(asyncio.get_event_loop().time() * 1000.0)
+        await asyncio.sleep(0.05)
+        got_rr = None
+        while True:
+            try:
+                d, _ = pub.recvfrom(2048)
+                if d[1] == RTCP_RR:
+                    got_rr = d
+            except BlockingIOError:
+                break
+        assert got_rr is not None, "no upstream RR reached the publisher"
+        assert int.from_bytes(got_rr[8:12], "big") == pub_ssrc
+        assert got_rr[12] == 64  # fraction_lost relayed
+        assert transport._down_frac_lost == {}  # window reset
+        pub.close()
+        sub.close()
+    finally:
+        transport.transport.close()
+        await runtime.stop()
