@@ -36,6 +36,7 @@ PARSED_DTYPE = np.dtype(
         ("is_vp8", np.uint8), ("keyframe", np.uint8), ("begin_pic", np.uint8),
         ("tid", np.uint8), ("layer_sync", np.uint8),
         ("picture_id", np.int32), ("tl0picidx", np.int32), ("keyidx", np.int32),
+        ("dd_off", np.int32), ("dd_len", np.int32),
     ],
     align=True,
 )
@@ -62,7 +63,7 @@ class _NativeRTP:
         self.lib.parse_rtp_batch.restype = ctypes.c_int
         self.lib.parse_rtp_batch.argtypes = [
             ctypes.c_void_p, ctypes.c_void_p, ctypes.c_void_p, ctypes.c_int,
-            ctypes.c_int, ctypes.c_void_p, ctypes.c_void_p,
+            ctypes.c_int, ctypes.c_void_p, ctypes.c_void_p, ctypes.c_int,
         ]
         self.lib.rewrite_rtp_batch.restype = None
         self.lib.rewrite_rtp_batch.argtypes = [
@@ -84,9 +85,11 @@ class _NativeRTP:
         lengths: np.ndarray,
         audio_level_ext: int = 1,
         vp8_pts: set[int] | None = None,
+        dd_ext_id: int = 0,
     ) -> np.ndarray:
         n = len(offsets)
         out = np.zeros(n, PARSED_DTYPE)
+        out["dd_off"] = -1
         mask = np.zeros(16, np.uint8)
         for pt in vp8_pts or ():
             mask[pt >> 3] |= 1 << (pt & 7)
@@ -95,7 +98,7 @@ class _NativeRTP:
         lens = np.ascontiguousarray(lengths, np.int32)
         self.lib.parse_rtp_batch(
             b.ctypes.data, offs.ctypes.data, lens.ctypes.data, n,
-            audio_level_ext, mask.ctypes.data, out.ctypes.data,
+            audio_level_ext, mask.ctypes.data, out.ctypes.data, dd_ext_id,
         )
         return out
 
@@ -136,7 +139,8 @@ class _PythonRTP:
 
     native = False
 
-    def parse_batch(self, buf, offsets, lengths, audio_level_ext=1, vp8_pts=None):
+    def parse_batch(self, buf, offsets, lengths, audio_level_ext=1, vp8_pts=None,
+                    dd_ext_id=0):
         buf = bytes(buf)
         vp8_pts = vp8_pts or set()
         out = np.zeros(len(offsets), PARSED_DTYPE)
@@ -145,6 +149,7 @@ class _PythonRTP:
             o["audio_level"] = 127
             o["picture_id"] = o["tl0picidx"] = o["keyidx"] = -1
             o["payload_len"] = -1
+            o["dd_off"] = -1
             p = buf[off : off + ln]
             if len(p) < 12 or p[0] >> 6 != 2:
                 continue
@@ -167,7 +172,7 @@ class _PythonRTP:
                 ext_off = q + 4
                 if ext_off + ext_len > len(p):
                     continue
-                if profile == 0xBEDE and audio_level_ext > 0:
+                if profile == 0xBEDE:
                     j, end = ext_off, ext_off + ext_len
                     while j < end:
                         b0 = p[j]
@@ -177,10 +182,30 @@ class _PythonRTP:
                         eid, elen = b0 >> 4, (b0 & 0x0F) + 1
                         if eid == 15 or j + 1 + elen > end:
                             break
-                        if eid == audio_level_ext and elen >= 1:
+                        if audio_level_ext > 0 and eid == audio_level_ext and elen >= 1:
                             o["voice"] = p[j + 1] >> 7
                             o["audio_level"] = p[j + 1] & 0x7F
+                        if dd_ext_id > 0 and eid == dd_ext_id:
+                            o["dd_off"] = off + j + 1
+                            o["dd_len"] = elen
                         j += 1 + elen
+                elif (profile & 0xFFF0) == 0x1000:  # two-byte extensions
+                    j, end = ext_off, ext_off + ext_len
+                    while j + 1 < end:
+                        eid = p[j]
+                        if eid == 0:
+                            j += 1
+                            continue
+                        elen = p[j + 1]
+                        if j + 2 + elen > end:
+                            break
+                        if audio_level_ext > 0 and eid == audio_level_ext and elen >= 1:
+                            o["voice"] = p[j + 2] >> 7
+                            o["audio_level"] = p[j + 2] & 0x7F
+                        if dd_ext_id > 0 and eid == dd_ext_id:
+                            o["dd_off"] = off + j + 2
+                            o["dd_len"] = elen
+                        j += 2 + elen
                 q = ext_off + ext_len
             pad = p[-1] if has_pad and len(p) > q else 0
             plen = len(p) - q - pad
@@ -319,9 +344,7 @@ class NativeEgress:
         self.lib.egress_batch_send.restype = ctypes.c_int64
         self.lib.egress_batch_send.argtypes = (
             [ctypes.c_int, ctypes.c_int, ctypes.c_void_p, ctypes.c_int32]
-            + [ctypes.c_void_p] * 6      # pay_off..vp8, pd
-            + [ctypes.c_int]             # pd_ext_id
-            + [ctypes.c_void_p] * 16     # sn..out_len
+            + [ctypes.c_void_p] * 24     # pay_off..out_len
         )
         # Exercise the library once so a broken libcrypto link is caught at
         # load time (and the fallback engaged), not on the first media tick.
@@ -364,15 +387,17 @@ class NativeEgress:
 
     def send(self, fd, n_threads, slab, pay_off, pay_len, marker, pt, vp8,
              sn, ts, ssrc, pid, tl0, kidx, ip, port, seal, key_idx, keys,
-             key_ids, counters, pd=None, pd_ext_id=6):
+             key_ids, counters, ext_blob=b"", ext_off=None, ext_len=None):
         """Returns (out, out_off, out_len, sent). With fd < 0 nothing hits
         the network and `out` holds the built frames (tests / TCP path).
-        `pd` (optional uint32 per entry) adds a playout-delay header
-        extension: (min_10ms << 12) | max_10ms, 0 = none."""
+        `ext_blob`/`ext_off`/`ext_len` attach pre-serialized RTP header-
+        extension sections (profile+length+elements+padding) per entry
+        (playout delay, dependency descriptor, …); ext_len 0 = none."""
         n = len(pay_off)
-        if pd is None:
-            pd = np.zeros(n, np.uint32)
-        clear_len = 12 + (pd != 0) * 8 + pay_len.astype(np.int64)
+        if ext_off is None:
+            ext_off = np.zeros(n, np.int64)
+            ext_len = np.zeros(n, np.int32)
+        clear_len = 12 + ext_len.astype(np.int64) + pay_len.astype(np.int64)
         out_len = np.where(
             (seal != 0) & (key_idx >= 0), clear_len + self.SEAL_OVERHEAD, clear_len
         ).astype(np.int32)
@@ -380,6 +405,10 @@ class NativeEgress:
         np.cumsum(out_len[:-1], out=out_off[1:])
         out = np.zeros(int(out_off[-1]) + int(out_len[-1]) if n else 0, np.uint8)
         slab_arr = np.frombuffer(slab, np.uint8) if len(slab) else np.zeros(1, np.uint8)
+        ext_arr = (
+            np.frombuffer(ext_blob, np.uint8) if len(ext_blob)
+            else np.zeros(1, np.uint8)
+        )
 
         def c(a, dt):
             return np.ascontiguousarray(a, dt).ctypes.data
@@ -388,7 +417,7 @@ class NativeEgress:
             int(fd), int(n_threads), slab_arr.ctypes.data, n,
             c(pay_off, np.int64), c(pay_len, np.int32), c(marker, np.uint8),
             c(pt, np.uint8), c(vp8, np.uint8),
-            c(pd, np.uint32), int(pd_ext_id),
+            ext_arr.ctypes.data, c(ext_off, np.int64), c(ext_len, np.int32),
             c(sn, np.uint16),
             c(ts, np.uint32), c(ssrc, np.uint32), c(pid, np.int32),
             c(tl0, np.int32), c(kidx, np.int32), c(ip, np.uint32),

@@ -152,6 +152,13 @@ class TrackInfo(_Model):
     encryption: int = 0  # 0 none, 1 gcm, 2 custom — E2EE passthrough
 
 
+def is_svc_mime(mime: str | None, is_video: bool) -> bool:
+    """SVC codecs (VP9/AV1) carry all spatial layers in ONE stream and take
+    the dependency-descriptor selection path (receiver.go IsSvcCodec)."""
+    m = (mime or "").lower()
+    return is_video and ("vp9" in m or "av1" in m)
+
+
 @dataclass
 class ParticipantPermission(_Model):
     """livekit.ParticipantPermission (auth grants → runtime enforcement,

@@ -155,12 +155,7 @@ class Room:
         track = PublishedTrack(info=info, track_col=col)
         self.tracks[info.sid] = (publisher, track)
         self.col_to_sid[col] = info.sid
-        # SVC codecs (VP9/AV1) carry all spatial layers in one stream and
-        # take the onion-selection path on device (receiver.go IsSvcCodec).
-        mime = (info.mime_type or "").lower()
-        is_svc = info.type == pm.TrackType.VIDEO and (
-            "vp9" in mime or "av1" in mime
-        )
+        is_svc = pm.is_svc_mime(info.mime_type, info.type == pm.TrackType.VIDEO)
         self.runtime.set_track(
             self.slots.row,
             col,

@@ -39,11 +39,17 @@ def handle_participant_signal(room, participant: Participant, req: SignalRequest
             if track is not None:
                 # One SSRC per simulcast spatial layer (mediatrack.go layer
                 # SSRC bookkeeping); single-layer tracks get exactly one.
-                n_layers = max(1, len(track.info.layers)) if track.is_video else 1
+                # SVC codecs (VP9/AV1) are single-stream: ONE SSRC, layers
+                # ride the dependency descriptor (receiver.go IsSvcCodec).
+                is_svc = pm.is_svc_mime(track.info.mime_type, track.is_video)
+                n_layers = (
+                    1 if is_svc or not track.is_video
+                    else max(1, len(track.info.layers))
+                )
                 layer_ssrcs = [
                     udp.assign_ssrc(
                         room.slots.row, track.track_col, track.is_video, layer=l,
-                        session=participant.crypto_session,
+                        session=participant.crypto_session, svc=is_svc,
                     )
                     for l in range(n_layers)
                 ]

@@ -35,6 +35,11 @@ class PayloadSlab:
     off: np.ndarray      # int64; -1 = no payload staged
     length: np.ndarray   # int32
     marker: np.ndarray   # bool — RTP M bit
+    # Dependency-descriptor extension bytes (SVC tracks): staged alongside
+    # payloads so egress re-attaches them (-1 = none).
+    dd_off: np.ndarray | None = None   # int64
+    dd_len: np.ndarray | None = None   # int32
+    dd_ver: np.ndarray | None = None   # int32 — structure version stamp
 
     def get(self, r: int, t: int, k: int) -> tuple[bytes, bool]:
         o = int(self.off[r, t, k])
@@ -44,6 +49,14 @@ class PayloadSlab:
             bytes(self.data[o : o + int(self.length[r, t, k])]),
             bool(self.marker[r, t, k]),
         )
+
+    def get_dd(self, r: int, t: int, k: int) -> bytes:
+        if self.dd_off is None:
+            return b""
+        o = int(self.dd_off[r, t, k])
+        if o < 0:
+            return b""
+        return bytes(self.data[o : o + int(self.dd_len[r, t, k])])
 
 
 @dataclass
@@ -96,6 +109,9 @@ class IngestBuffer:
         self.pay_off = np.full((R, T, K), -1, np.int64)
         self.pay_len = np.zeros((R, T, K), np.int32)
         self.marker = np.zeros((R, T, K), bool)
+        self.dd_off = np.full((R, T, K), -1, np.int64)
+        self.dd_len = np.zeros((R, T, K), np.int32)
+        self.dd_ver = np.full((R, T, K), -1, np.int32)
         # Per-subscriber feedback staging.
         self._estimate = np.zeros((R, S), np.float32)
         self._estimate_valid = np.zeros((R, S), bool)
@@ -168,6 +184,7 @@ class IngestBuffer:
         self, room, track, layer, sn, ts, ts_aligned, temporal, keyframe,
         layer_sync, begin_pic, marker, pid, tl0, keyidx, size, frame_ms,
         audio_level, arrival_rtp, pay_start, pay_length, blob,
+        dd_start=None, dd_length=None, dd_version=None,
     ) -> int:
         """Vectorized push: stage a whole receive batch with numpy group
         math instead of one Python call per packet (the batch half of the
@@ -177,17 +194,24 @@ class IngestBuffer:
         n = len(room)
         if n == 0:
             return 0
+        if dd_start is None:
+            dd_start = np.full(n, -1, np.int64)
+            dd_length = np.zeros(n, np.int32)
+        if dd_version is None:
+            dd_version = np.full(n, -1, np.int32)
         if self.frozen_rows:
             keep0 = ~np.isin(room, list(self.frozen_rows))
             if not keep0.all():
                 (room, track, layer, sn, ts, ts_aligned, temporal, keyframe,
                  layer_sync, begin_pic, marker, pid, tl0, keyidx, size,
-                 frame_ms, audio_level, arrival_rtp, pay_start, pay_length) = (
+                 frame_ms, audio_level, arrival_rtp, pay_start, pay_length,
+                 dd_start, dd_length, dd_version) = (
                     a[keep0] for a in (
                         room, track, layer, sn, ts, ts_aligned, temporal,
                         keyframe, layer_sync, begin_pic, marker, pid, tl0,
                         keyidx, size, frame_ms, audio_level, arrival_rtp,
-                        pay_start, pay_length)
+                        pay_start, pay_length, dd_start, dd_length,
+                        dd_version)
                 )
                 n = len(room)
                 if n == 0:
@@ -238,6 +262,19 @@ class IngestBuffer:
         self._slab += b"".join(
             blob[o : o + l] for o, l in zip(starts.tolist(), lens.tolist())
         )
+        # DD extension bytes (SVC): appended after the payload bytes.
+        dmask = dd_start[keep] >= 0
+        if dmask.any():
+            dstarts = dd_start[keep][dmask].astype(np.int64)
+            dlens = dd_length[keep][dmask].astype(np.int64)
+            doffs = len(self._slab) + np.r_[np.int64(0), np.cumsum(dlens[:-1])]
+            didx = (r_[dmask], t_[dmask], k_[dmask])
+            self.dd_off[didx] = doffs
+            self.dd_len[didx] = dlens
+            self.dd_ver[didx] = dd_version[keep][dmask]
+            self._slab += b"".join(
+                blob[o : o + l] for o, l in zip(dstarts.tolist(), dlens.tolist())
+            )
         # New per-group counts (capped at K).
         uniq_rt = sorted_rt[grp_start]
         self._count.reshape(-1)[uniq_rt] = np.minimum(
@@ -375,11 +412,17 @@ class IngestBuffer:
             off=self.pay_off.copy(),
             length=self.pay_len.copy(),
             marker=self.marker.copy(),
+            dd_off=self.dd_off.copy(),
+            dd_len=self.dd_len.copy(),
+            dd_ver=self.dd_ver.copy(),
         )
         self._slab.clear()
         self.pay_off[:] = -1
         self.pay_len[:] = 0
         self.marker[:] = False
+        self.dd_off[:] = -1
+        self.dd_len[:] = 0
+        self.dd_ver[:] = -1
         self._count[:] = 0
         self.valid[:] = False
         self.audio_level[:] = 127

@@ -55,6 +55,7 @@ class EgressPacket:
     payload: bytes
     marker: bool = False
     padding: bool = False  # probe padding (RTP P-bit; no media payload)
+    dd: bytes = b""       # dependency-descriptor ext bytes (SVC tracks)
 
 
 @dataclass
@@ -97,6 +98,7 @@ class EgressBatch:
                     size=len(payload),
                     payload=payload,
                     marker=marker,
+                    dd=self.payloads.get_dd(r, t, k),
                 )
             )
         return out
@@ -390,6 +392,7 @@ class PlaneRuntime:
                     ts=int(rts[r, s, m]) & 0xFFFFFFFF,
                     pid=pid, tl0=tl0, keyidx=keyidx,
                     size=len(payload), payload=payload, marker=marker,
+                    dd=slab.get_dd(int(r), t, k),
                 )
             )
         return replays

@@ -36,6 +36,8 @@ OPUS_PT = 111
 RED_PT = 63           # RFC 2198 redundancy for Opus (redreceiver.go seat)
 AUDIO_LEVEL_EXT_ID = 1
 PLAYOUT_DELAY_EXT_ID = 6  # one-byte ext id for playout-delay (playoutdelay.go)
+DD_EXT_ID = 8             # dependency-descriptor ext id (sfu/dependencydescriptor)
+SVC_PT = 98               # single-stream SVC video (VP9/AV1) payload type
 
 # Subscriber address punch: a client proves it owns the address it wants
 # media sent to by sending this magic + its 32-bit punch id from that
@@ -150,6 +152,28 @@ def parse_sr(chunk: bytes):
     )
 
 
+def build_ext_section(exts: list[tuple[int, bytes]]) -> bytes:
+    """Serialize an RTP header-extension section (RFC 8285): one-byte
+    profile when every element fits, two-byte otherwise (DD structures
+    exceed the one-byte form's 16-byte cap on keyframes)."""
+    two_byte = any(len(d) > 16 or len(d) == 0 or i > 14 for i, d in exts)
+    body = bytearray()
+    if two_byte:
+        profile = 0x1000
+        for i, d in exts:
+            body += bytes([i, len(d)]) + d
+    else:
+        profile = 0xBEDE
+        for i, d in exts:
+            body += bytes([(i << 4) | (len(d) - 1)]) + d
+    body += bytes((-len(body)) % 4)
+    return (
+        profile.to_bytes(2, "big")
+        + (len(body) // 4).to_bytes(2, "big")
+        + bytes(body)
+    )
+
+
 def build_rr(sender_ssrc: int, media_ssrc: int, fraction_lost: int) -> bytes:
     """Receiver report with one block carrying only fraction_lost (the
     upstream loss signal of medialossproxy.go → buffer
@@ -218,6 +242,8 @@ class SSRCBinding:
     is_video: bool
     layer: int = 0       # simulcast spatial layer carried by this SSRC
     session: MediaCryptoSession | None = None  # publisher's crypto session
+    svc: bool = False    # single-stream SVC (VP9/AV1): layers ride the
+                         # dependency-descriptor extension, not SSRCs
 
 
 class UDPMediaTransport(asyncio.DatagramProtocol):
@@ -275,6 +301,7 @@ class UDPMediaTransport(asyncio.DatagramProtocol):
         self._egress_ssrc_arr = np.zeros((R, S, T), np.uint32)
         self._track_pt = np.full((R, T), OPUS_PT, np.uint8)
         self._track_is_video = np.zeros((R, T), bool)
+        self._track_svc = np.zeros((R, T), bool)
         self._txsr_pkts = np.zeros((R, S, T), np.int64)
         self._txsr_oct = np.zeros((R, S, T), np.int64)
         self._txsr_ts = np.zeros((R, S, T), np.uint32)
@@ -292,6 +319,15 @@ class UDPMediaTransport(asyncio.DatagramProtocol):
         # fraction_lost per audio track, relayed upstream ~1/s so the
         # publisher's Opus encoder can enable FEC.
         self._down_frac_lost: dict[tuple, int] = {}  # (room, track) → byte
+        # SVC (VP9/AV1) dependency-descriptor state: per-track structure
+        # cache (structures ride keyframes only; runtime/dd.py parses) —
+        # packets between keyframes resolve layers via the cached table.
+        self._svc_tracks: set[tuple] = set()
+        # (room, track) → [(version, Structure), ...] (last 2 kept):
+        # staged packets are stamped with the version they were parsed
+        # under, so egress patching one tick later never mixes an old
+        # packet with a newer structure's field widths.
+        self._dd_structs: dict[tuple, list] = {}
         self.stats = {
             "rx": 0, "tx": 0, "unknown_ssrc": 0, "parse_errors": 0,
             "addr_mismatch": 0, "bad_punch": 0,
@@ -311,7 +347,7 @@ class UDPMediaTransport(asyncio.DatagramProtocol):
 
     def assign_ssrc(
         self, room: int, track: int, is_video: bool, layer: int = 0,
-        session: MediaCryptoSession | None = None,
+        session: MediaCryptoSession | None = None, svc: bool = False,
     ) -> int:
         """Bind a fresh SSRC to one (track, simulcast layer); sent back in
         signal. Simulcast publishers get one SSRC per layer, matching the
@@ -319,9 +355,14 @@ class UDPMediaTransport(asyncio.DatagramProtocol):
         `session` pins the SSRC to its publisher's crypto session: media
         sealed under any other key is rejected even if the SSRC matches."""
         ssrc = self._new_ssrc()
-        self.bindings[ssrc] = SSRCBinding(room, track, is_video, layer, session)
+        self.bindings[ssrc] = SSRCBinding(room, track, is_video, layer, session, svc)
         self.track_kind[(room, track)] = is_video
-        self._track_pt[room, track] = VP8_PT if is_video else OPUS_PT
+        if svc:
+            self._svc_tracks.add((room, track))
+            self._track_svc[room, track] = True
+        self._track_pt[room, track] = (
+            SVC_PT if svc else VP8_PT if is_video else OPUS_PT
+        )
         self._track_is_video[room, track] = is_video
         return ssrc
 
@@ -374,6 +415,14 @@ class UDPMediaTransport(asyncio.DatagramProtocol):
             s for s, b in self.bindings.items() if b.room == room and b.track == track
         ]:
             self.release_ssrc(ssrc)
+        # SVC/RED state must not leak to the column's next tenant (a new
+        # publisher would inherit the wrong DD template table).
+        self._svc_tracks.discard((room, track))
+        self._dd_structs.pop((room, track), None)
+        self._red_ring.pop((room, track), None)
+        self._track_pt[room, track] = OPUS_PT
+        self._track_is_video[room, track] = False
+        self._track_svc[room, track] = False
 
     def set_track_kind(self, room: int, track: int, is_video: bool) -> None:
         """Record media kind for egress PT selection (any transport)."""
@@ -465,6 +514,10 @@ class UDPMediaTransport(asyncio.DatagramProtocol):
         self.sub_red = {k for k in self.sub_red if k[0] != room}
         for key in [k for k in self._red_ring if k[0] == room]:
             del self._red_ring[key]
+        self._svc_tracks = {k for k in self._svc_tracks if k[0] != room}
+        self._track_svc[room] = False
+        for key in [k for k in self._dd_structs if k[0] == room]:
+            del self._dd_structs[key]
         for key in [k for k in self._ts_delta if k[0] == room]:
             del self._ts_delta[key]
         for key in [k for k in self.sub_sessions if k[0] == room]:
@@ -789,6 +842,7 @@ class UDPMediaTransport(asyncio.DatagramProtocol):
         parsed = rtp.parse_batch(
             blob, offsets, lengths,
             audio_level_ext=AUDIO_LEVEL_EXT_ID, vp8_pts={VP8_PT},
+            dd_ext_id=DD_EXT_ID if self._svc_tracks else 0,
         )
 
         # RED-publishing clients (pt 63): strip to the primary block before
@@ -820,6 +874,7 @@ class UDPMediaTransport(asyncio.DatagramProtocol):
         u_track = np.zeros(U, np.int32)
         u_layer = np.zeros(U, np.int32)
         u_video = np.zeros(U, bool)
+        u_svc = np.zeros(U, bool)
         u_keyed = np.zeros(U, bool)
         u_sess = np.full(U, -1, np.int64)     # bound session's index this flush
         u_aligned = np.zeros(U, bool)
@@ -834,6 +889,7 @@ class UDPMediaTransport(asyncio.DatagramProtocol):
             u_track[j] = b.track
             u_layer[j] = b.layer
             u_video[j] = b.is_video
+            u_svc[j] = b.svc
             if b.session is not None:
                 u_keyed[j] = True
                 u_sess[j] = sess_map.get(id(b.session), -1)
@@ -909,17 +965,60 @@ class UDPMediaTransport(asyncio.DatagramProtocol):
             ts = np.where(aligned, (raw_ts - u_delta[e_inv]) & 0xFFFFFFFF, raw_ts)
             kf = parsed["keyframe"][idx].astype(bool)
             is_vid = u_video[e_inv]
+            layer = u_layer[e_inv].copy()
+            temporal = parsed["tid"][idx].astype(np.int32)
+            begin_pic = parsed["begin_pic"][idx].astype(bool)
+            layer_sync = parsed["layer_sync"][idx].astype(bool)
+            dd_start = np.full(len(idx), -1, np.int64)
+            dd_length = np.zeros(len(idx), np.int32)
+            dd_ver = np.full(len(idx), -1, np.int32)
+            svc_dd = np.nonzero(u_svc[e_inv] & (parsed["dd_off"][idx] >= 0))[0]
+            if len(svc_dd):
+                from livekit_server_tpu.runtime import dd as dd_mod
+
+                for j in svc_dd:
+                    i = idx[j]
+                    key = (int(u_room[e_inv[j]]), int(u_track[e_inv[j]]))
+                    raw = blob[
+                        int(parsed["dd_off"][i]) :
+                        int(parsed["dd_off"][i]) + int(parsed["dd_len"][i])
+                    ]
+                    hist = self._dd_structs.get(key)
+                    struct = hist[-1][1] if hist else None
+                    ver = hist[-1][0] if hist else -1
+                    try:
+                        desc = (
+                            dd_mod.parse(raw) if struct is None
+                            else dd_mod.parse_with_structure(raw, struct)
+                        )
+                    except ValueError:
+                        continue  # malformed/needs-structure: keep defaults
+                    if desc.structure is not None:
+                        struct = desc.structure
+                        ver += 1
+                        hist = (hist or []) + [(ver, struct)]
+                        self._dd_structs[key] = hist[-2:]
+                        kf[j] = True            # structures ride keyframes
+                        layer_sync[j] = True
+                    if struct is not None:
+                        sp, tp = desc.layer(struct)
+                        layer[j] = sp
+                        temporal[j] = tp
+                    begin_pic[j] = desc.first_packet_in_frame
+                    dd_start[j] = int(parsed["dd_off"][i])
+                    dd_length[j] = int(parsed["dd_len"][i])
+                    dd_ver[j] = ver
             self.ingest.push_batch(
                 room=u_room[e_inv],
                 track=u_track[e_inv],
-                layer=u_layer[e_inv],
+                layer=layer,
                 sn=sn_arr[idx].astype(np.int64),
                 ts=ts,
                 ts_aligned=aligned,
-                temporal=parsed["tid"][idx].astype(np.int32),
+                temporal=temporal,
                 keyframe=kf,
-                layer_sync=parsed["layer_sync"][idx].astype(bool) | kf,
-                begin_pic=parsed["begin_pic"][idx].astype(bool),
+                layer_sync=layer_sync | kf,
+                begin_pic=begin_pic,
                 marker=parsed["marker"][idx].astype(bool),
                 pid=np.maximum(parsed["picture_id"][idx], 0),
                 tl0=np.maximum(parsed["tl0picidx"][idx], 0),
@@ -932,6 +1031,9 @@ class UDPMediaTransport(asyncio.DatagramProtocol):
                 + parsed["payload_off"][idx].astype(np.int64),
                 pay_length=plen[idx],
                 blob=blob,
+                dd_start=dd_start,
+                dd_length=dd_length,
+                dd_version=dd_ver,
             )
         self._send_upstream_nacks(now_ms)
 
@@ -981,7 +1083,7 @@ class UDPMediaTransport(asyncio.DatagramProtocol):
                 if addr is not None:
                     self._sendto(build_rr(self.node_ssrc, ssrc, frac), addr, b.session)
 
-    def send_egress_batch(self, batch, red_plan=None) -> np.ndarray:
+    def send_egress_batch(self, batch, red_plan=None, layer_caps=None) -> np.ndarray:
         """Vectorized tick egress (the hot half of DownTrack.WriteRTP +
         pion/srtp + pacer socket writes): per-entry field arrays are
         assembled with numpy index math and handed to ONE native call that
@@ -1050,6 +1152,7 @@ class UDPMediaTransport(asyncio.DatagramProtocol):
         idx = np.nonzero((e_port != 0) & (po >= 0) & ~red_mask)[0]
         if len(idx):
             rr_, tt_, ss_ = r[idx], t[idx], s[idx]
+            kk_ = k[idx]
             ssrc = self._egress_ssrc_arr[rr_, ss_, tt_].copy()
             for m_ in np.nonzero(ssrc == 0)[0]:  # first tick of a new sub only
                 ssrc[m_] = self.subscriber_ssrc(int(rr_[m_]), int(ss_[m_]), int(tt_[m_]))
@@ -1091,15 +1194,10 @@ class UDPMediaTransport(asyncio.DatagramProtocol):
                 np.array([x.key_id for x in sessions], np.uint32)
                 if sessions else np.zeros(1, np.uint32)
             )
-            pd = None
-            if self.playout_delay is not None:
-                mn, mx = self.playout_delay
-                # Clamp to the extension's 12-bit fields (playoutdelay.go).
-                val = np.uint32(
-                    (min(mn // 10, 4095) << 12) | min(mx // 10, 4095)
-                )
-                pd = np.where(self._track_is_video[rr_, tt_], val, 0).astype(
-                    np.uint32
+            ext_blob, ext_off, ext_len = b"", None, None
+            if self.playout_delay is not None or self._svc_tracks:
+                ext_blob, ext_off, ext_len = self._build_ext_sections(
+                    batch, rr_, tt_, kk_, ss_, layer_caps
                 )
             fd = self.transport.get_extra_info("socket").fileno()
             _, _, _, sent = native_egress.send(
@@ -1108,7 +1206,9 @@ class UDPMediaTransport(asyncio.DatagramProtocol):
                 pay_off=po[idx], pay_len=pl[idx],
                 marker=batch.payloads.marker[r, t, k][idx].astype(np.uint8),
                 pt=self._track_pt[rr_, tt_],
-                vp8=self._track_is_video[rr_, tt_].astype(np.uint8),
+                vp8=(
+                    self._track_is_video[rr_, tt_] & ~self._track_svc[rr_, tt_]
+                ).astype(np.uint8),
                 sn=(batch.sn[idx] & 0xFFFF).astype(np.uint16),
                 ts=(batch.ts[idx].astype(np.int64) & 0xFFFFFFFF).astype(np.uint32),
                 ssrc=ssrc,
@@ -1116,7 +1216,7 @@ class UDPMediaTransport(asyncio.DatagramProtocol):
                 ip=u_ip[inv][idx], port=e_port[idx],
                 seal=seal.astype(np.uint8), key_idx=key_idx,
                 keys=keys, key_ids=key_ids, counters=ctr,
-                pd=pd, pd_ext_id=PLAYOUT_DELAY_EXT_ID,
+                ext_blob=ext_blob, ext_off=ext_off, ext_len=ext_len,
             )
             self.stats["tx"] += sent
             if sent < len(idx):
@@ -1146,6 +1246,99 @@ class UDPMediaTransport(asyncio.DatagramProtocol):
             self.send_egress(batch.to_packets(e_tcp & (po >= 0)))
         self._send_srs(now_ms)
         return has_dest
+
+    def _build_ext_sections(self, batch, rr_, tt_, kk_, ss_, layer_caps):
+        """Per-entry RTP header-extension sections for the native builder:
+        playout delay on video, and for SVC entries the re-attached
+        dependency descriptor (sfu/dependencydescriptor) with the
+        active-decode-targets bitmask patched to the subscriber's layer
+        caps (videolayerselector/dependencydescriptor.go:65 selection →
+        writer :254 bitmask rewrite). Sections are deduped per
+        (source packet, mask) — subscribers with identical caps share
+        bytes."""
+        from livekit_server_tpu.runtime import dd as dd_mod
+
+        n = len(rr_)
+        off = np.zeros(n, np.int64)
+        ln = np.zeros(n, np.int32)
+        parts: list[bytes] = []
+        total = 0
+        pd_bytes = b""
+        pd_section_off = -1
+        if self.playout_delay is not None:
+            mn, mx = self.playout_delay
+            # Clamp to the extension's 12-bit fields (playoutdelay.go).
+            val = (min(mn // 10, 4095) << 12) | min(mx // 10, 4095)
+            pd_bytes = val.to_bytes(3, "big")
+            sec = build_ext_section([(PLAYOUT_DELAY_EXT_ID, pd_bytes)])
+            parts.append(sec)
+            pd_section_off = 0
+            total += len(sec)
+
+        is_vid = self._track_is_video[rr_, tt_]
+        dd_offs = batch.payloads.dd_off
+        if dd_offs is not None:
+            has_dd = dd_offs[rr_, tt_, kk_] >= 0
+        else:
+            has_dd = np.zeros(n, bool)
+        if pd_section_off >= 0:
+            m = is_vid & ~has_dd
+            off[m] = pd_section_off
+            ln[m] = len(parts[0])
+
+        if has_dd.any():
+            max_sp, max_tp = layer_caps if layer_caps is not None else (None, None)
+            data = batch.payloads.data
+            cache: dict = {}
+            dt_layers_cache: dict = {}
+            dd_vers = batch.payloads.dd_ver
+            for i in np.nonzero(has_dd)[0]:
+                rr, tt, kk, ss = int(rr_[i]), int(tt_[i]), int(kk_[i]), int(ss_[i])
+                ver = int(dd_vers[rr, tt, kk]) if dd_vers is not None else -1
+                struct = None
+                for v, st in self._dd_structs.get((rr, tt), ()):  # last 2
+                    if v == ver:
+                        struct = st
+                        break
+                mask = None
+                if struct is not None and max_sp is not None:
+                    layers = dt_layers_cache.get(id(struct))
+                    if layers is None:
+                        layers = dt_layers_cache[id(struct)] = (
+                            struct.decode_target_layers()
+                        )
+                    sp_cap = int(max_sp[rr, tt, ss])
+                    tp_cap = int(max_tp[rr, tt, ss])
+                    mask = 0
+                    for d_i, (sp, tp) in enumerate(layers):
+                        if sp <= sp_cap and tp <= tp_cap:
+                            mask |= 1 << d_i
+                ck = (rr, tt, kk, mask)
+                hit = cache.get(ck)
+                if hit is None:
+                    o = int(dd_offs[rr, tt, kk])
+                    raw = data[o : o + int(batch.payloads.dd_len[rr, tt, kk])]
+                    if (
+                        struct is not None
+                        and mask is not None
+                        and mask != (1 << struct.num_decode_targets) - 1
+                    ):
+                        try:
+                            desc = dd_mod.parse_with_structure(raw, struct)
+                            buf = bytearray(raw)
+                            if dd_mod.patch_active_mask(buf, 0, desc, mask):
+                                raw = bytes(buf)
+                        except ValueError:
+                            pass  # unparseable DD forwards unmodified
+                    exts = [(DD_EXT_ID, raw)]
+                    if pd_bytes:
+                        exts.append((PLAYOUT_DELAY_EXT_ID, pd_bytes))
+                    sec = build_ext_section(exts)
+                    hit = cache[ck] = (total, len(sec))
+                    parts.append(sec)
+                    total += len(sec)
+                off[i], ln[i] = hit
+        return b"".join(parts), off, ln
 
     def _send_red(self, batch, red_plan, red_mask, po, pl, now_ms) -> None:
         """RFC 2198 encapsulation for RED subscribers (redreceiver.go):
@@ -1244,16 +1437,32 @@ class UDPMediaTransport(asyncio.DatagramProtocol):
             if addr is None or (not pkt.payload and not is_padding):
                 continue
             is_video = self.track_kind.get((pkt.room, pkt.track), False)
+            is_svc = bool(self._track_svc[pkt.room, pkt.track])
             header = bytearray(12)
             header[0] = 0x80 | (0x20 if is_padding else 0)  # P bit on padding
-            header[1] = (0x80 if pkt.marker else 0) | (VP8_PT if is_video else OPUS_PT)
+            header[1] = (0x80 if pkt.marker else 0) | (
+                SVC_PT if is_svc else VP8_PT if is_video else OPUS_PT
+            )
+            # Header extensions on this cold path too: DD for SVC packets
+            # (unpatched — per-sub mask rewrite is the batch path's job)
+            # and playout delay on video.
+            exts = []
+            if getattr(pkt, "dd", b"") and not is_padding:
+                exts.append((DD_EXT_ID, pkt.dd))
+            if self.playout_delay is not None and is_video and not is_padding:
+                mn, mx = self.playout_delay
+                val = (min(mn // 10, 4095) << 12) | min(mx // 10, 4095)
+                exts.append((PLAYOUT_DELAY_EXT_ID, val.to_bytes(3, "big")))
+            ext = build_ext_section(exts) if exts else b""
+            if ext:
+                header[0] |= 0x10
             # Probe padding carries a pure pad run: N-1 zeros + the pad
             # length byte (WritePaddingRTP's wire shape, downtrack.go:764).
             payload = pkt.payload if pkt.payload else PAD_RUN
             n_pad_sent += is_padding
             offsets.append(len(buf))
-            buf += header + payload
-            lengths.append(12 + len(payload))
+            buf += header + ext + payload
+            lengths.append(12 + len(ext) + len(payload))
             sns.append(pkt.sn)
             tss.append(pkt.ts)
             ssrcs.append(self.subscriber_ssrc(pkt.room, pkt.sub, pkt.track))
@@ -1261,7 +1470,7 @@ class UDPMediaTransport(asyncio.DatagramProtocol):
             # (codecmunger/vp8.go:161): after a simulcast switch or
             # temporal drop, receivers need contiguous picture ids.
             # Padding has no descriptor to rewrite.
-            has_vp8 = is_video and not is_padding
+            has_vp8 = is_video and not is_padding and not is_svc
             pids.append(pkt.pid if has_vp8 else -1)
             tl0s.append(pkt.tl0 if has_vp8 else -1)
             keyidxs.append(pkt.keyidx if has_vp8 else -1)

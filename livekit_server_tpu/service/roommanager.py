@@ -375,7 +375,11 @@ class RoomManager:
             # UDP-destined entry; only WS-destined entries materialize as
             # Python packet objects.
             handled = self.udp.send_egress_batch(
-                res.egress_batch, red_plan=(res.red_sn, res.red_off, res.red_ok)
+                res.egress_batch,
+                red_plan=(res.red_sn, res.red_off, res.red_ok),
+                layer_caps=(
+                    self.runtime.ctrl.max_spatial, self.runtime.ctrl.max_temporal
+                ),
             )
             if res.replays:
                 self.udp.send_egress(res.replays, rtx=True)  # NACK retransmits

@@ -68,10 +68,12 @@ struct Args {
   const uint8_t* marker;
   const uint8_t* pt;
   const uint8_t* vp8;
-  // Playout-delay header extension (rtpextension/playoutdelay.go):
-  // per-entry packed (min_10ms << 12) | max_10ms; 0 = no extension.
-  const uint32_t* pd;
-  int pd_ext_id;
+  // Pre-serialized RTP header-extension section per entry (profile +
+  // length + elements + padding, built host-side: playout delay,
+  // dependency descriptor, or both). ext_len 0 = no extension.
+  const uint8_t* ext_blob;
+  const int64_t* ext_off;
+  const int32_t* ext_len;
   const uint16_t* sn;
   const uint32_t* ts;
   const uint32_t* ssrc;
@@ -147,11 +149,11 @@ int64_t worker(const Args& a, int lo, int hi) {
   for (int i = lo; i < hi; i++) {
     uint8_t* dst = a.out + a.out_off[i];
     int plen = a.pay_len[i];
-    int ext_len = a.pd[i] ? 8 : 0;  // BEDE header (4) + one-byte ext (4)
+    int ext_len = a.ext_len[i];
     int hdr_len = 12 + ext_len;
     int clear_len = hdr_len + plen;
     bool sealed = a.seal[i] && a.key_idx[i] >= 0;
-    if (plen < 0 || (sealed && clear_len > MAX_DGRAM)) {
+    if (plen < 0 || ext_len < 0 || (sealed && clear_len > MAX_DGRAM)) {
       // The sealed path stages cleartext in a fixed stack scratch; an
       // attacker-sized jumbo datagram must be refused, never overflowed.
       a.skip[i] = 1;
@@ -163,14 +165,7 @@ int64_t worker(const Args& a, int lo, int hi) {
     be16(build + 2, a.sn[i]);
     be32(build + 4, a.ts[i]);
     be32(build + 8, a.ssrc[i]);
-    if (ext_len) {
-      // RFC 8285 one-byte extension carrying the 24-bit playout delay.
-      build[12] = 0xBE; build[13] = 0xDE; build[14] = 0; build[15] = 1;
-      build[16] = (uint8_t)((a.pd_ext_id << 4) | 2);  // len-1 = 2 → 3 bytes
-      build[17] = (a.pd[i] >> 16) & 0xFF;
-      build[18] = (a.pd[i] >> 8) & 0xFF;
-      build[19] = a.pd[i] & 0xFF;
-    }
+    if (ext_len) std::memcpy(build + 12, a.ext_blob + a.ext_off[i], ext_len);
     std::memcpy(build + hdr_len, a.slab + a.pay_off[i], plen);
     if (a.vp8[i]) patch_vp8(build + hdr_len, plen, a.pid[i], a.tl0[i], a.kidx[i]);
 
@@ -255,7 +250,8 @@ extern "C" {
 int64_t egress_batch_send(
     int fd, int n_threads, const uint8_t* slab, int32_t n,
     const int64_t* pay_off, const int32_t* pay_len, const uint8_t* marker,
-    const uint8_t* pt, const uint8_t* vp8, const uint32_t* pd, int pd_ext_id,
+    const uint8_t* pt, const uint8_t* vp8,
+    const uint8_t* ext_blob, const int64_t* ext_off, const int32_t* ext_len,
     const uint16_t* sn,
     const uint32_t* ts, const uint32_t* ssrc, const int32_t* pid,
     const int32_t* tl0, const int32_t* kidx, const uint32_t* ip,
@@ -264,7 +260,8 @@ int64_t egress_batch_send(
     uint8_t* out, const int64_t* out_off, const int32_t* out_len) {
   if (n <= 0) return 0;
   std::vector<uint8_t> skip(n, 0);
-  Args a{skip.data(), slab, pay_off, pay_len, marker, pt,   vp8, pd, pd_ext_id,
+  Args a{skip.data(), slab, pay_off, pay_len, marker, pt, vp8,
+         ext_blob, ext_off, ext_len,
          sn,  ts,
          ssrc,  pid,     tl0,     kidx,   ip,       port,    seal, key_idx,
          keys,  key_ids, counters, out,   out_off,  out_len, fd};

@@ -36,6 +36,12 @@ struct ParsedPacket {
   int32_t picture_id;    // -1 if absent
   int32_t tl0picidx;     // -1 if absent
   int32_t keyidx;        // -1 if absent
+  // AV1 dependency-descriptor header extension (RFC 8285), when
+  // dd_ext_id > 0: offset/length of the DD payload within the batch
+  // buffer (-1/0 when absent). Descriptor decode is host-side
+  // (runtime/dd.py) — structures arrive only on keyframes.
+  int32_t dd_off;
+  int32_t dd_len;
 };
 
 // Parse `n` datagrams packed back-to-back in `buf`; `offsets`/`lengths`
@@ -45,7 +51,8 @@ struct ParsedPacket {
 // Returns the number of successfully parsed packets.
 int parse_rtp_batch(const uint8_t* buf, const int32_t* offsets,
                     const int32_t* lengths, int n, int audio_level_ext,
-                    const uint8_t* vp8_pt_mask, ParsedPacket* out) {
+                    const uint8_t* vp8_pt_mask, ParsedPacket* out,
+                    int dd_ext_id) {
   int ok = 0;
   for (int i = 0; i < n; i++) {
     const uint8_t* p = buf + offsets[i];
@@ -57,6 +64,7 @@ int parse_rtp_batch(const uint8_t* buf, const int32_t* offsets,
     o.tl0picidx = -1;
     o.keyidx = -1;
     o.payload_len = -1;
+    o.dd_off = -1;
     if (len < 12) continue;
     uint8_t v = p[0] >> 6;
     if (v != 2) continue;
@@ -80,7 +88,7 @@ int parse_rtp_batch(const uint8_t* buf, const int32_t* offsets,
       int ext_len = ext_words * 4;
       int ext_off = off + 4;
       if (ext_off + ext_len > len) continue;
-      if (profile == 0xBEDE && audio_level_ext > 0) {
+      if (profile == 0xBEDE) {
         // RFC 8285 one-byte header extensions.
         int q = ext_off;
         int end = ext_off + ext_len;
@@ -91,11 +99,35 @@ int parse_rtp_batch(const uint8_t* buf, const int32_t* offsets,
           int elen = (b & 0x0F) + 1;
           if (id == 15) break;
           if (q + 1 + elen > end) break;
-          if (id == audio_level_ext && elen >= 1) {
+          if (audio_level_ext > 0 && id == audio_level_ext && elen >= 1) {
             o.voice = p[q + 1] >> 7;
             o.audio_level = p[q + 1] & 0x7F;
           }
+          if (dd_ext_id > 0 && id == dd_ext_id) {
+            o.dd_off = offsets[i] + q + 1;
+            o.dd_len = elen;
+          }
           q += 1 + elen;
+        }
+      } else if ((profile & 0xFFF0) == 0x1000) {
+        // RFC 8285 two-byte header extensions (DD structures can exceed
+        // the one-byte form's 16-byte data cap).
+        int q = ext_off;
+        int end = ext_off + ext_len;
+        while (q + 1 < end) {
+          uint8_t id = p[q];
+          if (id == 0) { q++; continue; }  // padding
+          int elen = p[q + 1];
+          if (q + 2 + elen > end) break;
+          if (audio_level_ext > 0 && id == audio_level_ext && elen >= 1) {
+            o.voice = p[q + 2] >> 7;
+            o.audio_level = p[q + 2] & 0x7F;
+          }
+          if (dd_ext_id > 0 && id == dd_ext_id) {
+            o.dd_off = offsets[i] + q + 2;
+            o.dd_len = elen;
+          }
+          q += 2 + elen;
         }
       }
       off = ext_off + ext_len;

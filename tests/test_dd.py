@@ -1,0 +1,101 @@
+"""Dependency-descriptor parse/patch round trips.
+
+Reference parity: pkg/sfu/dependencydescriptor/dependencydescriptor
+extension_test.go — parse mandatory + extended + template structure,
+active-decode-targets bitmask location and in-place rewrite.
+"""
+
+import pytest
+
+from livekit_server_tpu.runtime import dd
+
+
+def l2t2_structure():
+    # 2 spatial x 2 temporal, 4 decode targets (dt = sid*2+tid), one
+    # template per layer, simple fdiffs + chains.
+    templates = [
+        dd.Template(spatial=0, temporal=0, dtis=[3, 2, 3, 2], fdiffs=[4],
+                    chain_diffs=[4, 0]),
+        dd.Template(spatial=0, temporal=1, dtis=[0, 3, 0, 2], fdiffs=[2],
+                    chain_diffs=[2, 2]),
+        dd.Template(spatial=1, temporal=0, dtis=[0, 0, 3, 2], fdiffs=[1, 4],
+                    chain_diffs=[1, 1]),
+        dd.Template(spatial=1, temporal=1, dtis=[0, 0, 0, 3], fdiffs=[2, 1],
+                    chain_diffs=[2, 1]),
+    ]
+    return dd.Structure(
+        structure_id=3, num_decode_targets=4, templates=templates,
+        num_chains=2, protected_by=[0, 0, 1, 1],
+        resolutions=[(640, 360), (1280, 720)],
+    )
+
+
+def test_mandatory_only_roundtrip():
+    raw = dd.build(True, False, template_id=5, frame_number=0xBEEF)
+    assert len(raw) == 3
+    d = dd.parse(raw)
+    assert d.first_packet_in_frame and not d.last_packet_in_frame
+    assert d.template_id == 5 and d.frame_number == 0xBEEF
+    assert d.structure is None and d.active_mask is None
+
+
+def test_structure_roundtrip_and_layers():
+    s = l2t2_structure()
+    raw = dd.build(True, True, template_id=3, frame_number=7, structure=s)
+    d = dd.parse(raw)
+    assert d.structure is not None
+    got = d.structure
+    assert got.structure_id == 3 and got.num_decode_targets == 4
+    assert [(t.spatial, t.temporal) for t in got.templates] == [
+        (0, 0), (0, 1), (1, 0), (1, 1)
+    ]
+    assert [t.dtis for t in got.templates] == [t.dtis for t in s.templates]
+    assert [t.fdiffs for t in got.templates] == [t.fdiffs for t in s.templates]
+    assert got.num_chains == 2 and got.protected_by == [0, 0, 1, 1]
+    assert got.resolutions == [(640, 360), (1280, 720)]
+    # Structure attach => all decode targets active.
+    assert d.active_mask == 0b1111
+    # dt -> max (spatial, temporal) map for the selector.
+    assert got.decode_target_layers() == [(0, 0), (0, 1), (1, 0), (1, 1)]
+    # Packet layer via template id (relative to structure_id).
+    assert d.layer(got) == (0, 0)
+    d2 = dd.parse(dd.build(True, True, template_id=4, frame_number=8))
+    assert d2.layer(got) == (0, 1)   # relative index 4-3 = 1
+    d3 = dd.parse(dd.build(True, True, template_id=5, frame_number=9))
+    assert d3.layer(got) == (1, 0)   # relative index 2
+
+
+def test_active_mask_needs_structure_and_patch():
+    s = l2t2_structure()
+    raw = dd.build(False, True, template_id=4, frame_number=9,
+                   active_mask=0b1111, mask_bits=4)
+    with pytest.raises(dd.NeedStructure):
+        dd.parse(raw)
+    d = dd.parse_with_structure(raw, s)
+    assert d.active_mask == 0b1111 and d.active_mask_bit_off > 0
+
+    # In-place restriction to spatial 0 only (targets 0,1).
+    buf = bytearray(raw)
+    assert dd.patch_active_mask(buf, 0, d, 0b0011)
+    d3 = dd.parse_with_structure(bytes(buf), s)
+    assert d3.active_mask == 0b0011
+    # Everything else untouched.
+    assert d3.template_id == 4 and d3.frame_number == 9
+
+
+def test_mask_patch_with_structure_packet():
+    s = l2t2_structure()
+    raw = dd.build(True, True, template_id=3, frame_number=1, structure=s,
+                   active_mask=0b1111, mask_bits=4)
+    d = dd.parse(raw)
+    assert d.active_mask == 0b1111 and d.active_mask_bit_off > 0
+    buf = bytearray(raw)
+    assert dd.patch_active_mask(buf, 0, d, 0b0101)
+    assert dd.parse(bytes(buf)).active_mask == 0b0101
+
+
+def test_truncated_dd_rejected():
+    s = l2t2_structure()
+    raw = dd.build(True, True, template_id=3, frame_number=7, structure=s)
+    with pytest.raises(ValueError):
+        dd.parse(raw[:5])
