@@ -218,6 +218,11 @@ class KVRouter(LocalRouter):
             if await self.bus.setnx(lock_key, self.local_node.node_id, 5.0):
                 await self.set_node_for_room(room_name, self.local_node.node_id)
                 await self.bus.delete(lock_key)
+                from livekit_server_tpu.utils.logger import log
+
+                log.info("room takeover", room=room_name,
+                         dead_node=dead_node_id[:12],
+                         new_node=self.local_node.node_id[:12])
                 return self.local_node.node_id
             # Lost the race: wait for the winner to release (or for its
             # TTL to lapse if it crashed), then read the new pin.

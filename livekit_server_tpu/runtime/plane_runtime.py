@@ -237,6 +237,9 @@ class PlaneRuntime:
         self.state_lock = asyncio.Lock()
         self._on_tick: list[Callable[[TickResult], Awaitable[None] | None]] = []
         self.stats = {"ticks": 0, "fwd_packets": 0, "fwd_bytes": 0, "late_ticks": 0}
+        from collections import deque
+
+        self.recent_tick_s: deque = deque(maxlen=120)  # /debug/ticks window
         # Single worker: device steps are strictly ordered (donated state).
         from concurrent.futures import ThreadPoolExecutor
 
@@ -352,6 +355,7 @@ class PlaneRuntime:
         result = self._fan_out(out, payloads, inp, time.perf_counter() - t0)
         result.quality_window_closed = roll
         self.tick_index += 1
+        self.recent_tick_s.append(round(result.tick_s, 5))
         self.stats["ticks"] += 1
         self.stats["fwd_packets"] += result.fwd_packets
         self.stats["fwd_bytes"] += result.fwd_bytes

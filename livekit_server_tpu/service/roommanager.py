@@ -77,6 +77,9 @@ class RoomManager:
         from livekit_server_tpu.runtime.crypto import MediaCryptoRegistry
 
         self.crypto = MediaCryptoRegistry()
+        from livekit_server_tpu.utils.logger import Logger
+
+        self.log = Logger()  # server start replaces with a node-scoped one
         self.agents = None  # AgentService; room/publisher job dispatch
         self.runtime.on_tick(self._dispatch_tick)
         self._reaper_task: asyncio.Task | None = None
@@ -113,6 +116,7 @@ class RoomManager:
             await self.router.set_node_for_room(name, self.router.local_node.node_id)
         self._create_locks.pop(name, None)
         self._update_node_stats()
+        self.log.info("room started", room=name, row=room.slots.row)
         self._notify("room_started", room=room.info.to_dict())
         if self.agents is not None:
             # room agent job on room start; publisher job on first publish
@@ -133,6 +137,7 @@ class RoomManager:
         if room is not None:
             self._row_to_room.pop(room.slots.row, None)
             room.close(pm.DisconnectReason.ROOM_DELETED)
+            self.log.info("room finished", room=name)
             self._notify("room_finished", room=room.info.to_dict())
         await self.store.delete_room(name)
         await self.router.clear_room_state(name)
@@ -206,6 +211,7 @@ class RoomManager:
         if participant.client_config is not None:
             join["client_configuration"] = participant.client_config.to_dict()
         participant.send("join", join)
+        self.log.info("participant joined", room=room_name, participant=identity)
         await self.store.store_participant(room_name, participant.to_info())
         self._update_node_stats()
         self._notify(
@@ -246,6 +252,11 @@ class RoomManager:
                 if not participant.disconnected.is_set():
                     room.remove_participant(participant, pm.DisconnectReason.SIGNAL_CLOSE)
                 await self.store.delete_participant(room.name, participant.identity)
+                self.log.info(
+                    "participant left", room=room.name,
+                    participant=participant.identity,
+                    reason=participant.close_reason.name,
+                )
                 self._update_node_stats()
                 self._notify(
                     "participant_left",
@@ -336,6 +347,7 @@ class RoomManager:
             self.rooms.pop(name, None)
             self._row_to_room.pop(room.slots.row, None)
             room.close(pm.DisconnectReason.MIGRATION)
+            self.log.info("room handed off", room=name, target=target_node_id or "unpinned")
         finally:
             # room.close released the row; its next tenant starts unfrozen.
             self.runtime.ingest.frozen_rows.discard(room.slots.row)
@@ -355,10 +367,11 @@ class RoomManager:
             snap = self.runtime.decode_room_snapshot(raw)
             async with self.runtime.state_lock:  # vs. the donated device step
                 self.runtime.restore_room(room.slots.row, snap)
+            self.log.info("room restored from migration snapshot", room=room.name)
         except Exception as e:  # noqa: BLE001 — a bad snapshot (version/
             # dims drift, corruption) must not poison room creation; the
             # room starts fresh instead (a stream reset, not an outage).
-            print(f"room snapshot for {room.name!r} rejected: {e}", flush=True)
+            self.log.warn("room snapshot rejected", room=room.name, error=str(e))
         await bus.delete(f"room_snapshot:{room.name}")
 
     def handle_pli(self, row: int, track_col: int) -> None:

@@ -51,7 +51,12 @@ class LivekitServer:
         self.sip = SIPService(self)
         self.agents = AgentService(self)
         room_manager.agents = self.agents
-        self.app = web.Application()
+        from livekit_server_tpu.utils.logger import Logger, configure
+
+        configure(config.log_level)
+        self.log = Logger(node=router.local_node.node_id[:12])
+        room_manager.log = self.log
+        self.app = web.Application(middlewares=[self._request_hooks])
         self.app.router.add_get("/", self.health)
         self.app.router.add_get("/rtc", self.rtc_service.handle)
         self.app.router.add_get("/rtc/validate", self.validate)
@@ -65,6 +70,8 @@ class LivekitServer:
         self.app.router.add_get("/metrics", self.metrics)
         self.app.router.add_get("/debug/rooms", self.debug_rooms)
         self.app.router.add_get("/debug/analytics", self.debug_analytics)
+        self.app.router.add_get("/debug/tasks", self.debug_tasks)
+        self.app.router.add_get("/debug/ticks", self.debug_ticks)
         self._runner: web.AppRunner | None = None
         self._sites: list[web.TCPSite] = []
         self._stats_task: asyncio.Task | None = None
@@ -112,6 +119,55 @@ class LivekitServer:
         if not claims.video.room_join:
             return web.Response(status=401, text="token lacks roomJoin")
         return web.Response(text="success")
+
+    @web.middleware
+    async def _request_hooks(self, request: web.Request, handler):
+        """Twirp request logging + status metrics (the TwirpLogger /
+        request-status hooks of service/server.go's Twirp server options)."""
+        t0 = time.perf_counter()
+        status = 500
+        try:
+            resp = await handler(request)
+            status = resp.status
+            return resp
+        except web.HTTPException as e:
+            status = e.status
+            raise
+        except asyncio.CancelledError:
+            status = 499  # client went away; not a server error
+            raise
+        finally:
+            if request.path.startswith("/twirp/"):
+                svc = request.path.split("/")[2]
+                method = request.match_info.get("method", "")
+                self.telemetry.add(
+                    "livekit_twirp_requests_total",
+                    service=svc, method=method, status=str(status),
+                )
+                self.log.info(
+                    "twirp", service=svc, method=method, status=status,
+                    dur_ms=round((time.perf_counter() - t0) * 1000.0, 2),
+                )
+
+    async def debug_tasks(self, request: web.Request) -> web.Response:
+        """Asyncio task dump (the pprof goroutine-profile analog, §5.1)."""
+        tasks = []
+        for t in asyncio.all_tasks():
+            tasks.append({
+                "name": t.get_name(),
+                "done": t.done(),
+                "coro": str(getattr(t.get_coro(), "__qualname__", t.get_coro())),
+            })
+        return web.json_response({"count": len(tasks), "tasks": tasks})
+
+    async def debug_ticks(self, request: web.Request) -> web.Response:
+        """Recent tick timing breakdown (§5.1 profiling surface)."""
+        rt = self.room_manager.runtime
+        return web.json_response({
+            "tick_ms": rt.tick_ms,
+            "stats": rt.stats,
+            "recent_tick_s": list(getattr(rt, "recent_tick_s", [])),
+        })
 
     async def metrics(self, request: web.Request) -> web.Response:
         return web.Response(

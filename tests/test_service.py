@@ -350,6 +350,24 @@ async def test_metrics_and_debug():
                 dbg = await r.json()
                 assert "m" in dbg["rooms"]
                 assert dbg["rooms"]["m"]["participants"] == ["alice"]
+            # Twirp request hooks (service/server.go Twirp options): a call
+            # through /twirp shows up in the status counter.
+            from livekit_server_tpu.auth import AccessToken, VideoGrant
+
+            t = AccessToken(API_KEY, API_SECRET)
+            t.grant = VideoGrant(room_list=True)
+            hdr = {"Authorization": f"Bearer {t.to_jwt()}"}
+            base = f"http://127.0.0.1:{server.port}/twirp/livekit.RoomService"
+            async with s.post(f"{base}/ListRooms", json={}, headers=hdr) as r:
+                pass
+            async with s.get(f"http://127.0.0.1:{server.port}/metrics") as r:
+                text = await r.text()
+                assert 'livekit_twirp_requests_total{method="ListRooms"' in text
+            # §5.1 profiling surfaces.
+            async with s.get(f"http://127.0.0.1:{server.port}/debug/tasks") as r:
+                assert (await r.json())["count"] > 0
+            async with s.get(f"http://127.0.0.1:{server.port}/debug/ticks") as r:
+                assert "stats" in await r.json()
             await alice.close()
 
 
