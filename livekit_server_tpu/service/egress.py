@@ -76,40 +76,13 @@ class EgressService:
 
     def __init__(self, server: "LivekitServer"):
         self.server = server
-        self.egresses: dict[str, EgressInfo] = {}
-        self._updates_sub = None
 
-    async def start(self) -> None:
-        """Listen for worker status updates (IOInfoService fan-in seat,
-        pkg/service/ioservice.go)."""
-        bus = getattr(self.server.router, "bus", None)
-        if bus is None:
-            return
-        self._updates_sub = bus.subscribe(self.UPDATES_TOPIC)
-
-        async def worker():
-            async for raw in self._updates_sub:
-                try:
-                    info = EgressInfo.from_dict(json.loads(raw))
-                except (ValueError, TypeError):
-                    continue
-                prev = self.egresses.get(info.egress_id)
-                self.egresses[info.egress_id] = info
-                if prev and prev.status != info.status:
-                    if info.status == EgressStatus.ACTIVE:
-                        self.server.telemetry.notify("egress_started", egress=info.to_dict())
-                    elif info.status in (
-                        EgressStatus.COMPLETE, EgressStatus.FAILED, EgressStatus.ABORTED
-                    ):
-                        self.server.telemetry.notify("egress_ended", egress=info.to_dict())
-
-        import asyncio
-
-        self._worker = asyncio.ensure_future(worker())
-
-    async def stop(self) -> None:
-        if self._updates_sub is not None:
-            self._updates_sub.close()
+    @property
+    def egresses(self) -> dict:
+        """Shared store owned by the IOInfoService aggregator
+        (pkg/service/ioservice.go): the Twirp handlers create/delete
+        entries here and the aggregator's bus worker updates them."""
+        return self.server.ioinfo.egresses
 
     async def handle(self, request: web.Request) -> web.Response:
         from livekit_server_tpu.auth import (

@@ -74,35 +74,13 @@ class IngressService:
 
     def __init__(self, server: "LivekitServer"):
         self.server = server
-        self.ingresses: dict[str, IngressInfo] = {}
-        self._updates_sub = None
 
-    async def start(self) -> None:
-        bus = getattr(self.server.router, "bus", None)
-        if bus is None:
-            return
-        self._updates_sub = bus.subscribe(self.UPDATES_TOPIC)
-        import asyncio
-
-        async def worker():
-            async for raw in self._updates_sub:
-                try:
-                    info = IngressInfo.from_dict(json.loads(raw))
-                except (ValueError, TypeError):
-                    continue
-                prev = self.ingresses.get(info.ingress_id)
-                self.ingresses[info.ingress_id] = info
-                if prev and prev.state != info.state:
-                    if info.state == IngressState.ENDPOINT_PUBLISHING:
-                        self.server.telemetry.notify("ingress_started", ingress=info.to_dict())
-                    elif info.state in (IngressState.ENDPOINT_COMPLETE, IngressState.ENDPOINT_ERROR):
-                        self.server.telemetry.notify("ingress_ended", ingress=info.to_dict())
-
-        self._worker = asyncio.ensure_future(worker())
-
-    async def stop(self) -> None:
-        if self._updates_sub is not None:
-            self._updates_sub.close()
+    @property
+    def ingresses(self) -> dict:
+        """Shared store owned by the IOInfoService aggregator
+        (pkg/service/ioservice.go): the Twirp handlers create/delete
+        entries here and the aggregator's bus worker updates them."""
+        return self.server.ioinfo.ingresses
 
     async def handle(self, request: web.Request) -> web.Response:
         from livekit_server_tpu.auth import (

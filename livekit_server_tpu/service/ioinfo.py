@@ -1,0 +1,94 @@
+"""IOInfoService: the central egress/ingress status aggregator.
+
+Reference parity: pkg/service/ioservice.go — workers report status over
+RPC to ONE aggregator that owns the authoritative EgressInfo/IngressInfo
+stores, fans lifecycle transitions into telemetry/webhooks, and serves
+get/list to the Twirp APIs (CreateEgress :81, UpdateEgress :98,
+UpdateIngressState :180). Here workers publish JSON updates on the
+cluster bus topics; the Twirp services delegate their stores to this
+service instead of each keeping a private copy.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import json
+
+
+class IOInfoService:
+
+    def __init__(self, server):
+        self.server = server
+        self.egresses: dict[str, object] = {}    # egress_id → EgressInfo
+        self.ingresses: dict[str, object] = {}   # ingress_id → IngressInfo
+        self._subs: list = []
+        self._workers: list[asyncio.Task] = []
+
+    async def start(self) -> None:
+        bus = getattr(self.server.router, "bus", None)
+        if bus is None:
+            return
+        from livekit_server_tpu.service.egress import EgressService
+        from livekit_server_tpu.service.ingress import IngressService
+
+        e_sub = bus.subscribe(EgressService.UPDATES_TOPIC)
+        i_sub = bus.subscribe(IngressService.UPDATES_TOPIC)
+        self._subs = [e_sub, i_sub]
+        self._workers = [
+            asyncio.ensure_future(self._egress_worker(e_sub)),
+            asyncio.ensure_future(self._ingress_worker(i_sub)),
+        ]
+
+    async def stop(self) -> None:
+        for sub in self._subs:
+            sub.close()
+        for w in self._workers:
+            w.cancel()
+        self._subs = []
+        self._workers = []
+
+    # -- egress fan-in (ioservice.go UpdateEgress :98) --------------------
+    async def _egress_worker(self, sub) -> None:
+        from livekit_server_tpu.service.egress import EgressInfo, EgressStatus
+
+        async for raw in sub:
+            try:
+                info = EgressInfo.from_dict(json.loads(raw))
+            except (ValueError, TypeError):
+                continue
+            prev = self.egresses.get(info.egress_id)
+            self.egresses[info.egress_id] = info
+            if prev and prev.status != info.status:
+                if info.status == EgressStatus.ACTIVE:
+                    self.server.telemetry.notify(
+                        "egress_started", egress=info.to_dict()
+                    )
+                elif info.status in (
+                    EgressStatus.COMPLETE, EgressStatus.FAILED, EgressStatus.ABORTED
+                ):
+                    self.server.telemetry.notify(
+                        "egress_ended", egress=info.to_dict()
+                    )
+
+    # -- ingress fan-in (ioservice.go UpdateIngressState :180) ------------
+    async def _ingress_worker(self, sub) -> None:
+        from livekit_server_tpu.service.ingress import IngressInfo, IngressState
+
+        async for raw in sub:
+            try:
+                info = IngressInfo.from_dict(json.loads(raw))
+            except (ValueError, TypeError):
+                continue
+            prev = self.ingresses.get(info.ingress_id)
+            self.ingresses[info.ingress_id] = info
+            if prev and prev.state != info.state:
+                if info.state == IngressState.ENDPOINT_PUBLISHING:
+                    self.server.telemetry.notify(
+                        "ingress_started", ingress=info.to_dict()
+                    )
+                elif info.state in (
+                    IngressState.ENDPOINT_COMPLETE, IngressState.ENDPOINT_ERROR
+                ):
+                    self.server.telemetry.notify(
+                        "ingress_ended", ingress=info.to_dict()
+                    )

@@ -49,6 +49,9 @@ class LivekitServer:
         self.egress = EgressService(self)
         self.ingress = IngressService(self)
         self.sip = SIPService(self)
+        from livekit_server_tpu.service.ioinfo import IOInfoService
+
+        self.ioinfo = IOInfoService(self)
         self.agents = AgentService(self)
         room_manager.agents = self.agents
         from livekit_server_tpu.utils.logger import Logger, configure
@@ -251,8 +254,7 @@ class LivekitServer:
                         pass  # port busy: UDP path still works
             except OSError:
                 pass  # port busy: WS media path still works
-        await self.egress.start()
-        await self.ingress.start()
+        await self.ioinfo.start()
         self.room_manager.start()
         self._stats_task = asyncio.ensure_future(self._refresh_nodes())
         self._runner = web.AppRunner(self.app)
@@ -278,8 +280,7 @@ class LivekitServer:
             self.room_manager.udp.transport.close()
         if getattr(self, "tcp_media", None) is not None:
             self.tcp_media.close()
-        await self.egress.stop()
-        await self.ingress.stop()
+        await self.ioinfo.stop()
         await self.room_manager.stop()
         await self.router.unregister_node()
         if self._runner is not None:
