@@ -231,6 +231,7 @@ class PlaneRuntime:
         self._last_congested = np.zeros((R, S), bool)
         self._last_deficient = np.zeros((R, S), bool)
         self._task: asyncio.Task | None = None
+        self._complete_task: asyncio.Task | None = None
         # Guards self.state across the donated device step vs. host-side
         # snapshot/restore (room migration): donation deletes the old
         # buffers mid-step, so concurrent readers would see dead arrays.
@@ -306,16 +307,18 @@ class PlaneRuntime:
             np.asarray(buf), self.dims, self.egress_cap, self.red_enabled
         )
 
-    async def step_once(self) -> TickResult:
-        """One tick; the device round trip runs in a worker thread so the
-        event loop (signal sessions) never blocks on HBM/tunnel latency."""
+    def _stage(self):
+        """Host pre-step: ctrl upload, probe scheduling, ingest drain.
+        Claims this tick's index; returns (inp, payloads, idx, roll, t0)."""
         t0 = time.perf_counter()
         if self._ctrl_dirty:
             self._upload_ctrl()
+        idx = self.tick_index
+        self.tick_index += 1
         # Close the quality/stats window about once per second
         # (connectionquality windows; room.go:1318 worker cadence).
         q_ticks = max(1, 1000 // self.tick_ms)
-        roll = (self.tick_index + 1) % q_ticks == 0
+        roll = (idx + 1) % q_ticks == 0
         # Probe scheduling (probe_controller.go): padding rides the first
         # live video track each subscriber is actually SUBSCRIBED to (its
         # munger lane must be started for padding_tick to emit anything);
@@ -326,7 +329,7 @@ class PlaneRuntime:
             cand.any(axis=1), cand.argmax(axis=1), -1
         ).astype(np.int32)                                     # [R, S]
         pad_num = self.prober.update(
-            now_ms=self.tick_index * self.tick_ms,
+            now_ms=idx * self.tick_ms,
             committed=self._last_committed,
             congested=self._last_congested,
             deficient=self._last_deficient,
@@ -339,22 +342,33 @@ class PlaneRuntime:
             # migration must stay byte-for-byte at its snapshot.
             pad_num[list(self.ingest.frozen_rows)] = 0
         inp, payloads = self.ingest.drain(
-            roll_quality=roll, tick_index=self.tick_index,
+            roll_quality=roll, tick_index=idx,
             pad_num=pad_num, pad_track=pad_track,
         )
         # Retain the slab for the RTX window: replay keys minted this tick
         # reference slot (tick % SLAB_WINDOW) until it recycles.
-        self._slab_history[self.tick_index % plane.SLAB_WINDOW] = payloads
-        loop = asyncio.get_running_loop()
-        async with self.state_lock:
-            out = await loop.run_in_executor(self._executor, self._device_step, inp)
-        # Mirror the probe controller's inputs for the next tick.
+        self._slab_history[idx % plane.SLAB_WINDOW] = payloads
+        return inp, payloads, idx, roll, t0
+
+    def _mirror_probe_inputs(self, out) -> None:
+        """Probe-controller inputs for the NEXT stage; must land as soon
+        as the device step resolves (a congested flag one tick stale
+        already delays padding shutdown; two would be worse)."""
         self._last_committed = np.asarray(out.committed_bps)
         self._last_congested = np.asarray(out.congested)
         self._last_deficient = np.asarray(out.deficient)
-        result = self._fan_out(out, payloads, inp, time.perf_counter() - t0)
+
+    async def _complete(self, out, inp, payloads, idx, roll, t0, pre_s=None) -> TickResult:
+        """Host post-step: fan out + callbacks. `pre_s` (pipelined loop)
+        is the stage+device work time measured when the device future
+        resolved — the deferred fan-out must not bill the scheduler sleep
+        between ticks as work."""
+        c0 = time.perf_counter()
+        base = pre_s if pre_s is not None else c0 - t0
+        result = self._fan_out(out, payloads, inp, base, idx)
+        # Total tick work: stage+device plus this fan-out.
+        result.tick_s = base + (time.perf_counter() - c0)
         result.quality_window_closed = roll
-        self.tick_index += 1
         self.recent_tick_s.append(round(result.tick_s, 5))
         self.stats["ticks"] += 1
         self.stats["fwd_packets"] += result.fwd_packets
@@ -364,6 +378,19 @@ class PlaneRuntime:
             if asyncio.iscoroutine(r):
                 await r
         return result
+
+    async def step_once(self) -> TickResult:
+        """One sequential tick (tests, warmup, manual stepping); the device
+        round trip runs in a worker thread so the event loop (signal
+        sessions) never blocks on HBM/tunnel latency. The serving loop
+        (`_run`) instead pipelines: egress fan-out of tick N overlaps tick
+        N+1's device step."""
+        inp, payloads, idx, roll, t0 = self._stage()
+        loop = asyncio.get_running_loop()
+        async with self.state_lock:
+            out = await loop.run_in_executor(self._executor, self._device_step, inp)
+        self._mirror_probe_inputs(out)
+        return await self._complete(out, inp, payloads, idx, roll, t0)
 
     def _assemble_replays(self, out, inp) -> list[EgressPacket]:
         """Resolve device replay keys → EgressPackets from the slab history
@@ -420,7 +447,7 @@ class PlaneRuntime:
             for r, s, j in zip(*hits)
         ]
 
-    def _fan_out(self, out, payloads, inp, tick_s: float) -> TickResult:
+    def _fan_out(self, out, payloads, inp, tick_s: float, tick_idx: int | None = None) -> TickResult:
         # Compacted egress: [R, E] index lists (see plane.TickOutputs) →
         # column arrays. No per-packet Python objects here; the wire path
         # consumes the batch arrays directly (DownTrackSpreader's fan-out
@@ -470,7 +497,7 @@ class PlaneRuntime:
         if padding:
             self.stats["pad_packets"] = self.stats.get("pad_packets", 0) + len(padding)
         return TickResult(
-            tick_index=self.tick_index,
+            tick_index=self.tick_index if tick_idx is None else tick_idx,
             egress_batch=batch,
             replays=replays,
             padding=padding,
@@ -498,16 +525,59 @@ class PlaneRuntime:
             self._task = asyncio.ensure_future(self._run())
 
     async def _run(self) -> None:
+        """Pipelined serving loop (the 'double-buffered DMA' this module
+        documents): tick N's device step is dispatched to the worker
+        thread, then tick N-1's fan-out + egress runs on the event loop
+        WHILE the device crunches — so a tick's wall budget is
+        max(device, host-egress) + staging instead of their sum. The
+        completion queue is bounded at 1: if host egress can't keep up,
+        the loop degrades to sequential instead of queueing stale sends.
+        self.state stays single-owner: staging (which touches the donated
+        state via ctrl uploads) only ever runs after the previous device
+        future resolved."""
         period = self.tick_ms / 1000.0
         next_at = time.perf_counter() + period
-        while True:
-            await asyncio.sleep(max(0.0, next_at - time.perf_counter()))
-            res = await self.step_once()
-            if res.tick_s > period:
-                self.stats["late_ticks"] += 1
-            next_at += period
-            if next_at < time.perf_counter() - 5 * period:
-                next_at = time.perf_counter() + period  # resync after stall
+        loop = asyncio.get_running_loop()
+        pending = None  # (out, staged, pre_s) — previous tick awaiting fan-out
+        pending_task: asyncio.Task | None = None
+        try:
+            while True:
+                await asyncio.sleep(max(0.0, next_at - time.perf_counter()))
+                if pending_task is not None:
+                    # Backpressure: previous fan-out still running ⇒ wait
+                    # (sequential under overload; no unbounded queue).
+                    res = await pending_task
+                    pending_task = self._complete_task = None
+                    if res.tick_s > period:
+                        self.stats["late_ticks"] += 1
+                staged = self._stage()
+                await self.state_lock.acquire()
+                fut = loop.run_in_executor(
+                    self._executor, self._device_step, staged[0]
+                )
+                try:
+                    if pending is not None:
+                        pending_task = self._complete_task = asyncio.ensure_future(
+                            self._complete(pending[0], *pending[1], pre_s=pending[2])
+                        )
+                        pending = None
+                    out = await fut
+                finally:
+                    self.state_lock.release()
+                self._mirror_probe_inputs(out)
+                pending = (out, staged, time.perf_counter() - staged[4])
+                next_at += period
+                if next_at < time.perf_counter() - 5 * period:
+                    next_at = time.perf_counter() + period  # resync after stall
+        except asyncio.CancelledError:
+            # Drain: the final tick's device step already ran — its egress,
+            # callbacks, and stats must not silently vanish at shutdown.
+            if pending_task is not None:
+                await pending_task
+                self._complete_task = None
+            if pending is not None:
+                await self._complete(pending[0], *pending[1], pre_s=pending[2])
+            raise
 
     async def stop(self) -> None:
         if self._task is not None:
@@ -517,6 +587,13 @@ class PlaneRuntime:
             except asyncio.CancelledError:
                 pass
             self._task = None
+        if self._complete_task is not None:
+            self._complete_task.cancel()
+            try:
+                await self._complete_task
+            except asyncio.CancelledError:
+                pass
+            self._complete_task = None
 
     # -- checkpoint / resume (§5.4) --------------------------------------
     def snapshot(self) -> dict[str, Any]:
