@@ -167,7 +167,7 @@ async def host_path_bench(dims, spec, ticks: int, device_tick_ms: float) -> dict
             runtime.set_track(r, t, published=True, is_video=is_video)
             ssrcs.append((r, t, is_video, ssrc))
         for s in range(dims.subs):
-            udp.sub_addrs[(r, s)] = sink_addr
+            udp.register_subscriber(r, s, sink_addr)
             for t in range(used):
                 runtime.set_subscription(r, t, s, subscribed=True)
 

@@ -93,11 +93,37 @@ class _Endpoint:
         self.tx_dir = tx_dir
         self.rx_dir = 1 - tx_dir
         self.tx_counter = 0
+        self._ctr_bind: tuple | None = None  # (array, index) when bound
         self.replay = _Replay()
 
-    def seal(self, plaintext: bytes) -> bytes:
+    def next_counter(self) -> int:
+        """Allocate one tx counter. A GCM nonce must NEVER repeat under a
+        key, so every sealing path (per-frame control traffic here, the
+        native bulk egress via its counter-array binding) allocates from
+        ONE source."""
+        if self._ctr_bind is not None:
+            arr, i = self._ctr_bind
+            v = int(arr[i])
+            arr[i] = v + 1
+            return v
         ctr = self.tx_counter
         self.tx_counter += 1
+        return ctr
+
+    def cur_counter(self) -> int:
+        if self._ctr_bind is not None:
+            arr, i = self._ctr_bind
+            return int(arr[i])
+        return self.tx_counter
+
+    def bind_counter(self, arr, idx: int) -> None:
+        """Move the tx counter into a shared numpy array slot (the batch
+        egress allocates counter blocks vectorized from it)."""
+        arr[idx] = self.cur_counter()
+        self._ctr_bind = (arr, idx)
+
+    def seal(self, plaintext: bytes) -> bytes:
+        ctr = self.next_counter()
         return _seal(self.aead, self.key_id, self.tx_dir, ctr, plaintext)
 
     def open(self, frame: bytes) -> bytes | None:

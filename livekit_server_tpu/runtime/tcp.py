@@ -87,6 +87,7 @@ class TCPMediaTransport:
                         self.udp.sub_addrs[(session.room, session.sub)] = (
                             "tcp", bound_key,
                         )
+                        self.udp._touch_subs()
                 self.udp._dispatch_inner(inner, ("tcp", session.key_id), session)
         except (asyncio.IncompleteReadError, ConnectionResetError):
             pass
@@ -99,6 +100,7 @@ class TCPMediaTransport:
                 for k, v in list(self.udp.sub_addrs.items()):
                     if v == ("tcp", bound_key):
                         del self.udp.sub_addrs[k]
+                self.udp._touch_subs()
             writer.close()
 
     def close(self) -> None:

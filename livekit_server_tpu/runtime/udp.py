@@ -302,6 +302,24 @@ class UDPMediaTransport(asyncio.DatagramProtocol):
         self._track_pt = np.full((R, T), OPUS_PT, np.uint8)
         self._track_is_video = np.zeros((R, T), bool)
         self._track_svc = np.zeros((R, T), bool)
+        # Persistent per-(room, sub) destination/session arrays: the batch
+        # egress reads these with pure numpy gathers (no per-tick Python
+        # loop over subscribers — the loop would scale with subscriber
+        # count at north-star shapes). Resynced from the dicts only when
+        # subscription state changes (`_subs_rev` bump or dict-length
+        # drift from out-of-band writers like tests/bench).
+        self._sub_ip = np.zeros((R, S), np.uint32)
+        self._sub_port = np.zeros((R, S), np.uint16)
+        self._sub_tcp = np.zeros((R, S), bool)
+        self._sub_red_arr = np.zeros((R, S), bool)
+        self._sub_sess_idx = np.full((R, S), -1, np.int32)
+        self._sessions: list = []
+        self._sess_keys = np.zeros((0, 16), np.uint8)
+        self._sess_keyids = np.zeros(0, np.uint32)
+        self._sess_active = np.zeros(0, np.uint8)
+        self._sess_ctr = np.zeros(0, np.uint64)
+        self._subs_rev = 0
+        self._subs_synced = (-1, -1, -1)  # (rev, len(sub_addrs), len(sub_sessions))
         self._txsr_pkts = np.zeros((R, S, T), np.int64)
         self._txsr_oct = np.zeros((R, S, T), np.int64)
         self._txsr_ts = np.zeros((R, S, T), np.uint32)
@@ -374,6 +392,7 @@ class UDPMediaTransport(asyncio.DatagramProtocol):
         self.sub_sessions[(room, sub)] = session
         session.room = room
         session.sub = sub
+        self._touch_subs()
 
     def _sendto(self, data: bytes, addr, session=None) -> None:
         """Single egress chokepoint: seal under the session, then route to
@@ -436,12 +455,14 @@ class UDPMediaTransport(asyncio.DatagramProtocol):
             self.sub_red.add((room, sub))
         else:
             self.sub_red.discard((room, sub))
+        self._touch_subs()
 
     def register_subscriber(self, room: int, sub: int, addr: tuple) -> None:
         """Trusted-caller egress registration (tests / in-process tooling).
         The signal plane must NOT call this with a client-supplied address —
         it hands out a punch id instead (assign_subscriber_punch)."""
         self.sub_addrs[(room, sub)] = addr
+        self._touch_subs()
 
     def assign_subscriber_punch(self, room: int, sub: int, rotate: bool = False) -> int:
         """Mint an unguessable punch id for a subscriber. The client proves
@@ -486,6 +507,7 @@ class UDPMediaTransport(asyncio.DatagramProtocol):
         self._txsr_pkts[room, sub, :] = 0
         self._txsr_oct[room, sub, :] = 0
         self.sub_red.discard((room, sub))
+        self._touch_subs()
         pid = self._punch_by_sub.pop((room, sub), None)
         if pid is not None:
             self.punch_ids.pop(pid, None)
@@ -515,6 +537,7 @@ class UDPMediaTransport(asyncio.DatagramProtocol):
         for key in [k for k in self._red_ring if k[0] == room]:
             del self._red_ring[key]
         self._svc_tracks = {k for k in self._svc_tracks if k[0] != room}
+        self._touch_subs()
         self._track_svc[room] = False
         for key in [k for k in self._dd_structs if k[0] == room]:
             del self._dd_structs[key]
@@ -552,7 +575,15 @@ class UDPMediaTransport(asyncio.DatagramProtocol):
             if inner is None:
                 self.stats["bad_frame"] += 1
                 return
-            session.client_active = True
+            if not session.client_active:
+                session.client_active = True
+                j = getattr(session, "_arr_idx", None)
+                if (
+                    j is not None
+                    and j < len(self._sessions)
+                    and self._sessions[j] is session
+                ):
+                    self._sess_active[j] = 1
             self._dispatch_inner(inner, addr, session)
             return
         if self.require_encryption:
@@ -799,6 +830,7 @@ class UDPMediaTransport(asyncio.DatagramProtocol):
             return
         entry[1] = addr
         self.sub_addrs[key] = addr
+        self._touch_subs()
         self._sendto(PUNCH_ACK + data[8:12], addr, session)
 
     def _flush_rx(self) -> None:
@@ -1093,41 +1125,16 @@ class UDPMediaTransport(asyncio.DatagramProtocol):
         Returns a [N] bool mask of entries that have a UDP/TCP media
         destination — the caller delivers the complement over WebSocket.
         """
-        import socket as _socket
-
         n = len(batch)
         if n == 0:
             return np.zeros(0, bool)
         r, t, k, s = batch.rooms, batch.tracks, batch.ks, batch.subs
-        S = self.ingest.dims.subs
-        # Destination resolution per UNIQUE (room, sub) — dict lookups
-        # scale with subscribers, not with (packet × subscriber) entries.
-        pairkey = r.astype(np.int64) * S + s
-        uniq, inv = np.unique(pairkey, return_inverse=True)
-        u_ip = np.zeros(len(uniq), np.uint32)
-        u_port = np.zeros(len(uniq), np.uint16)
-        u_tcp = np.zeros(len(uniq), bool)
-        u_sess = np.full(len(uniq), -1, np.int32)
-        u_red = np.zeros(len(uniq), bool)
-        sessions: list = []
-        for j, q in enumerate(uniq):
-            rr, ss = divmod(int(q), S)
-            if (rr, ss) in self.sub_red:
-                u_red[j] = True
-            sess = self.sub_sessions.get((rr, ss))
-            if sess is not None:
-                u_sess[j] = len(sessions)
-                sessions.append(sess)
-            addr = self.sub_addrs.get((rr, ss))
-            if addr is None:
-                continue
-            if addr[0] == "tcp":
-                u_tcp[j] = True
-            else:
-                u_ip[j] = int.from_bytes(_socket.inet_aton(addr[0]), "big")
-                u_port[j] = addr[1]
-        e_port = u_port[inv]
-        e_tcp = u_tcp[inv]
+        # Destination resolution: pure array gathers from the persistent
+        # per-(room, sub) mirrors (resynced only on subscription churn) —
+        # no per-subscriber Python loop on the per-tick path.
+        self._maybe_resync_subs()
+        e_port = self._sub_port[r, s]
+        e_tcp = self._sub_tcp[r, s]
         has_dest = (e_port != 0) | e_tcp
 
         if native_egress is None or self.transport is None:
@@ -1144,7 +1151,7 @@ class UDPMediaTransport(asyncio.DatagramProtocol):
         red_mask = np.zeros(n, bool)
         if self.sub_red and red_plan is not None and red_plan[0].size:
             red_mask = (
-                u_red[inv] & (e_port != 0) & (po >= 0)
+                self._sub_red_arr[r, s] & (e_port != 0) & (po >= 0)
                 & ~self._track_is_video[r, t]
             )
             if red_mask.any():
@@ -1156,28 +1163,28 @@ class UDPMediaTransport(asyncio.DatagramProtocol):
             ssrc = self._egress_ssrc_arr[rr_, ss_, tt_].copy()
             for m_ in np.nonzero(ssrc == 0)[0]:  # first tick of a new sub only
                 ssrc[m_] = self.subscriber_ssrc(int(rr_[m_]), int(ss_[m_]), int(tt_[m_]))
-            e_sess = u_sess[inv][idx]
-            if sessions:
-                active = np.array(
-                    [self.require_encryption or x.client_active for x in sessions],
-                    bool,
+            e_sess = self._sub_sess_idx[rr_, ss_]
+            n_sess = len(self._sessions)
+            if n_sess:
+                seal = (e_sess >= 0) & (
+                    self.require_encryption
+                    | (self._sess_active[np.maximum(e_sess, 0)] > 0)
                 )
-                seal = (e_sess >= 0) & active[np.maximum(e_sess, 0)]
             else:
                 seal = np.zeros(len(idx), bool)
             key_idx = np.where(seal, e_sess, -1).astype(np.int32)
             ctr = np.zeros(len(idx), np.uint64)
             if seal.any():
                 # Allocate each session a contiguous counter block for this
-                # batch (sessions also seal RTCP from Python between ticks;
-                # the authoritative cursor stays on the session object).
+                # batch, fully vectorized over the shared counter array
+                # (sessions seal RTCP between ticks through the SAME array
+                # slot — crypto.bind_counter — so nonces never collide).
                 sealed_pos = np.nonzero(seal)[0]
                 es = e_sess[sealed_pos]
-                cnts = np.bincount(es, minlength=len(sessions))
-                base = np.zeros(len(sessions), np.uint64)
-                for j, x in enumerate(sessions):
-                    base[j] = x.tx_counter
-                    x.tx_counter += int(cnts[j])
+                u, cnts = np.unique(es, return_counts=True)
+                base = np.zeros(n_sess, np.uint64)
+                base[u] = self._sess_ctr[u]
+                self._sess_ctr[u] += cnts.astype(np.uint64)
                 order = np.argsort(es, kind="stable")
                 sorted_es = es[order]
                 grp_start = np.r_[0, np.nonzero(np.diff(sorted_es))[0] + 1]
@@ -1185,15 +1192,8 @@ class UDPMediaTransport(asyncio.DatagramProtocol):
                 ranks = np.empty(len(es), np.int64)
                 ranks[order] = np.arange(len(es)) - np.repeat(grp_start, sizes)
                 ctr[sealed_pos] = base[es] + ranks.astype(np.uint64)
-            keys = (
-                np.frombuffer(b"".join(x.key for x in sessions), np.uint8)
-                .reshape(-1, 16)
-                if sessions else np.zeros((1, 16), np.uint8)
-            )
-            key_ids = (
-                np.array([x.key_id for x in sessions], np.uint32)
-                if sessions else np.zeros(1, np.uint32)
-            )
+            keys = self._sess_keys if n_sess else np.zeros((1, 16), np.uint8)
+            key_ids = self._sess_keyids if n_sess else np.zeros(1, np.uint32)
             ext_blob, ext_off, ext_len = b"", None, None
             if self.playout_delay is not None or self._svc_tracks:
                 ext_blob, ext_off, ext_len = self._build_ext_sections(
@@ -1213,7 +1213,7 @@ class UDPMediaTransport(asyncio.DatagramProtocol):
                 ts=(batch.ts[idx].astype(np.int64) & 0xFFFFFFFF).astype(np.uint32),
                 ssrc=ssrc,
                 pid=batch.pid[idx], tl0=batch.tl0[idx], kidx=batch.keyidx[idx],
-                ip=u_ip[inv][idx], port=e_port[idx],
+                ip=self._sub_ip[rr_, ss_], port=e_port[idx],
                 seal=seal.astype(np.uint8), key_idx=key_idx,
                 keys=keys, key_ids=key_ids, counters=ctr,
                 ext_blob=ext_blob, ext_off=ext_off, ext_len=ext_len,
@@ -1225,7 +1225,8 @@ class UDPMediaTransport(asyncio.DatagramProtocol):
             # allocates plane-sized temporaries — only worth it when the
             # batch is a sizable fraction of the plane; otherwise add.at
             # scales with entries sent.
-            R, T = self.ingest.dims.rooms, self.ingest.dims.tracks
+            R, T, S = (self.ingest.dims.rooms, self.ingest.dims.tracks,
+                       self.ingest.dims.subs)
             flat = (rr_.astype(np.int64) * S + ss_) * T + tt_
             if R * S * T <= 4 * len(flat):
                 self._txsr_pkts += np.bincount(
@@ -1246,6 +1247,74 @@ class UDPMediaTransport(asyncio.DatagramProtocol):
             self.send_egress(batch.to_packets(e_tcp & (po >= 0)))
         self._send_srs(now_ms)
         return has_dest
+
+    def _maybe_resync_subs(self) -> None:
+        """Rebuild the destination/session arrays from the dicts when
+        subscription state changed (register/release/punch/bind bump
+        `_subs_rev`; the length checks catch direct dict writers)."""
+        import socket as _socket
+
+        key = (self._subs_rev, len(self.sub_addrs), len(self.sub_sessions))
+        if key == self._subs_synced:
+            return
+        self._sub_ip[:] = 0
+        self._sub_port[:] = 0
+        self._sub_tcp[:] = False
+        self._sub_red_arr[:] = False
+        self._sub_sess_idx[:] = -1
+        R, S = self._sub_ip.shape
+        for (room, sub), addr in self.sub_addrs.items():
+            if not (0 <= room < R and 0 <= sub < S):
+                continue
+            if addr[0] == "tcp":
+                self._sub_tcp[room, sub] = True
+            else:
+                try:
+                    self._sub_ip[room, sub] = int.from_bytes(
+                        _socket.inet_aton(addr[0]), "big"
+                    )
+                except OSError:
+                    # Loud enough to find: a hostname here means a caller
+                    # bypassed the resolve step; the sub gets no egress.
+                    self.stats["bad_sub_addr"] = self.stats.get("bad_sub_addr", 0) + 1
+                    continue
+                self._sub_port[room, sub] = addr[1]
+        for room, sub in self.sub_red:
+            if 0 <= room < R and 0 <= sub < S:
+                self._sub_red_arr[room, sub] = True
+        sessions = []
+        sess_idx_by_id: dict[int, int] = {}
+        for (room, sub), sess in self.sub_sessions.items():
+            if not (0 <= room < R and 0 <= sub < S):
+                continue
+            # Dedup by identity: a session bound under two keys must get
+            # ONE counter slot — two slots seeded alike would hand out
+            # duplicate GCM nonces under one key.
+            j = sess_idx_by_id.get(id(sess))
+            if j is None:
+                j = sess_idx_by_id[id(sess)] = len(sessions)
+                sessions.append(sess)
+            self._sub_sess_idx[room, sub] = j
+        self._sessions = sessions
+        n = len(sessions)
+        self._sess_keys = np.frombuffer(
+            b"".join(x.key for x in sessions), np.uint8
+        ).reshape(n, 16) if n else np.zeros((0, 16), np.uint8)
+        self._sess_keyids = np.array([x.key_id for x in sessions], np.uint32)
+        self._sess_active = np.array(
+            [1 if x.client_active else 0 for x in sessions], np.uint8
+        )
+        # Shared counter slots: GCM nonces must be unique per key, so both
+        # the vectorized bulk allocation and per-frame seal() draw from
+        # the same array cell (crypto.bind_counter).
+        self._sess_ctr = np.zeros(n, np.uint64)
+        for j, x in enumerate(sessions):
+            x.bind_counter(self._sess_ctr, j)
+            x._arr_idx = j
+        self._subs_synced = key
+
+    def _touch_subs(self) -> None:
+        self._subs_rev += 1
 
     def _build_ext_sections(self, batch, rr_, tt_, kk_, ss_, layer_caps):
         """Per-entry RTP header-extension sections for the native builder:
