@@ -255,6 +255,7 @@ class LivekitServer:
             except OSError:
                 pass  # port busy: WS media path still works
         await self.ioinfo.start()
+        await self.room_api.start()
         self.room_manager.start()
         self._stats_task = asyncio.ensure_future(self._refresh_nodes())
         self._runner = web.AppRunner(self.app)
@@ -281,6 +282,7 @@ class LivekitServer:
         if getattr(self, "tcp_media", None) is not None:
             self.tcp_media.close()
         await self.ioinfo.stop()
+        await self.room_api.stop()
         await self.room_manager.stop()
         await self.router.unregister_node()
         if self._runner is not None:

@@ -268,3 +268,66 @@ async def test_bus_auth():
         await member.close()
     finally:
         bus.close()
+
+
+async def test_roomservice_ops_against_non_hosting_node():
+    """Admin RPCs hit node B for a room hosted on node A and are relayed
+    to the hosting node over the bus (multinode_roomservice_test.go)."""
+    from livekit_server_tpu.auth import AccessToken, VideoGrant
+    from tests.test_service import API_KEY, API_SECRET
+
+    bus = await start_bus()
+    srv_a = srv_b = None
+    try:
+        srv_a, _ = await start_node(bus.port)
+        srv_b, _ = await start_node(bus.port)
+        async with aiohttp.ClientSession() as s:
+            alice = SignalClient(s, srv_a.port)
+            await alice.connect("hosted-on-a", "alice")
+            assert "hosted-on-a" in srv_a.room_manager.rooms
+
+            t = AccessToken(API_KEY, API_SECRET)
+            t.grant = VideoGrant(room_admin=True, room="hosted-on-a")
+            hdr = {"Authorization": f"Bearer {t.to_jwt()}"}
+            base_b = f"http://127.0.0.1:{srv_b.port}/twirp/livekit.RoomService"
+
+            # List participants via the NON-hosting node.
+            async with s.post(
+                f"{base_b}/ListParticipants", json={"room": "hosted-on-a"},
+                headers=hdr,
+            ) as r:
+                assert r.status == 200, await r.text()
+                parts = (await r.json())["participants"]
+                assert [p["identity"] for p in parts] == ["alice"]
+
+            # Mutate metadata via the non-hosting node; the hosting node's
+            # room object changes and alice gets the update.
+            async with s.post(
+                f"{base_b}/UpdateRoomMetadata",
+                json={"room": "hosted-on-a", "metadata": "via-node-b"},
+                headers=hdr,
+            ) as r:
+                assert r.status == 200, await r.text()
+            assert srv_a.room_manager.rooms["hosted-on-a"].info.metadata == "via-node-b"
+
+            # Remove alice via the non-hosting node.
+            async with s.post(
+                f"{base_b}/RemoveParticipant",
+                json={"room": "hosted-on-a", "identity": "alice"},
+                headers=hdr,
+            ) as r:
+                assert r.status == 200, await r.text()
+            deadline = asyncio.get_event_loop().time() + 3
+            while (
+                (room_a := srv_a.room_manager.rooms.get("hosted-on-a")) is not None
+                and "alice" in room_a.participants
+                and asyncio.get_event_loop().time() < deadline
+            ):
+                await asyncio.sleep(0.05)
+            assert room_a is None or "alice" not in room_a.participants
+            await alice.close()
+    finally:
+        for srv in (srv_a, srv_b):
+            if srv is not None:
+                await srv.stop(force=True)
+        bus.close()
