@@ -39,7 +39,10 @@ BASELINE_WRITES_PER_SEC = 20_000.0  # reference: ~50 µs per WriteRTP, 1 core
 # -- device throughput ------------------------------------------------------
 
 def device_bench(dims, spec, ticks: int, warmup: int) -> dict:
-    """Chained device steps (no host sync inside the timed window)."""
+    """Chained device steps, measured as a TWO-WINDOW slope so the fixed
+    per-run dispatch/sync cost (large through a tunneled dev chip, nonzero
+    even locally) cancels out: per-tick time = (t(2N) − t(N)) / N over
+    identical input streams."""
     import jax
     import jax.numpy as jnp
 
@@ -57,8 +60,14 @@ def device_bench(dims, spec, ticks: int, warmup: int) -> dict:
         return state, fwd + out.fwd_packets.sum(), evaluated + ev, out.fwd_packets
 
     traffic = synth.init_traffic(dims, spec)
+    # Inputs are pre-staged ON DEVICE: through a tunneled dev chip a
+    # per-tick host upload costs ~50 ms and would swamp the compute being
+    # measured (a locally-attached chip uploads in microseconds — the
+    # runtime's real per-tick upload is negligible there). The HBM cost is
+    # bounded: ~1 MB/tick at the default shape (~200 MB total), ~9 MB/tick
+    # for the 2-tick memory-feasibility run.
     inputs = []
-    for i in range(warmup + ticks):
+    for i in range(warmup + 4 * ticks):
         traffic, inp = synth.next_tick(traffic, dims, spec, tick_index=i)
         inputs.append(jax.tree.map(jnp.asarray, inp))
 
@@ -68,18 +77,36 @@ def device_bench(dims, spec, ticks: int, warmup: int) -> dict:
         state, fwd, ev, _ = step(state, fwd, ev, inputs[i])
     jax.block_until_ready(fwd)
 
-    fwd = jnp.zeros((), jnp.int32)
-    ev = jnp.zeros((), jnp.int32)
-    t0 = time.perf_counter()
-    for i in range(warmup, warmup + ticks):
-        state, fwd, ev, _ = step(state, fwd, ev, inputs[i])
-    fwd = int(jax.block_until_ready(fwd))
-    ev = int(jax.block_until_ready(ev))
-    dt = time.perf_counter() - t0
+    def window(state, n, start):
+        fwd = jnp.zeros((), jnp.int32)
+        ev = jnp.zeros((), jnp.int32)
+        t0 = time.perf_counter()
+        for i in range(start, start + n):
+            state, fwd, ev, _ = step(state, fwd, ev, inputs[i])
+        fwd = int(jax.block_until_ready(fwd))
+        ev = int(jax.block_until_ready(ev))
+        return state, fwd, ev, time.perf_counter() - t0
+
+    # Window A: N ticks; window B: 3N ticks of the continuing stream.
+    # t(N) = C + N·τ ⇒ τ = (t_B − t_A)/2N with the fixed cost C cancelled;
+    # the 3×-vs-1× separation keeps timing jitter small relative to dt.
+    state, fwd_a, ev_a, t_a = window(state, ticks, warmup)
+    state, fwd_b, ev_b, t_b = window(state, 3 * ticks, warmup + ticks)
+    if t_b < 1.2 * t_a:
+        # Fixed cost dominates (tiny config): the slope is buried in
+        # noise — report window B absolute (conservative: includes C).
+        return {
+            "fwd_writes_per_s": round(fwd_b / t_b, 1),
+            "evaluated_per_s": round(ev_b / t_b, 1),
+            "device_tick_ms": round(t_b / (3 * ticks) * 1000.0, 3),
+        }
+    dt = t_b - t_a
+    fwd = max(fwd_b - fwd_a, 0)
+    ev = max(ev_b - ev_a, 0)
     return {
         "fwd_writes_per_s": round(fwd / dt, 1),
         "evaluated_per_s": round(ev / dt, 1),
-        "device_tick_ms": round(dt / ticks * 1000.0, 3),
+        "device_tick_ms": round(dt / (2 * ticks) * 1000.0, 3),
     }
 
 
@@ -314,7 +341,10 @@ def main() -> None:
         # would measure socket queueing, not forwarding.
         try:
             host_dims = plane.PlaneDims(32, 8, 16, 6)
-            host_dev = device_bench(host_dims, spec, ticks=10, warmup=3)
+            # Enough ticks that the slope beats the fixed tunnel cost even
+            # at this small shape (otherwise the fallback would fold the
+            # tunnel round trip into the p99 composition).
+            host_dev = device_bench(host_dims, spec, ticks=60, warmup=3)
             host = asyncio.run(
                 host_path_bench(host_dims, spec, args.host_ticks,
                                 host_dev["device_tick_ms"])
