@@ -73,6 +73,9 @@ class RTCConfig:
     """pkg/config RTCConfig — transport + media-plane edges."""
 
     udp_port: int = 7882
+    # "" = burst each tick; "no-queue" spreads sendmmsg chunks across
+    # half the tick (pkg/sfu/pacer seat — shaping without a queue).
+    pacer: str = ""
     tcp_port: int = 7881
     require_encryption: bool = True   # drop cleartext media datagrams; the
                                       # sealed AEAD wire (runtime/crypto.py)
@@ -290,6 +293,10 @@ def load_config(
 
 
 def _validate(cfg: Config) -> None:
+    if cfg.rtc.pacer not in ("", "no-queue"):
+        raise ConfigError(
+            f"rtc.pacer must be '' or 'no-queue', got {cfg.rtc.pacer!r}"
+        )
     if not cfg.development and not cfg.keys:
         raise ConfigError("one or more API keys are required (or set development: true)")
     if cfg.development and not cfg.keys:

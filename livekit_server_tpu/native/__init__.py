@@ -345,6 +345,7 @@ class NativeEgress:
         self.lib.egress_batch_send.argtypes = (
             [ctypes.c_int, ctypes.c_int, ctypes.c_void_p, ctypes.c_int32]
             + [ctypes.c_void_p] * 24     # pay_off..out_len
+            + [ctypes.c_int]             # pace_window_us
         )
         # Exercise the library once so a broken libcrypto link is caught at
         # load time (and the fallback engaged), not on the first media tick.
@@ -387,7 +388,8 @@ class NativeEgress:
 
     def send(self, fd, n_threads, slab, pay_off, pay_len, marker, pt, vp8,
              sn, ts, ssrc, pid, tl0, kidx, ip, port, seal, key_idx, keys,
-             key_ids, counters, ext_blob=b"", ext_off=None, ext_len=None):
+             key_ids, counters, ext_blob=b"", ext_off=None, ext_len=None,
+             pace_window_us=0):
         """Returns (out, out_off, out_len, sent). With fd < 0 nothing hits
         the network and `out` holds the built frames (tests / TCP path).
         `ext_blob`/`ext_off`/`ext_len` attach pre-serialized RTP header-
@@ -426,6 +428,7 @@ class NativeEgress:
             c(key_ids, np.uint32), c(counters, np.uint64),
             out.ctypes.data, out_off.ctypes.data,
             np.ascontiguousarray(out_len).ctypes.data,
+            int(pace_window_us),
         )
         return out, out_off, out_len, int(sent)
 

@@ -333,6 +333,12 @@ class UDPMediaTransport(asyncio.DatagramProtocol):
         # Playout-delay header extension on video egress
         # (rtpextension/playoutdelay.go): (min_ms, max_ms) or None.
         self.playout_delay: tuple[int, int] | None = None
+        # Pacer window (pkg/sfu/pacer "no-queue"): spread a tick's
+        # sendmmsg chunks across this many ms; 0 = burst. Paced sends
+        # run on a dedicated worker thread (they sleep).
+        self.pacer_spread_ms: float = 0.0
+        self._pace_pool = None
+        self._pace_pending = None
         # Media-loss proxy (medialossproxy.go): max subscriber-reported
         # fraction_lost per audio track, relayed upstream ~1/s so the
         # publisher's Opus encoder can enable FEC.
@@ -1199,8 +1205,22 @@ class UDPMediaTransport(asyncio.DatagramProtocol):
                 ext_blob, ext_off, ext_len = self._build_ext_sections(
                     batch, rr_, tt_, kk_, ss_, layer_caps
                 )
+            pace_us = int(self.pacer_spread_ms * 1000)
             fd = self.transport.get_extra_info("socket").fileno()
-            _, _, _, sent = native_egress.send(
+            if pace_us > 0:
+                # Paced sends sleep inside the native call; run them OFF
+                # the event loop (one worker: tick order preserved). If
+                # the previous paced send hasn't drained, burst this one
+                # inline instead of queueing stale media.
+                if self._pace_pool is None:
+                    from concurrent.futures import ThreadPoolExecutor
+
+                    self._pace_pool = ThreadPoolExecutor(
+                        max_workers=1, thread_name_prefix="pacer"
+                    )
+                if self._pace_pending is not None and not self._pace_pending.done():
+                    pace_us = 0
+            send_args = dict(
                 fd=fd, n_threads=self.egress_threads,
                 slab=batch.payloads.data,
                 pay_off=po[idx], pay_len=pl[idx],
@@ -1217,10 +1237,22 @@ class UDPMediaTransport(asyncio.DatagramProtocol):
                 seal=seal.astype(np.uint8), key_idx=key_idx,
                 keys=keys, key_ids=key_ids, counters=ctr,
                 ext_blob=ext_blob, ext_off=ext_off, ext_len=ext_len,
+                pace_window_us=pace_us,
             )
-            self.stats["tx"] += sent
-            if sent < len(idx):
-                self.stats["tx_drop"] = self.stats.get("tx_drop", 0) + len(idx) - sent
+            n_entries = len(idx)
+
+            def do_send(args=send_args, n_entries=n_entries):
+                _, _, _, sent = native_egress.send(**args)
+                self.stats["tx"] += sent
+                if sent < n_entries:
+                    self.stats["tx_drop"] = (
+                        self.stats.get("tx_drop", 0) + n_entries - sent
+                    )
+
+            if pace_us > 0:
+                self._pace_pending = self._pace_pool.submit(do_send)
+            else:
+                do_send()
             # SR bookkeeping accumulators, folded at SR cadence. bincount
             # allocates plane-sized temporaries — only worth it when the
             # batch is a sizable fraction of the plane; otherwise add.at

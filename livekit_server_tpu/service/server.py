@@ -229,6 +229,10 @@ class LivekitServer:
                 )
                 # Client PLIs over RTCP reach signal-plane publishers too.
                 self.room_manager.udp.on_pli = self.room_manager.handle_pli
+                if self.config.rtc.pacer == "no-queue":
+                    self.room_manager.udp.pacer_spread_ms = (
+                        self.config.plane.tick_ms / 2.0
+                    )
                 if self.config.room.playout_delay_max_ms > 0:
                     # Video egress carries the playout-delay extension
                     # (rtpextension/playoutdelay.go; config room section).

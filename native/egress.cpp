@@ -91,7 +91,14 @@ struct Args {
   const int64_t* out_off;
   const int32_t* out_len;
   int fd;
+  // Pacer (pkg/sfu/pacer "no-queue" seat): spread each worker's sendmmsg
+  // chunks across this window so a tick's burst doesn't hit receiver
+  // buffers as one spike. 0 = no shaping. Chunking shrinks to PACE_CHUNK
+  // when active so typical loads actually have gaps to spread.
+  int pace_window_us;
 };
+
+constexpr int PACE_CHUNK = 64;
 
 void be16(uint8_t* p, uint16_t v) { p[0] = v >> 8; p[1] = v & 0xFF; }
 void be32(uint8_t* p, uint32_t v) {
@@ -198,11 +205,16 @@ int64_t worker(const Args& a, int lo, int hi) {
     mmsghdr msgs[MMSG_CHUNK];
     iovec iovs[MMSG_CHUNK];
     sockaddr_in sas[MMSG_CHUNK];
+    int chunk = a.pace_window_us > 0 ? PACE_CHUNK : MMSG_CHUNK;
+    // Sleep per inter-chunk gap, from THIS worker's real chunk count (the
+    // caller only names the window; constants stay one-sided).
+    int n_chunks = (hi - lo + chunk - 1) / chunk;
+    int gap_us = n_chunks > 1 ? a.pace_window_us / (n_chunks - 1) : 0;
     int i = lo;
     while (i < hi) {
       int cnt = 0;
       while (i < hi && a.skip[i]) i++;
-      for (; cnt < MMSG_CHUNK && i + cnt < hi && !a.skip[i + cnt]; cnt++) {
+      for (; cnt < chunk && i + cnt < hi && !a.skip[i + cnt]; cnt++) {
         int j = i + cnt;
         std::memset(&sas[cnt], 0, sizeof(sockaddr_in));
         sas[cnt].sin_family = AF_INET;
@@ -234,6 +246,7 @@ int64_t worker(const Args& a, int lo, int hi) {
         break;  // hard error (or spun out): drop the remainder of the chunk
       }
       i += cnt;
+      if (gap_us > 0 && i < hi) usleep(gap_us);
     }
   }
   return sent;
@@ -257,14 +270,16 @@ int64_t egress_batch_send(
     const int32_t* tl0, const int32_t* kidx, const uint32_t* ip,
     const uint16_t* port, const uint8_t* seal, const int32_t* key_idx,
     const uint8_t* keys, const uint32_t* key_ids, const uint64_t* counters,
-    uint8_t* out, const int64_t* out_off, const int32_t* out_len) {
+    uint8_t* out, const int64_t* out_off, const int32_t* out_len,
+    int pace_window_us) {
   if (n <= 0) return 0;
   std::vector<uint8_t> skip(n, 0);
   Args a{skip.data(), slab, pay_off, pay_len, marker, pt, vp8,
          ext_blob, ext_off, ext_len,
          sn,  ts,
          ssrc,  pid,     tl0,     kidx,   ip,       port,    seal, key_idx,
-         keys,  key_ids, counters, out,   out_off,  out_len, fd};
+         keys,  key_ids, counters, out,   out_off,  out_len, fd,
+         pace_window_us};
   if (n_threads < 1) n_threads = 1;
   if (n_threads > 8) n_threads = 8;
   if (n < 2 * n_threads) n_threads = 1;
