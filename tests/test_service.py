@@ -73,9 +73,10 @@ class SignalClient:
         self.media: list = []
         self._reader: asyncio.Task | None = None
 
-    async def connect(self, room: str, identity: str, **grant_kw):
+    async def connect(self, room: str, identity: str, query: str = "", **grant_kw):
         self.ws = await self.session.ws_connect(
-            f"ws://127.0.0.1:{self.port}/rtc?access_token={token(identity, room, **grant_kw)}"
+            f"ws://127.0.0.1:{self.port}/rtc?access_token="
+            f"{token(identity, room, **grant_kw)}{query}"
         )
         self._reader = asyncio.ensure_future(self._read())
         join = await self.wait_for("join")
@@ -123,17 +124,21 @@ import socket
 
 
 @contextlib.asynccontextmanager
-async def running_server(**plane_overrides):
+async def running_server(configure=None, **plane_overrides):
     """In-process server on a free port (createSingleNodeServer analog).
 
     An async context manager rather than a pytest fixture: the conftest
-    async shim runs coroutine *tests*, not async fixtures.
+    async shim runs coroutine *tests*, not async fixtures. `configure`
+    (optional callable) mutates the Config before the server is built.
     """
     s = socket.socket()
     s.bind(("127.0.0.1", 0))
     port = s.getsockname()[1]
     s.close()
-    srv = create_server(make_config(port, **plane_overrides))
+    cfg = make_config(port, **plane_overrides)
+    if configure is not None:
+        configure(cfg)
+    srv = create_server(cfg)
     await srv.start()
     try:
         yield srv
