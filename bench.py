@@ -216,12 +216,23 @@ async def host_path_bench(dims, spec, ticks: int, device_tick_ms: float) -> dict
     sn0 = {(r, t): int(rng.integers(0, 1 << 16)) for (r, t, _v, _s) in ssrcs}
     v_ppt = max(1, round(spec.video_kbps * 125 / 1200 / 1000 * spec.tick_ms))
     counts = [v_ppt if is_video else 1 for (_, _, is_video, _) in ssrcs]
+    def stage(dgrams):
+        """Pre-pack one tick's datagrams in the batch-receive layout
+        (blob + offsets/lengths/src arrays — what rx_batch produces)."""
+        blob = np.frombuffer(b"".join(dgrams), np.uint8)
+        lens = np.array([len(d) for d in dgrams], np.int32)
+        offs = np.zeros(len(dgrams), np.int32)
+        np.cumsum(lens[:-1], out=offs[1:])
+        ips = np.full(len(dgrams), 0x7F000001, np.uint32)
+        ports = np.full(len(dgrams), 50000, np.uint16)
+        return blob, offs, lens, ips, ports
+
     pre = [
-        _build_tick_datagrams(ssrcs, counts, sn0, i, spec)
+        stage(_build_tick_datagrams(ssrcs, counts, sn0, i, spec))
         for i in range(ticks + 2)
     ]
     pre_pipe = [
-        _build_tick_datagrams(ssrcs, counts, sn0, ticks + 2 + i, spec)
+        stage(_build_tick_datagrams(ssrcs, counts, sn0, ticks + 2 + i, spec))
         for i in range(max(10, ticks // 2))
     ]
 
@@ -240,8 +251,8 @@ async def host_path_bench(dims, spec, ticks: int, device_tick_ms: float) -> dict
             sent0 = udp.stats["tx"]
             seq_t0 = time.perf_counter()
         t0 = time.perf_counter()
-        for d in pre[i]:
-            udp.datagram_received(d, src)
+        blob, offs, lens, ips, ports_a = pre[i]
+        udp.feed_batch(blob, offs, lens, ips, ports_a, len(offs))
         udp._flush_rx()  # one native batch parse (the event-loop coalesce)
         runtime.ingest._estimate[:] = est
         runtime.ingest._estimate_valid[:] = True
@@ -259,8 +270,8 @@ async def host_path_bench(dims, spec, ticks: int, device_tick_ms: float) -> dict
     pending = None
     pipe_t0 = time.perf_counter()
     for i in range(P):
-        for d in pre_pipe[i]:
-            udp.datagram_received(d, src)
+        blob, offs, lens, ips, ports_a = pre_pipe[i]
+        udp.feed_batch(blob, offs, lens, ips, ports_a, len(offs))
         udp._flush_rx()
         runtime.ingest._estimate[:] = est
         runtime.ingest._estimate_valid[:] = True

@@ -347,6 +347,18 @@ class NativeEgress:
             + [ctypes.c_void_p] * 24     # pay_off..out_len
             + [ctypes.c_int]             # pace_window_us
         )
+        self.lib.rx_batch.restype = ctypes.c_int32
+        self.lib.rx_batch.argtypes = [
+            ctypes.c_int, ctypes.c_void_p, ctypes.c_int64, ctypes.c_void_p,
+            ctypes.c_void_p, ctypes.c_void_p, ctypes.c_void_p,
+            ctypes.c_int32, ctypes.c_int32,
+        ]
+        self.lib.open_batch.restype = None
+        self.lib.open_batch.argtypes = [
+            ctypes.c_void_p, ctypes.c_void_p, ctypes.c_void_p, ctypes.c_int32,
+            ctypes.c_void_p, ctypes.c_void_p, ctypes.c_uint8,
+            ctypes.c_void_p, ctypes.c_void_p, ctypes.c_void_p,
+        ]
         # Exercise the library once so a broken libcrypto link is caught at
         # load time (and the fallback engaged), not on the first media tick.
         self._selftest()
@@ -385,6 +397,48 @@ class NativeEgress:
             [0x90, 0xE0, 0x80, 0x05, 0x06, 0x22, 0x00]
         ):
             raise OSError("egress seal self-test failed")
+
+    def rx_batch(self, fd: int, scratch, offsets, lengths, ips, ports,
+                 max_dgram: int = 2048) -> int:
+        """Drain a non-blocking UDP socket with recvmmsg into caller-owned
+        arrays; returns datagrams received (the batch ingress twin of
+        send — one native call per event-loop wake)."""
+        return int(self.lib.rx_batch(
+            int(fd), scratch.ctypes.data, scratch.nbytes,
+            offsets.ctypes.data, lengths.ctypes.data,
+            ips.ctypes.data, ports.ctypes.data,
+            len(offsets), int(max_dgram),
+        ))
+
+    def open_batch(self, blob, offsets, lengths, key_idx, keys,
+                   expect_dir: int):
+        """Batch-open sealed frames; returns (out, out_off, out_len) with
+        out_len[i] = plaintext length or -1 on auth/direction failure."""
+        n = len(offsets)
+        out_len = np.full(n, -1, np.int32)
+        # Plaintext ≤ frame length − 30; lay out at the frame offsets'
+        # scale for simplicity (caller slices by out_off/out_len).
+        sizes = np.maximum(lengths.astype(np.int64) - 30, 0)
+        out_off = np.zeros(n, np.int64)
+        np.cumsum(sizes[:-1], out=out_off[1:])
+        out = np.zeros(int(sizes.sum()) if n else 0, np.uint8)
+        blob_arr = np.frombuffer(blob, np.uint8) if not isinstance(
+            blob, np.ndarray
+        ) else blob
+        # Bind converted arrays to locals: an inline temporary's buffer
+        # could be freed before the C call executes.
+        offs_c = np.ascontiguousarray(offsets, np.int32)
+        lens_c = np.ascontiguousarray(lengths, np.int32)
+        kidx_c = np.ascontiguousarray(key_idx, np.int32)
+        keys_c = np.ascontiguousarray(keys, np.uint8)
+        self.lib.open_batch(
+            blob_arr.ctypes.data,
+            offs_c.ctypes.data, lens_c.ctypes.data, n,
+            kidx_c.ctypes.data, keys_c.ctypes.data,
+            int(expect_dir),
+            out.ctypes.data, out_off.ctypes.data, out_len.ctypes.data,
+        )
+        return out, out_off, out_len
 
     def send(self, fd, n_threads, slab, pay_off, pay_len, marker, pt, vp8,
              sn, ts, ssrc, pid, tl0, kidx, ip, port, seal, key_idx, keys,

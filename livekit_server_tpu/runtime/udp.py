@@ -24,6 +24,7 @@ import numpy as np
 
 from livekit_server_tpu.native import egress as native_egress, rtp
 from livekit_server_tpu.runtime.crypto import (
+    DIR_C2S,
     MAGIC as CRYPTO_MAGIC,
     MediaCryptoRegistry,
     MediaCryptoSession,
@@ -320,6 +321,7 @@ class UDPMediaTransport(asyncio.DatagramProtocol):
         self._sess_ctr = np.zeros(0, np.uint64)
         self._subs_rev = 0
         self._subs_synced = (-1, -1, -1)  # (rev, len(sub_addrs), len(sub_sessions))
+        self._ip_str: dict[int, str] = {}  # batch-rx source ip cache
         self._txsr_pkts = np.zeros((R, S, T), np.int64)
         self._txsr_oct = np.zeros((R, S, T), np.int64)
         self._txsr_ts = np.zeros((R, S, T), np.uint32)
@@ -568,6 +570,100 @@ class UDPMediaTransport(asyncio.DatagramProtocol):
     def connection_made(self, transport) -> None:
         self.transport = transport
 
+    def _mark_client_active(self, session) -> None:
+        """First frame that opens under a session latches sealed egress;
+        the array mirror must track the exact session at that slot."""
+        if session.client_active:
+            return
+        session.client_active = True
+        j = getattr(session, "_arr_idx", None)
+        if (
+            j is not None
+            and j < len(self._sessions)
+            and self._sessions[j] is session
+        ):
+            self._sess_active[j] = 1
+
+    def feed_batch(self, blob, offs, lens, ips, ports, n) -> None:
+        """Batch ingress from the native recvmmsg reader: sealed frames are
+        opened with ONE native AES-GCM batch call (replay windows and the
+        client-active latch stay host-side), then every datagram runs the
+        normal demux. Replaces one asyncio protocol callback per datagram."""
+        import socket as _socket
+
+        self.stats["rx"] += int(n)
+        offs = offs[:n]
+        lens = lens[:n]
+        valid = lens > 0
+        b0 = np.where(valid, blob[offs], 0xFF)
+        sealed = (
+            (b0 == CRYPTO_MAGIC) & valid
+            if self.crypto is not None else np.zeros(n, bool)
+        )
+
+        def addr_of(i):
+            ip = int(ips[i])
+            s_ = self._ip_str.get(ip)
+            if s_ is None:
+                if len(self._ip_str) >= 4096:
+                    # Spoofed-source flood must not grow this unbounded.
+                    self._ip_str.clear()
+                s_ = self._ip_str[ip] = _socket.inet_ntoa(ip.to_bytes(4, "big"))
+            return (s_, int(ports[i]))
+
+        if sealed.any():
+            si = np.nonzero(sealed)[0]
+            o = offs[si].astype(np.int64)
+            kid = (
+                (blob[o + 1].astype(np.uint32) << 24)
+                | (blob[o + 2].astype(np.uint32) << 16)
+                | (blob[o + 3].astype(np.uint32) << 8)
+                | blob[o + 4]
+            )
+            sessions = {int(k): self.crypto.get(int(k)) for k in np.unique(kid)}
+            keyrows: list[bytes] = []
+            kmap: dict[int, int] = {}
+            for k, sess in sessions.items():
+                if sess is not None:
+                    kmap[k] = len(keyrows)
+                    keyrows.append(sess.key)
+            kidx = np.array([kmap.get(int(k), -1) for k in kid], np.int32)
+            keys = (
+                np.frombuffer(b"".join(keyrows), np.uint8).reshape(-1, 16)
+                if keyrows else np.zeros((1, 16), np.uint8)
+            )
+            out, ooff, olen = native_egress.open_batch(
+                blob, offs[si], lens[si], kidx, keys, DIR_C2S
+            )
+            ctr = np.zeros(len(si), np.uint64)
+            for b in range(8):
+                ctr = (ctr << np.uint64(8)) | blob[o + 6 + b].astype(np.uint64)
+            for j, i in enumerate(si):
+                if olen[j] < 0:
+                    self.stats["bad_frame"] += 1
+                    continue
+                sess = sessions[int(kid[j])]
+                if not sess.replay.check(int(ctr[j])):
+                    self.stats["bad_frame"] += 1
+                    continue
+                self._mark_client_active(sess)
+                inner = bytes(out[int(ooff[j]) : int(ooff[j]) + int(olen[j])])
+                self._dispatch_inner(inner, addr_of(i), sess)
+
+        clear = np.nonzero(valid & ~sealed)[0]
+        if len(clear):
+            if self.require_encryption:
+                # Secure mode: the cleartext media wire does not exist —
+                # but punch probes ride sealed frames only, so anything
+                # cleartext here is droppable wholesale.
+                self.stats["plaintext_drop"] += len(clear)
+            else:
+                for i in clear:
+                    oo = int(offs[i])
+                    self._dispatch_inner(
+                        bytes(blob[oo : oo + int(lens[i])]), addr_of(i), None
+                    )
+
     def datagram_received(self, data: bytes, addr) -> None:
         self.stats["rx"] += 1
         if not data:
@@ -581,15 +677,7 @@ class UDPMediaTransport(asyncio.DatagramProtocol):
             if inner is None:
                 self.stats["bad_frame"] += 1
                 return
-            if not session.client_active:
-                session.client_active = True
-                j = getattr(session, "_arr_idx", None)
-                if (
-                    j is not None
-                    and j < len(self._sessions)
-                    and self._sessions[j] is session
-                ):
-                    self._sess_active[j] = 1
+            self._mark_client_active(session)
             self._dispatch_inner(inner, addr, session)
             return
         if self.require_encryption:
@@ -1616,6 +1704,39 @@ class UDPMediaTransport(asyncio.DatagramProtocol):
             self._send_srs(now_ms)
 
 
+class _RawDatagramTransport:
+    """Minimal DatagramTransport stand-in over a raw non-blocking socket
+    (the native batch-receive path owns reads via loop.add_reader)."""
+
+    def __init__(self, sock, loop):
+        self._sock = sock
+        self._loop = loop
+        self._closed = False
+
+    def sendto(self, data, addr) -> None:
+        try:
+            self._sock.sendto(data, addr)
+        except (BlockingIOError, OSError):
+            pass  # full buffer / teardown race: drop like the kernel would
+
+    def close(self) -> None:
+        if self._closed:
+            return
+        self._closed = True
+        try:
+            self._loop.remove_reader(self._sock.fileno())
+        except (OSError, ValueError):
+            pass
+        self._sock.close()
+
+    def get_extra_info(self, name, default=None):
+        if name == "socket":
+            return self._sock
+        if name == "sockname":
+            return self._sock.getsockname()
+        return default
+
+
 async def start_udp_transport(
     ingest: IngestBuffer,
     host: str = "0.0.0.0",
@@ -1623,9 +1744,42 @@ async def start_udp_transport(
     crypto: MediaCryptoRegistry | None = None,
     require_encryption: bool = False,
 ) -> UDPMediaTransport:
+    import socket as _socket
+
     loop = asyncio.get_running_loop()
-    transport, protocol = await loop.create_datagram_endpoint(
-        lambda: UDPMediaTransport(ingest, crypto, require_encryption),
-        local_addr=(host, port),
+    protocol = UDPMediaTransport(ingest, crypto, require_encryption)
+    is_v4 = ":" not in host  # rx_batch parses sockaddr_in (IPv4) only
+    if native_egress is not None and is_v4:
+        # Native batch-receive path: raw socket + recvmmsg per event-loop
+        # wake + one batch AEAD open, instead of one asyncio protocol
+        # callback (and one Python AES call) per datagram.
+        sock = _socket.socket(_socket.AF_INET, _socket.SOCK_DGRAM)
+        sock.setsockopt(_socket.SOL_SOCKET, _socket.SO_RCVBUF, 4 << 20)
+        sock.setsockopt(_socket.SOL_SOCKET, _socket.SO_SNDBUF, 4 << 20)
+        sock.bind((host, port))
+        sock.setblocking(False)
+        tr = _RawDatagramTransport(sock, loop)
+        protocol.connection_made(tr)
+        MAXN, MAXD = 1024, 2048
+        scratch = np.zeros(MAXN * MAXD, np.uint8)
+        offs = np.zeros(MAXN, np.int32)
+        lens = np.zeros(MAXN, np.int32)
+        ips = np.zeros(MAXN, np.uint32)
+        ports_a = np.zeros(MAXN, np.uint16)
+        fd = sock.fileno()
+
+        def on_readable():
+            # ONE batch per wake: the reader is level-triggered, so a
+            # still-full socket re-fires immediately — but other event-loop
+            # work (ticks, flushes, timers) gets to run in between instead
+            # of being starved by a sustained flood.
+            nn = native_egress.rx_batch(fd, scratch, offs, lens, ips, ports_a, MAXD)
+            if nn > 0:
+                protocol.feed_batch(scratch, offs, lens, ips, ports_a, nn)
+
+        loop.add_reader(fd, on_readable)
+        return protocol
+    transport, _ = await loop.create_datagram_endpoint(
+        lambda: protocol, local_addr=(host, port)
     )
     return protocol

@@ -47,9 +47,15 @@ int EVP_EncryptInit_ex(EVP_CIPHER_CTX*, const EVP_CIPHER*, ENGINE*,
 int EVP_EncryptUpdate(EVP_CIPHER_CTX*, unsigned char*, int*,
                       const unsigned char*, int);
 int EVP_EncryptFinal_ex(EVP_CIPHER_CTX*, unsigned char*, int*);
+int EVP_DecryptInit_ex(EVP_CIPHER_CTX*, const EVP_CIPHER*, ENGINE*,
+                       const unsigned char*, const unsigned char*);
+int EVP_DecryptUpdate(EVP_CIPHER_CTX*, unsigned char*, int*,
+                      const unsigned char*, int);
+int EVP_DecryptFinal_ex(EVP_CIPHER_CTX*, unsigned char*, int*);
 int EVP_CIPHER_CTX_ctrl(EVP_CIPHER_CTX*, int, int, void*);
 }
 #define EVP_CTRL_GCM_GET_TAG 0x10
+#define EVP_CTRL_GCM_SET_TAG 0x11
 
 namespace {
 
@@ -303,6 +309,106 @@ int64_t egress_batch_send(
   int64_t built = 0;
   for (int i = 0; i < n; i++) built += skip[i] ? 0 : 1;
   return built;
+}
+
+}  // extern "C"
+
+extern "C" {
+
+// Batch receive: drain up to max_n datagrams from a non-blocking UDP
+// socket with recvmmsg (the ingress twin of the batch sender — replaces
+// one Python callback per datagram with one native call per wake).
+// Returns the number received; fills per-datagram offsets/lengths into
+// `buf` (caller-sized) and source ip/port (host byte order).
+int32_t rx_batch(int fd, uint8_t* buf, int64_t cap, int32_t* offsets,
+                 int32_t* lengths, uint32_t* ips, uint16_t* ports,
+                 int32_t max_n, int32_t max_dgram) {
+  constexpr int CHUNK = 64;
+  mmsghdr msgs[CHUNK];
+  iovec iovs[CHUNK];
+  sockaddr_in sas[CHUNK];
+  int32_t n = 0;
+  int64_t off = 0;
+  while (n < max_n && off + (int64_t)CHUNK * max_dgram <= cap) {
+    int want = max_n - n < CHUNK ? max_n - n : CHUNK;
+    for (int j = 0; j < want; j++) {
+      iovs[j].iov_base = buf + off + (int64_t)j * max_dgram;
+      iovs[j].iov_len = max_dgram;
+      std::memset(&msgs[j].msg_hdr, 0, sizeof(msghdr));
+      msgs[j].msg_hdr.msg_iov = &iovs[j];
+      msgs[j].msg_hdr.msg_iovlen = 1;
+      msgs[j].msg_hdr.msg_name = &sas[j];
+      msgs[j].msg_hdr.msg_namelen = sizeof(sockaddr_in);
+    }
+    int r = recvmmsg(fd, msgs, want, MSG_DONTWAIT, nullptr);
+    if (r <= 0) break;
+    for (int j = 0; j < r; j++) {
+      if (msgs[j].msg_hdr.msg_flags & MSG_TRUNC) {
+        // Oversized datagram: delivering the truncated prefix as if
+        // complete would feed corrupt payloads downstream — drop it
+        // (length 0; the caller's valid-mask skips it).
+        offsets[n] = (int32_t)(off + (int64_t)j * max_dgram);
+        lengths[n] = 0;
+        ips[n] = 0;
+        ports[n] = 0;
+        n++;
+        continue;
+      }
+      offsets[n] = (int32_t)(off + (int64_t)j * max_dgram);
+      lengths[n] = (int32_t)msgs[j].msg_len;
+      ips[n] = ntohl(sas[j].sin_addr.s_addr);
+      ports[n] = ntohs(sas[j].sin_port);
+      n++;
+    }
+    off += (int64_t)r * max_dgram;
+    if (r < want) break;  // socket drained
+  }
+  return n;
+}
+
+}  // extern "C"
+
+extern "C" {
+
+// Batch AEAD open for sealed ingress frames (the decrypt twin of the
+// sealed egress path; layout per runtime/crypto.py:
+// 0x01 | key_id(4 BE) | dir(1) | counter(8 BE) | ct || tag(16),
+// nonce = dir | counter | 0^3, AAD = the 14-byte header). `key_idx` maps
+// each frame to a row of `keys` (16-byte AES-128 keys); <0 = unknown key.
+// Plaintext for frame i lands at out + out_off[i]; out_len[i] = plaintext
+// length, or -1 on auth failure / wrong direction / runt. Caller handles
+// replay windows (cheap per-frame bitmap in Python).
+void open_batch(const uint8_t* buf, const int32_t* offsets,
+                const int32_t* lengths, int32_t n, const int32_t* key_idx,
+                const uint8_t* keys, uint8_t expect_dir,
+                uint8_t* out, const int64_t* out_off, int32_t* out_len) {
+  EVP_CIPHER_CTX* ctx = EVP_CIPHER_CTX_new();
+  const EVP_CIPHER* cipher = EVP_aes_128_gcm();
+  bool inited = false;
+  for (int i = 0; i < n; i++) {
+    out_len[i] = -1;
+    int len = lengths[i];
+    if (key_idx[i] < 0 || len < 14 + 16) continue;
+    const uint8_t* f = buf + offsets[i];
+    if (f[0] != 0x01 || f[5] != expect_dir) continue;
+    uint8_t nonce[12];
+    nonce[0] = f[5];
+    std::memcpy(nonce + 1, f + 6, 8);
+    std::memset(nonce + 9, 0, 3);
+    int ctlen = len - 14 - 16;
+    int outl = 0, fl = 0;
+    EVP_DecryptInit_ex(ctx, inited ? nullptr : cipher, nullptr,
+                       keys + 16 * key_idx[i], nonce);
+    inited = true;
+    EVP_DecryptUpdate(ctx, nullptr, &outl, f, 14);  // AAD
+    EVP_DecryptUpdate(ctx, out + out_off[i], &outl, f + 14, ctlen);
+    EVP_CIPHER_CTX_ctrl(ctx, EVP_CTRL_GCM_SET_TAG, 16,
+                        const_cast<uint8_t*>(f + len - 16));
+    if (EVP_DecryptFinal_ex(ctx, out + out_off[i] + outl, &fl) == 1) {
+      out_len[i] = outl + fl;
+    }
+  }
+  EVP_CIPHER_CTX_free(ctx);
 }
 
 }  // extern "C"
