@@ -149,6 +149,19 @@ class KeyValueConfig:
 
 
 @dataclass
+class RelayConfig:
+    """Embedded media relay (pkg/service/turn.go seat): a separately
+    addressable UDP hop for clients whose direct path to rtc.udp_port is
+    blocked. Blind forwarding — media stays AEAD-sealed end-to-end."""
+
+    enabled: bool = False
+    udp_port: int = 7885
+    external_host: str = ""      # address advertised to clients; "" = bind addr
+    allocation_ttl_s: int = 30
+    max_allocations: int = 4096
+
+
+@dataclass
 class WebHookConfig:
     """config.go WebHookConfig."""
 
@@ -174,6 +187,7 @@ class Config:
     node_selector: NodeSelectorConfig = field(default_factory=NodeSelectorConfig)
     plane: PlaneConfig = field(default_factory=PlaneConfig)
     kv: KeyValueConfig = field(default_factory=KeyValueConfig)
+    relay: RelayConfig = field(default_factory=RelayConfig)
     webhook: WebHookConfig = field(default_factory=WebHookConfig)
 
 

@@ -37,6 +37,7 @@ REQUEST_KINDS = frozenset(
         "simulate",         # fault injection scenarios
         "ping",             # rtt ping (responds pong)
         "update_metadata",  # participant metadata/name/attributes
+        "request_relay",    # mint a media-relay allocation (TURN cred seat)
     }
 )
 

@@ -118,6 +118,27 @@ def handle_participant_signal(room, participant: Participant, req: SignalRequest
             "pong",
             {"last_ping_timestamp": data.get("timestamp", 0), "timestamp": int(time.time() * 1000)},
         )
+    elif kind == "request_relay":
+        # Media-relay allocation (turn.go:47 capability): hand back the
+        # relay address + a token bound to this participant's media-crypto
+        # session. The relay is blind; the token only admits forwarding.
+        udp = getattr(room, "udp", None)
+        info = getattr(udp, "relay_info", None) if udp is not None else None
+        sess = participant.crypto_session
+        if info is not None and sess is not None:
+            from livekit_server_tpu.runtime.relay import mint_relay_token
+
+            host, port, secret, ttl = info
+            token = mint_relay_token(secret, sess.key_id, ttl)
+            participant.send(
+                "request_response",
+                {"relay_info": {
+                    "host": host, "port": port, "token": token.hex(),
+                    "ttl_s": ttl,
+                }},
+            )
+        else:
+            participant.send("request_response", {"relay_info": None})
     elif kind == "update_metadata":
         if participant.permission.can_update_metadata:
             participant.metadata = data.get("metadata", participant.metadata)

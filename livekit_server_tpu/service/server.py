@@ -256,6 +256,46 @@ class LivekitServer:
                         )
                     except OSError:
                         pass  # port busy: UDP path still works
+                # Embedded media relay (turn.go:47 seat): a second UDP hop
+                # for clients that cannot reach rtc.udp_port directly.
+                if self.config.relay.enabled:
+                    from livekit_server_tpu.runtime.relay import start_media_relay
+
+                    rcfg = self.config.relay
+                    secret = (
+                        next(iter(self.config.keys.values())) if self.config.keys
+                        else "dev"
+                    ).encode()
+                    try:
+                        self.media_relay = await start_media_relay(
+                            self.config.bind_addresses[0],
+                            rcfg.udp_port,
+                            (self.config.bind_addresses[0] or "127.0.0.1",
+                             self.config.rtc.udp_port),
+                            secret,
+                            ttl_s=float(rcfg.allocation_ttl_s),
+                            max_allocations=rcfg.max_allocations,
+                        )
+                        # Signal-layer mint point (request_relay handler).
+                        # Never advertise a wildcard bind as the relay host —
+                        # clients can't route to 0.0.0.0; without a concrete
+                        # external_host the relay runs but is not advertised.
+                        advert = rcfg.external_host or self.config.bind_addresses[0]
+                        if advert in ("", "0.0.0.0", "::"):
+                            self.log.warn(
+                                "relay enabled but bind address is a wildcard "
+                                "and relay.external_host is unset; not "
+                                "advertising relay to clients"
+                            )
+                        else:
+                            self.room_manager.udp.relay_info = (
+                                advert,
+                                rcfg.udp_port,
+                                secret,
+                                float(rcfg.allocation_ttl_s),
+                            )
+                    except OSError:
+                        pass  # relay port busy: direct path still works
             except OSError:
                 pass  # port busy: WS media path still works
         await self.ioinfo.start()
@@ -285,6 +325,8 @@ class LivekitServer:
             self.room_manager.udp.transport.close()
         if getattr(self, "tcp_media", None) is not None:
             self.tcp_media.close()
+        if getattr(self, "media_relay", None) is not None:
+            self.media_relay.close()
         await self.ioinfo.stop()
         await self.room_api.stop()
         await self.room_manager.stop()

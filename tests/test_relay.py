@@ -1,0 +1,288 @@
+"""Embedded media relay (the TURN seat): blind UDP forwarding for clients
+whose direct path to the SFU media port is blocked.
+
+Reference parity: pkg/service/turn.go:47 — the reference embeds a TURN
+server so UDP-hostile networks still move media over a relay address.
+Here the relay forwards this build's sealed frames verbatim; admission is
+a token minted over the signal channel (the long-term-credential seat).
+"""
+
+import asyncio
+import socket
+import time
+
+import numpy as np
+
+from livekit_server_tpu.models import plane
+from livekit_server_tpu.native import rtp as parser
+from livekit_server_tpu.runtime import PlaneRuntime
+from livekit_server_tpu.runtime.crypto import MediaCryptoClient, MediaCryptoRegistry
+from livekit_server_tpu.runtime.relay import (
+    BIND_ACK,
+    BIND_ERR,
+    BIND_REQ,
+    RELAY_MAGIC,
+    mint_relay_token,
+    start_media_relay,
+    verify_relay_token,
+)
+from livekit_server_tpu.runtime.udp import PUNCH_ACK, PUNCH_REQ, UDPMediaTransport
+from tests.test_native import rtp_packet
+
+DIMS = plane.PlaneDims(rooms=2, tracks=4, pkts=8, subs=4)
+SECRET = b"relay-hmac-secret"
+
+
+def _free_port() -> int:
+    s = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+def _bind_via(sock: socket.socket, relay_addr, token: bytes) -> None:
+    sock.sendto(RELAY_MAGIC + bytes([BIND_REQ]) + token, relay_addr)
+
+
+def _recv(sock: socket.socket):
+    out = []
+    while True:
+        try:
+            out.append(sock.recvfrom(4096)[0])
+        except BlockingIOError:
+            return out
+
+
+def test_relay_token_roundtrip():
+    tok = mint_relay_token(SECRET, 0xDEADBEEF, 30.0)
+    assert verify_relay_token(SECRET, tok) == 0xDEADBEEF
+    # forged mac / wrong secret / expired → rejected
+    assert verify_relay_token(b"other", tok) is None
+    assert verify_relay_token(SECRET, tok[:-1] + bytes([tok[-1] ^ 1])) is None
+    assert verify_relay_token(SECRET, mint_relay_token(SECRET, 7, -5.0)) is None
+
+
+async def test_relay_end_to_end_sealed_media():
+    """Publisher and subscriber that never touch the SFU port directly:
+    BIND → sealed punch → sealed media both ways, all through the relay.
+    The relay holds no media keys — every forwarded byte string is sealed."""
+    runtime = PlaneRuntime(DIMS, tick_ms=10)
+    reg = MediaCryptoRegistry()
+    sfu_port, relay_port = _free_port(), _free_port()
+    loop = asyncio.get_running_loop()
+    tr, transport = await loop.create_datagram_endpoint(
+        lambda: UDPMediaTransport(runtime.ingest, crypto=reg, require_encryption=True),
+        local_addr=("127.0.0.1", sfu_port),
+    )
+    relay = await start_media_relay(
+        "127.0.0.1", relay_port, ("127.0.0.1", sfu_port), SECRET
+    )
+    relay_addr = ("127.0.0.1", relay_port)
+    try:
+        runtime.set_track(0, 0, published=True, is_video=False)
+        runtime.set_subscription(0, 0, 1, subscribed=True)
+        pub_sess, sub_sess = reg.mint(), reg.mint()
+        transport.bind_sub_session(0, 1, sub_sess)
+        ssrc = transport.assign_ssrc(0, 0, is_video=False, session=pub_sess)
+        alice = MediaCryptoClient(pub_sess.key_id, pub_sess.key)
+        bob = MediaCryptoClient(sub_sess.key_id, sub_sess.key)
+
+        pub = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
+        pub.bind(("127.0.0.1", 0))
+        pub.setblocking(False)
+        sub = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
+        sub.bind(("127.0.0.1", 0))
+        sub.setblocking(False)
+
+        # Allocate: one BIND each, tokens bound to each session.
+        _bind_via(pub, relay_addr, mint_relay_token(SECRET, pub_sess.key_id, 30))
+        _bind_via(sub, relay_addr, mint_relay_token(SECRET, sub_sess.key_id, 30))
+        await asyncio.sleep(0.05)
+        assert _recv(pub) == [RELAY_MAGIC + bytes([BIND_ACK]) + pub_sess.key_id.to_bytes(4, "big")]
+        assert _recv(sub) == [RELAY_MAGIC + bytes([BIND_ACK]) + sub_sess.key_id.to_bytes(4, "big")]
+        assert len(relay.allocs) == 2
+
+        # Sealed punch rides through the relay; the SFU latches the relay's
+        # per-allocation source port, never bob's real address.
+        pid = transport.assign_subscriber_punch(0, 1)
+        sub.sendto(bob.seal(PUNCH_REQ + pid.to_bytes(4, "big")), relay_addr)
+        await asyncio.sleep(0.05)
+        acks = [bob.open(f) for f in _recv(sub)]
+        assert PUNCH_ACK + pid.to_bytes(4, "big") in acks
+        latched = transport.sub_addrs[(0, 1)]
+        assert latched[0] == "127.0.0.1" and latched[1] != sub.getsockname()[1]
+
+        # Sealed media: alice → relay → SFU → relay → bob.
+        payload = b"relayed-opus"
+        got = []
+        for i in range(5):
+            pub.sendto(
+                alice.seal(rtp_packet(sn=100 + i, ts=960 * i, ssrc=ssrc,
+                                      payload=payload + bytes([i]))),
+                relay_addr,
+            )
+            await asyncio.sleep(0.02)
+            res = await runtime.step_once()
+            transport.send_egress(res.egress)
+            await asyncio.sleep(0.02)
+            for f in _recv(sub):
+                assert f[0] == 0x01 and payload not in f  # still sealed on the wire
+                inner = bob.open(f)
+                if inner is not None and not (192 <= inner[1] <= 223):
+                    got.append(inner)
+        assert len(got) == 5
+        out = parser.parse_batch(
+            got[0], np.asarray([0], np.int32), np.asarray([len(got[0])], np.int32)
+        )[0]
+        assert int(out["sn"]) == 100
+        off, ln = int(out["payload_off"]), int(out["payload_len"])
+        assert got[0][off : off + ln] == payload + bytes([0])
+        assert relay.stats["up_fwd"] >= 6 and relay.stats["down_fwd"] >= 6
+        pub.close()
+        sub.close()
+    finally:
+        relay.close()
+        tr.close()
+
+
+async def test_request_relay_signal_mints_token():
+    """Signal plane: `request_relay` returns the relay address plus a token
+    the relay accepts for THIS participant's media session — and a null
+    relay_info when no relay is configured (client falls back to TCP)."""
+    from livekit_server_tpu.protocol import decode_signal_response
+    from livekit_server_tpu.protocol.signal import SignalRequest
+    from livekit_server_tpu.routing.messagechannel import MessageChannel
+    from livekit_server_tpu.rtc import Participant, Room, handle_participant_signal
+
+    runtime = PlaneRuntime(DIMS, tick_ms=10)
+    reg = MediaCryptoRegistry()
+    room = Room("relayroom", runtime)
+    room.crypto = reg
+    sink = MessageChannel(size=100)
+    p = Participant("alice", room, response_sink=sink)
+    room.join(p)
+    assert p.crypto_session is not None
+
+    class _FakeUdp:
+        relay_info = ("203.0.113.9", 7885, SECRET, 30.0)
+
+    room.udp = _FakeUdp()
+    handle_participant_signal(room, p, SignalRequest("request_relay", {}))
+    room.udp = None
+    handle_participant_signal(room, p, SignalRequest("request_relay", {}))
+
+    infos = []
+    while True:
+        try:
+            msg = decode_signal_response(sink._q.get_nowait())
+        except asyncio.QueueEmpty:
+            break
+        if msg.kind == "request_response" and "relay_info" in msg.data:
+            infos.append(msg.data["relay_info"])
+    assert len(infos) == 2 and infos[1] is None
+    info = infos[0]
+    assert (info["host"], info["port"]) == ("203.0.113.9", 7885)
+    assert verify_relay_token(SECRET, bytes.fromhex(info["token"])) == p.crypto_session.key_id
+
+
+async def test_relay_admission_and_rebind():
+    """Forged tokens never allocate; a re-BIND from a new source address
+    moves the allocation (NAT-rebind recovery) and revokes the old path."""
+    runtime = PlaneRuntime(DIMS, tick_ms=10)
+    reg = MediaCryptoRegistry()
+    sfu_port, relay_port = _free_port(), _free_port()
+    loop = asyncio.get_running_loop()
+    tr, transport = await loop.create_datagram_endpoint(
+        lambda: UDPMediaTransport(runtime.ingest, crypto=reg, require_encryption=True),
+        local_addr=("127.0.0.1", sfu_port),
+    )
+    relay = await start_media_relay(
+        "127.0.0.1", relay_port, ("127.0.0.1", sfu_port), SECRET, ttl_s=30
+    )
+    relay_addr = ("127.0.0.1", relay_port)
+    try:
+        sess = reg.mint()
+        c1 = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
+        c1.bind(("127.0.0.1", 0))
+        c1.setblocking(False)
+
+        # Forged / tampered / expired tokens → BIND_ERR, no allocation.
+        _bind_via(c1, relay_addr, mint_relay_token(b"wrong", sess.key_id, 30))
+        _bind_via(c1, relay_addr, mint_relay_token(SECRET, sess.key_id, -1))
+        await asyncio.sleep(0.05)
+        assert all(f == RELAY_MAGIC + bytes([BIND_ERR]) for f in _recv(c1))
+        assert not relay.allocs and relay.stats["bad_bind"] == 2
+        # Datagrams from an unbound address are dropped, not forwarded.
+        c1.sendto(b"\x01" + b"x" * 40, relay_addr)
+        await asyncio.sleep(0.05)
+        assert relay.stats["dropped"] == 1 and relay.stats["up_fwd"] == 0
+
+        token = mint_relay_token(SECRET, sess.key_id, 30)
+        _bind_via(c1, relay_addr, token)
+        await asyncio.sleep(0.05)
+        assert _recv(c1)[-1][4] == BIND_ACK
+        alloc = relay.allocs[sess.key_id]
+        assert alloc.client_addr == c1.getsockname()
+
+        # Same token, new socket: the allocation MOVES (one per session).
+        c2 = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
+        c2.bind(("127.0.0.1", 0))
+        c2.setblocking(False)
+        _bind_via(c2, relay_addr, token)
+        await asyncio.sleep(0.05)
+        assert _recv(c2)[-1][4] == BIND_ACK
+        assert len(relay.allocs) == 1
+        assert relay.allocs[sess.key_id].client_addr == c2.getsockname()
+        assert c1.getsockname() not in relay.by_client
+        c1.close()
+        c2.close()
+
+        # BIND burst: many datagrams for one session land in a single
+        # event-loop batch — exactly one upstream socket must exist (the
+        # creation await must not let duplicates through the cap).
+        sess2 = reg.mint()
+        c3 = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
+        c3.bind(("127.0.0.1", 0))
+        c3.setblocking(False)
+        burst_token = mint_relay_token(SECRET, sess2.key_id, 30)
+        for _ in range(8):
+            _bind_via(c3, relay_addr, burst_token)
+        await asyncio.sleep(0.1)
+        assert len(relay.allocs) == 2  # sess (moved above) + sess2, no dupes
+        assert not relay._pending
+        c3.close()
+    finally:
+        relay.close()
+        tr.close()
+
+
+async def test_relay_idle_allocations_expire():
+    runtime = PlaneRuntime(DIMS, tick_ms=10)
+    reg = MediaCryptoRegistry()
+    sfu_port, relay_port = _free_port(), _free_port()
+    loop = asyncio.get_running_loop()
+    tr, transport = await loop.create_datagram_endpoint(
+        lambda: UDPMediaTransport(runtime.ingest, crypto=reg, require_encryption=True),
+        local_addr=("127.0.0.1", sfu_port),
+    )
+    relay = await start_media_relay(
+        "127.0.0.1", relay_port, ("127.0.0.1", sfu_port), SECRET, ttl_s=0.1
+    )
+    try:
+        sess = reg.mint()
+        c = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
+        c.bind(("127.0.0.1", 0))
+        c.setblocking(False)
+        _bind_via(c, ("127.0.0.1", relay_port), mint_relay_token(SECRET, sess.key_id, 30))
+        await asyncio.sleep(0.05)
+        assert len(relay.allocs) == 1
+        # Sweeper period is max(1s, ttl/4): idle past the ttl → reaped.
+        deadline = time.monotonic() + 3.0
+        while relay.allocs and time.monotonic() < deadline:
+            await asyncio.sleep(0.1)
+        assert not relay.allocs and relay.stats["expired"] == 1
+        c.close()
+    finally:
+        relay.close()
+        tr.close()
