@@ -93,6 +93,88 @@ class SignalResponse:
             raise ValueError(f"unknown signal response kind: {self.kind!r}")
 
 
+# -- binary framing ---------------------------------------------------------
+# The reference negotiates JSON vs protobuf per WS connection
+# (pkg/service/wsprotocol.go — SDKs speak the compact binary form). This
+# build's binary mode is msgpack with numeric kind tags: a deliberate
+# redesign (no protobuf toolchain), same capability — a compact,
+# schema-tagged binary signal wire negotiated per connection.
+#
+# Frame: 0x00 | msgpack([kind_id, data]). The leading 0x00 can never
+# collide with the media frames that share the BINARY channel: those are
+# msgpack maps, whose first byte is 0x80-0x8f or 0xde/0xdf.
+#
+# Kind ids are STABLE WIRE CONSTANTS — append only, never renumber.
+BINARY_MAGIC = 0x00
+
+_REQUEST_ID_LIST = [
+    "offer", "answer", "trickle", "add_track", "mute", "subscription",
+    "track_setting", "leave", "update_layers", "subscription_permission",
+    "sync_state", "simulate", "ping", "update_metadata", "request_relay",
+]
+_RESPONSE_ID_LIST = [
+    "join", "answer", "offer", "trickle", "update", "track_published",
+    "track_unpublished", "leave", "mute", "speakers_changed", "room_update",
+    "connection_quality", "stream_state_update", "subscribed_quality_update",
+    "subscription_permission_update", "refresh_token", "pong", "reconnect",
+    "subscription_response", "request_response", "track_subscribed",
+    "data_packet",
+]
+REQUEST_KIND_TO_ID = {k: i for i, k in enumerate(_REQUEST_ID_LIST)}
+RESPONSE_KIND_TO_ID = {k: i for i, k in enumerate(_RESPONSE_ID_LIST)}
+
+assert set(_REQUEST_ID_LIST) == REQUEST_KINDS
+assert set(_RESPONSE_ID_LIST) == RESPONSE_KINDS
+
+
+def _encode_bin(kind_id: int, data: dict) -> bytes:
+    import msgpack
+
+    return bytes([BINARY_MAGIC]) + msgpack.packb([kind_id, data], use_bin_type=True)
+
+
+def _decode_bin(raw: bytes, id_list: list[str], what: str) -> tuple[str, dict]:
+    import msgpack
+
+    if not raw or raw[0] != BINARY_MAGIC:
+        raise ValueError(f"{what}: not a binary signal frame")
+    try:
+        msg = msgpack.unpackb(raw[1:], raw=False)
+    except Exception as e:  # noqa: BLE001 — malformed wire bytes
+        raise ValueError(f"{what}: malformed msgpack: {e}") from None
+    if not isinstance(msg, (list, tuple)) or len(msg) != 2:
+        raise ValueError(f"{what}: expected [kind_id, data] pair")
+    kind_id, data = msg
+    if not isinstance(kind_id, int) or not 0 <= kind_id < len(id_list):
+        raise ValueError(f"{what}: unknown kind id {kind_id!r}")
+    if data is None:
+        data = {}
+    if not isinstance(data, dict):
+        raise ValueError(f"{what}: payload must be a map")
+    return id_list[kind_id], data
+
+
+def is_binary_signal_frame(data: bytes) -> bool:
+    """Demux for the shared BINARY channel: signal frame vs media frame."""
+    return bool(data) and data[0] == BINARY_MAGIC
+
+
+def encode_signal_request_bin(req: SignalRequest) -> bytes:
+    return _encode_bin(REQUEST_KIND_TO_ID[req.kind], req.data)
+
+
+def decode_signal_request_bin(raw: bytes) -> SignalRequest:
+    return SignalRequest(*_decode_bin(raw, _REQUEST_ID_LIST, "SignalRequest"))
+
+
+def encode_signal_response_bin(resp: SignalResponse) -> bytes:
+    return _encode_bin(RESPONSE_KIND_TO_ID[resp.kind], resp.data)
+
+
+def decode_signal_response_bin(raw: bytes) -> SignalResponse:
+    return SignalResponse(*_decode_bin(raw, _RESPONSE_ID_LIST, "SignalResponse"))
+
+
 def _encode(kind: str, data: dict) -> str:
     return json.dumps({kind: data}, separators=(",", ":"))
 

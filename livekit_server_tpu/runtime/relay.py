@@ -136,27 +136,29 @@ class MediaRelay(asyncio.DatagramProtocol):
         self._sweeper = asyncio.ensure_future(self._sweep())
 
     def datagram_received(self, data: bytes, addr) -> None:
+        is_bind = len(data) == 5 + TOKEN_LEN and data[:4] == RELAY_MAGIC
         alloc = self.by_client.get(addr)
-        if alloc is not None and not (
-            len(data) == 5 + TOKEN_LEN and data[:4] == RELAY_MAGIC
-        ):
+        if alloc is not None and not is_bind:
             alloc.last_active = time.monotonic()
             self.stats["up_fwd"] += 1
             if alloc.upstream.transport is not None:
                 alloc.upstream.transport.sendto(data)
             return
-        if len(data) == 5 + TOKEN_LEN and data[:4] == RELAY_MAGIC and data[4] == BIND_REQ:
+        if is_bind and data[4] == BIND_REQ:
             asyncio.ensure_future(self._bind(data[5:], addr))
             return
         self.stats["dropped"] += 1
 
     # -- allocation lifecycle --------------------------------------------
+    def _reject(self, addr) -> None:
+        self.stats["bad_bind"] += 1
+        if self.transport is not None:
+            self.transport.sendto(RELAY_MAGIC + bytes([BIND_ERR]), addr)
+
     async def _bind(self, token: bytes, addr) -> None:
         key_id = verify_relay_token(self.secret, token)
         if key_id is None:
-            self.stats["bad_bind"] += 1
-            if self.transport is not None:
-                self.transport.sendto(RELAY_MAGIC + bytes([BIND_ERR]), addr)
+            self._reject(addr)
             return
         alloc = self.allocs.get(key_id)
         if alloc is None:
@@ -165,9 +167,7 @@ class MediaRelay(asyncio.DatagramProtocol):
             # Count pending creations against the cap too, or a burst of
             # distinct-token BINDs in one event-loop batch overshoots it.
             if len(self.allocs) + len(self._pending) >= self.max_allocations:
-                self.stats["bad_bind"] += 1
-                if self.transport is not None:
-                    self.transport.sendto(RELAY_MAGIC + bytes([BIND_ERR]), addr)
+                self._reject(addr)
                 return
             proto = _Upstream(self, key_id)
             loop = asyncio.get_running_loop()
@@ -179,9 +179,7 @@ class MediaRelay(asyncio.DatagramProtocol):
             except OSError:
                 # FD pressure / transient failure: tell the client now so
                 # it falls back to TCP instead of timing out.
-                self.stats["bad_bind"] += 1
-                if self.transport is not None:
-                    self.transport.sendto(RELAY_MAGIC + bytes([BIND_ERR]), addr)
+                self._reject(addr)
                 return
             finally:
                 self._pending.discard(key_id)

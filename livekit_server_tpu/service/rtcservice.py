@@ -24,6 +24,7 @@ import msgpack
 from aiohttp import WSMsgType, web
 
 from livekit_server_tpu.auth import TokenError, verify_token
+from livekit_server_tpu.protocol import signal as sigproto
 from livekit_server_tpu.routing.messagechannel import ChannelClosed, ChannelFull
 from livekit_server_tpu.routing.router import ParticipantInit
 from livekit_server_tpu.runtime.ingest import PacketIn
@@ -103,10 +104,25 @@ class RTCService:
             return web.Response(status=503, text=f"signal start failed: {e}")
 
         # -- websocket pump (rtcservice.go:283-439) -----------------------
-        ws = web.WebSocketResponse(heartbeat=30)
+        # Signal wire negotiation (wsprotocol.go JSON-vs-protobuf seat):
+        # `?signal=binary` or WS subprotocol "signal-binary" selects the
+        # compact msgpack signal framing; JSON TEXT remains the default.
+        # Either way the session plumbing sees JSON — transcoding happens
+        # here at the edge.
+        ws = web.WebSocketResponse(
+            heartbeat=30, protocols=("signal-json", "signal-binary")
+        )
         await ws.prepare(request)
+        binary_signal = (
+            request.query.get("signal") == "binary"
+            or ws.ws_protocol == "signal-binary"
+        )
         self.connections += 1
-        pump = asyncio.ensure_future(self._pump_responses(ws, resp_source, room_name, claims.identity))
+        pump = asyncio.ensure_future(
+            self._pump_responses(
+                ws, resp_source, room_name, claims.identity, binary_signal
+            )
+        )
         try:
             async for msg in ws:
                 if msg.type == WSMsgType.TEXT:
@@ -115,6 +131,19 @@ class RTCService:
                     except (ChannelFull, ChannelClosed):
                         break
                 elif msg.type == WSMsgType.BINARY:
+                    if sigproto.is_binary_signal_frame(msg.data):
+                        try:
+                            req = sigproto.decode_signal_request_bin(msg.data)
+                            req_sink.write_message(
+                                sigproto.encode_signal_request(req)
+                            )
+                        except (ValueError, TypeError):
+                            # malformed frame, or a payload JSON can't carry
+                            # (raw bytes in a map value): drop
+                            pass
+                        except (ChannelFull, ChannelClosed):
+                            break
+                        continue
                     self._ingest_media(room_name, claims.identity, msg.data)
                 elif msg.type in (WSMsgType.CLOSE, WSMsgType.ERROR):
                     break
@@ -124,8 +153,12 @@ class RTCService:
             pump.cancel()
         return ws
 
-    async def _pump_responses(self, ws, resp_source, room_name: str, identity: str) -> None:
-        """Server→client: signal JSON as TEXT; media deliveries as BINARY."""
+    async def _pump_responses(
+        self, ws, resp_source, room_name: str, identity: str,
+        binary_signal: bool = False,
+    ) -> None:
+        """Server→client: signal as TEXT JSON (or tagged BINARY msgpack in
+        binary mode); media deliveries as BINARY."""
         sig_t: asyncio.Task | None = None
         med_t: asyncio.Task | None = None
         try:
@@ -145,7 +178,14 @@ class RTCService:
                 if sig_t in done:
                     data = sig_t.result()
                     sig_t = None
-                    await ws.send_str(data)
+                    if binary_signal:
+                        await ws.send_bytes(
+                            sigproto.encode_signal_response_bin(
+                                sigproto.decode_signal_response(data)
+                            )
+                        )
+                    else:
+                        await ws.send_str(data)
                 if med_t is not None and med_t in done:
                     data = med_t.result()
                     med_t = None

@@ -266,12 +266,17 @@ class LivekitServer:
                         next(iter(self.config.keys.values())) if self.config.keys
                         else "dev"
                     ).encode()
+                    # A wildcard bind is not a connectable upstream
+                    # destination (0.0.0.0→loopback only works on Linux);
+                    # the relay's per-allocation sockets dial loopback.
+                    up_host = self.config.bind_addresses[0]
+                    if up_host in ("", "0.0.0.0", "::"):
+                        up_host = "127.0.0.1"
                     try:
                         self.media_relay = await start_media_relay(
                             self.config.bind_addresses[0],
                             rcfg.udp_port,
-                            (self.config.bind_addresses[0] or "127.0.0.1",
-                             self.config.rtc.udp_port),
+                            (up_host, self.config.rtc.udp_port),
                             secret,
                             ttl_s=float(rcfg.allocation_ttl_s),
                             max_allocations=rcfg.max_allocations,

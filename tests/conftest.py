@@ -43,3 +43,14 @@ def pytest_pyfunc_call(pyfuncitem):
 
 def pytest_configure(config):
     config.addinivalue_line("markers", "asyncio: run test under asyncio.run")
+
+
+def free_port() -> int:
+    """One-shot ephemeral port (the shared bind-port-0 idiom)."""
+    import socket
+
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port

@@ -20,15 +20,8 @@ from livekit_server_tpu.routing.tcpbus import BusServer, TCPBusClient
 from livekit_server_tpu.runtime import PlaneRuntime
 from livekit_server_tpu.runtime.ingest import PacketIn
 from livekit_server_tpu.service.server import create_server
+from tests.conftest import free_port
 from tests.test_service import SignalClient, make_config
-
-
-def free_port() -> int:
-    s = socket.socket()
-    s.bind(("127.0.0.1", 0))
-    port = s.getsockname()[1]
-    s.close()
-    return port
 
 
 async def start_bus() -> BusServer:

@@ -27,18 +27,11 @@ from livekit_server_tpu.runtime.relay import (
     verify_relay_token,
 )
 from livekit_server_tpu.runtime.udp import PUNCH_ACK, PUNCH_REQ, UDPMediaTransport
+from tests.conftest import free_port
 from tests.test_native import rtp_packet
 
 DIMS = plane.PlaneDims(rooms=2, tracks=4, pkts=8, subs=4)
 SECRET = b"relay-hmac-secret"
-
-
-def _free_port() -> int:
-    s = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
-    s.bind(("127.0.0.1", 0))
-    port = s.getsockname()[1]
-    s.close()
-    return port
 
 
 def _bind_via(sock: socket.socket, relay_addr, token: bytes) -> None:
@@ -69,7 +62,7 @@ async def test_relay_end_to_end_sealed_media():
     The relay holds no media keys — every forwarded byte string is sealed."""
     runtime = PlaneRuntime(DIMS, tick_ms=10)
     reg = MediaCryptoRegistry()
-    sfu_port, relay_port = _free_port(), _free_port()
+    sfu_port, relay_port = free_port(), free_port()
     loop = asyncio.get_running_loop()
     tr, transport = await loop.create_datagram_endpoint(
         lambda: UDPMediaTransport(runtime.ingest, crypto=reg, require_encryption=True),
@@ -191,7 +184,7 @@ async def test_relay_admission_and_rebind():
     moves the allocation (NAT-rebind recovery) and revokes the old path."""
     runtime = PlaneRuntime(DIMS, tick_ms=10)
     reg = MediaCryptoRegistry()
-    sfu_port, relay_port = _free_port(), _free_port()
+    sfu_port, relay_port = free_port(), free_port()
     loop = asyncio.get_running_loop()
     tr, transport = await loop.create_datagram_endpoint(
         lambda: UDPMediaTransport(runtime.ingest, crypto=reg, require_encryption=True),
@@ -260,7 +253,7 @@ async def test_relay_admission_and_rebind():
 async def test_relay_idle_allocations_expire():
     runtime = PlaneRuntime(DIMS, tick_ms=10)
     reg = MediaCryptoRegistry()
-    sfu_port, relay_port = _free_port(), _free_port()
+    sfu_port, relay_port = free_port(), free_port()
     loop = asyncio.get_running_loop()
     tr, transport = await loop.create_datagram_endpoint(
         lambda: UDPMediaTransport(runtime.ingest, crypto=reg, require_encryption=True),

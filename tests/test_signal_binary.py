@@ -1,0 +1,146 @@
+"""Binary signal framing: the negotiated compact wire alongside JSON.
+
+Reference parity: pkg/service/wsprotocol.go — the reference speaks JSON or
+protobuf per WS connection (SDKs use the binary form). This build's binary
+mode is msgpack with stable numeric kind tags, negotiated via
+`?signal=binary` or the "signal-binary" WS subprotocol; media msgpack
+frames share the BINARY channel behind a one-byte discriminator.
+"""
+
+import asyncio
+import json
+
+import aiohttp
+import msgpack
+
+from livekit_server_tpu.protocol.signal import (
+    REQUEST_KINDS,
+    RESPONSE_KINDS,
+    SignalRequest,
+    SignalResponse,
+    decode_signal_request_bin,
+    decode_signal_response_bin,
+    encode_signal_request,
+    encode_signal_request_bin,
+    encode_signal_response_bin,
+    is_binary_signal_frame,
+)
+from tests.test_service import SignalClient, running_server, token
+
+
+def test_binary_codec_roundtrip_all_kinds():
+    payload = {"sid": "TR_x", "muted": True, "n": 7, "list": [1, 2], "s": "é"}
+    for kind in sorted(REQUEST_KINDS):
+        wire = encode_signal_request_bin(SignalRequest(kind, dict(payload)))
+        assert is_binary_signal_frame(wire)
+        back = decode_signal_request_bin(wire)
+        assert back.kind == kind and back.data == payload
+    for kind in sorted(RESPONSE_KINDS):
+        wire = encode_signal_response_bin(SignalResponse(kind, dict(payload)))
+        back = decode_signal_response_bin(wire)
+        assert back.kind == kind and back.data == payload
+    # The point of the binary wire: smaller than the JSON framing.
+    req = SignalRequest("subscription", {"track_sids": ["TR_a", "TR_b"], "subscribe": True})
+    assert len(encode_signal_request_bin(req)) < len(encode_signal_request(req))
+
+
+def test_binary_frame_demux_never_collides_with_media():
+    # Media frames are msgpack maps: first byte 0x80-0x8f or 0xde/0xdf.
+    media = msgpack.packb({"cid": "mic", "sn": 1, "payload": b"x" * 40})
+    assert not is_binary_signal_frame(media)
+    big = msgpack.packb({f"k{i}": i for i in range(40)})  # map16 form
+    assert not is_binary_signal_frame(big)
+    assert not is_binary_signal_frame(b"")
+    # Malformed binary signal frames raise, never crash into media parsing.
+    for bad in (b"\x00", b"\x00\xc1", b"\x00" + msgpack.packb([999, {}]),
+                b"\x00" + msgpack.packb({"not": "a pair"}),
+                b"\x00" + msgpack.packb([1, "not-a-map"])):
+        try:
+            decode_signal_request_bin(bad)
+            raise AssertionError(f"accepted {bad!r}")
+        except ValueError:
+            pass
+
+
+class BinarySignalClient(SignalClient):
+    """SignalClient speaking the negotiated binary signal wire."""
+
+    def __init__(self, session, port):
+        super().__init__(session, port)
+        self.text_frames = 0
+
+    async def connect(self, room: str, identity: str, query: str = "", **grant_kw):
+        self.ws = await self.session.ws_connect(
+            f"ws://127.0.0.1:{self.port}/rtc?access_token="
+            f"{token(identity, room, **grant_kw)}&signal=binary{query}"
+        )
+        self._reader = asyncio.ensure_future(self._read())
+        return await self.wait_for("join")
+
+    async def _read(self):
+        async for msg in self.ws:
+            if msg.type == aiohttp.WSMsgType.TEXT:
+                self.text_frames += 1
+            elif msg.type == aiohttp.WSMsgType.BINARY:
+                if is_binary_signal_frame(msg.data):
+                    resp = decode_signal_response_bin(msg.data)
+                    self.signals.append({resp.kind: resp.data})
+                else:
+                    self.media.append(msgpack.unpackb(msg.data, raw=False))
+
+    async def send_signal(self, kind: str, data: dict):
+        await self.ws.send_bytes(encode_signal_request_bin(SignalRequest(kind, data)))
+
+
+async def test_binary_signal_end_to_end():
+    """A binary-mode client joins, pings, publishes and receives media —
+    every signal frame BINARY, zero TEXT frames from the server."""
+    async with running_server() as server:
+        async with aiohttp.ClientSession() as s:
+            pub = BinarySignalClient(s, server.port)
+            join = await pub.connect("binroom", "alice")
+            assert join["participant"]["identity"] == "alice"
+
+            sub = SignalClient(s, server.port)  # JSON client in the same room
+            await sub.connect("binroom", "bob")
+
+            await pub.send_signal("ping", {"timestamp": 42})
+            pong = await pub.wait_for("pong")
+            assert pong["last_ping_timestamp"] == 42
+
+            # Media still flows on the shared BINARY channel.
+            await pub.send_signal(
+                "add_track", {"cid": "mic", "type": 0, "name": "m"}
+            )
+            for i in range(3):
+                await pub.send_media(cid="mic", sn=10 + i, ts=960 * i,
+                                     payload=b"opus" + bytes([i]),
+                                     audio_level=30, frame_ms=20)
+                await asyncio.sleep(0.05)
+            media = await sub.wait_media(1)
+            assert media[0]["payload"].startswith(b"opus")
+
+            assert pub.text_frames == 0  # negotiated: no JSON fell through
+            await pub.close()
+            await sub.close()
+
+
+async def test_binary_subprotocol_negotiation():
+    """The WS subprotocol header selects binary mode without the query."""
+    async with running_server() as server:
+        async with aiohttp.ClientSession() as s:
+            ws = await s.ws_connect(
+                f"ws://127.0.0.1:{server.port}/rtc?access_token="
+                f"{token('carol', 'subproto')}",
+                protocols=("signal-binary",),
+            )
+            assert ws.protocol == "signal-binary"
+            got_join = False
+            async for msg in ws:
+                if msg.type == aiohttp.WSMsgType.BINARY and is_binary_signal_frame(msg.data):
+                    resp = decode_signal_response_bin(msg.data)
+                    if resp.kind == "join":
+                        got_join = True
+                        break
+            assert got_join
+            await ws.close()
