@@ -123,8 +123,10 @@ _RESPONSE_ID_LIST = [
 REQUEST_KIND_TO_ID = {k: i for i, k in enumerate(_REQUEST_ID_LIST)}
 RESPONSE_KIND_TO_ID = {k: i for i, k in enumerate(_RESPONSE_ID_LIST)}
 
-assert set(_REQUEST_ID_LIST) == REQUEST_KINDS
-assert set(_RESPONSE_ID_LIST) == RESPONSE_KINDS
+# Always-on invariant (asserts vanish under python -O): a drifted id list
+# would silently renumber wire constants for deployed binary clients.
+if set(_REQUEST_ID_LIST) != REQUEST_KINDS or set(_RESPONSE_ID_LIST) != RESPONSE_KINDS:
+    raise RuntimeError("binary signal kind-id tables out of sync with KINDS")
 
 
 def _encode_bin(kind_id: int, data: dict) -> bytes:

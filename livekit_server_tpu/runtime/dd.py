@@ -11,11 +11,14 @@ every packet's (spatial, temporal) comes from a template-table lookup.
 
 Scope: everything the SFU forwards or rewrites — mandatory fields,
 extended flags, the full template dependency structure (layers, DTIs,
-fdiffs, chains, resolutions), and the active-decode-targets bitmask with
-its exact bit offset so egress can patch it in place. Per-frame custom
-dtis/fdiffs/chains (used by decoders, not by forwarding decisions) are
-not decoded — the descriptor's total length already comes from the
-extension header, so nothing needs them to locate other fields.
+fdiffs, chains, resolutions), the active-decode-targets bitmask with
+its exact bit offset so egress can patch it in place, AND the per-frame
+custom dtis / fdiffs / chain fdiffs (frame_dependency_definition): the
+reference reads them (dependencydescriptorreader.go readFrameDtis /
+readFrameFdiffs / readFrameChains) and its selector prefers a frame's
+custom DTIs over the template's when deciding per-decode-target
+forwarding — so `effective_dtis`/`refine_layer` below feed the same
+override into this build's layer-based selection.
 """
 
 from __future__ import annotations
@@ -121,7 +124,11 @@ class Structure:
     def decode_target_layers(self) -> list[tuple[int, int]]:
         """Per decode target: (spatial, temporal) = max layer of any
         template where the DT is present (the dt → layer map ops/svc's
-        selection consumes)."""
+        selection consumes). Memoized — structures are parsed once per
+        keyframe and never mutated, and this runs in per-packet paths."""
+        cached = getattr(self, "_dt_layers", None)
+        if cached is not None:
+            return cached
         out = []
         for d in range(self.num_decode_targets):
             sp = tp = 0
@@ -130,6 +137,7 @@ class Structure:
                     sp = max(sp, t.spatial)
                     tp = max(tp, t.temporal)
             out.append((sp, tp))
+        object.__setattr__(self, "_dt_layers", out)
         return out
 
 
@@ -144,14 +152,61 @@ class Descriptor:
     active_mask: int | None = None
     active_mask_bit_off: int = -1               # bit offset of the mask
     active_mask_bits: int = 0
+    # frame_dependency_definition overrides (None = use the template's)
+    custom_dtis: list[int] | None = None
+    custom_fdiffs: list[int] | None = None
+    custom_chain_fdiffs: list[int] | None = None
+
+    def _template(self, structure: Structure) -> Template | None:
+        idx = (self.template_id + MAX_TEMPLATES - structure.structure_id) % MAX_TEMPLATES
+        if idx >= len(structure.templates):
+            return None
+        return structure.templates[idx]
 
     def layer(self, structure: Structure) -> tuple[int, int]:
         """(spatial, temporal) of this packet via the template table."""
-        idx = (self.template_id + MAX_TEMPLATES - structure.structure_id) % MAX_TEMPLATES
-        if idx >= len(structure.templates):
+        t = self._template(structure)
+        if t is None:
             return 0, 0
-        t = structure.templates[idx]
         return t.spatial, t.temporal
+
+    def effective_dtis(self, structure: Structure) -> list[int] | None:
+        """Per-decode-target indications for THIS frame: the custom
+        override when present, else the template's (the precedence the
+        reference's DD selector applies)."""
+        if self.custom_dtis is not None:
+            return self.custom_dtis
+        t = self._template(structure)
+        return t.dtis if t is not None and t.dtis else None
+
+    def refine_layer(self, structure: Structure) -> tuple[int, int]:
+        """(spatial, effective temporal) honoring per-frame DTIs.
+
+        The template gives the frame's nominal (s, t). When DTIs mark the
+        frame not-present for every decode target at temporal <= t (a
+        per-frame skip — only expressible via custom dtis), the frame's
+        effective temporal id is the lowest temporal of any decode target
+        that still needs it, so layer-based selection drops it for
+        subscribers below that point exactly as per-DT selection would.
+        Absent from every decode target at this spatial → (s, MAX_TEMPORAL):
+        forwardable to no one.
+
+        Frames WITHOUT custom dtis take the template fast path (one table
+        lookup — this runs per packet at ingest; template dtis are
+        consistent with the template's own (s, t) by construction)."""
+        sp, tp = self.layer(structure)
+        dtis = self.custom_dtis
+        if dtis is None:
+            return sp, tp
+        layers = structure.decode_target_layers()
+        needed = [
+            layers[d][1]
+            for d in range(min(len(dtis), len(layers)))
+            if dtis[d] != DTI_NOT_PRESENT and layers[d][0] >= sp
+        ]
+        if not needed:
+            return sp, MAX_TEMPORAL
+        return sp, max(tp, min(needed))
 
 
 def parse(data: bytes) -> Descriptor:
@@ -168,24 +223,25 @@ def parse(data: bytes) -> Descriptor:
 
     structure_present = r.read_bool()
     active_present = r.read_bool()
-    _custom_dtis = r.read_bool()
-    _custom_fdiffs = r.read_bool()
-    _custom_chains = r.read_bool()
+    custom_dtis = r.read_bool()
+    custom_fdiffs = r.read_bool()
+    custom_chains = r.read_bool()
 
     if structure_present:
         d.structure = _parse_structure(r)
         # Structure attach implies all targets active unless overridden.
         d.active_mask = (1 << d.structure.num_decode_targets) - 1
         d.active_mask_bits = d.structure.num_decode_targets
+    if (active_present or custom_dtis or custom_chains) and d.structure is None:
+        # These fields' widths come from the sender's structure
+        # (decode-target count / chain count); the caller re-parses via
+        # parse_with_structure against its cache.
+        raise NeedStructure(d)
     if active_present:
-        if d.structure is None:
-            # Without a structure in this packet the field width comes
-            # from the cached structure; the caller re-parses via
-            # parse_with_structure.
-            raise NeedStructure(d)
         d.active_mask_bit_off = r.pos
         d.active_mask_bits = d.structure.num_decode_targets
         d.active_mask = r.read_bits(d.structure.num_decode_targets)
+    _parse_frame_deps(r, d, d.structure, custom_dtis, custom_fdiffs, custom_chains)
     return d
 
 
@@ -212,12 +268,37 @@ def parse_with_structure(data: bytes, structure: Structure) -> Descriptor:
     d = Descriptor(first, last, template_id, frame_number)
     r.read_bool()                      # structure_present (False here)
     active_present = r.read_bool()
-    r.read_bits(3)                     # custom dtis/fdiffs/chains flags
+    custom_dtis = r.read_bool()
+    custom_fdiffs = r.read_bool()
+    custom_chains = r.read_bool()
     if active_present:
         d.active_mask_bit_off = r.pos
         d.active_mask_bits = structure.num_decode_targets
         d.active_mask = r.read_bits(structure.num_decode_targets)
+    _parse_frame_deps(r, d, structure, custom_dtis, custom_fdiffs, custom_chains)
     return d
+
+
+def _parse_frame_deps(
+    r: BitReader, d: Descriptor, structure: Structure | None,
+    custom_dtis: bool, custom_fdiffs: bool, custom_chains: bool,
+) -> None:
+    """frame_dependency_definition (dependencydescriptorreader.go
+    readFrameDtis/readFrameFdiffs/readFrameChains): per-frame overrides of
+    the template's dtis / fdiffs / chain diffs."""
+    if custom_dtis:
+        d.custom_dtis = [r.read_bits(2) for _ in range(structure.num_decode_targets)]
+    if custom_fdiffs:
+        d.custom_fdiffs = []
+        while True:
+            size = r.read_bits(2)      # next_fdiff_size: 0 ends the list
+            if size == 0:
+                break
+            if len(d.custom_fdiffs) >= MAX_TEMPLATES:
+                raise ValueError("too many frame fdiffs")
+            d.custom_fdiffs.append(r.read_bits(4 * size) + 1)
+    if custom_chains:
+        d.custom_chain_fdiffs = [r.read_bits(8) for _ in range(structure.num_chains)]
 
 
 def _parse_structure(r: BitReader) -> Structure:
@@ -288,19 +369,28 @@ def build(
     first: bool, last: bool, template_id: int, frame_number: int,
     structure: Structure | None = None, active_mask: int | None = None,
     mask_bits: int = 0,
+    custom_dtis: list[int] | None = None,
+    custom_fdiffs: list[int] | None = None,
+    custom_chain_fdiffs: list[int] | None = None,
 ) -> bytes:
-    """Serialize a DD (subset: no custom dtis/fdiffs/chains), mirroring
-    the reader's field order — used by tests and the traffic synthesizer."""
+    """Serialize a DD mirroring the reader's field order — used by tests
+    and the traffic synthesizer."""
     w = BitWriter()
     w.write_bits(1 if first else 0, 1)
     w.write_bits(1 if last else 0, 1)
     w.write_bits(template_id & 0x3F, 6)
     w.write_bits(frame_number & 0xFFFF, 16)
-    if structure is None and active_mask is None:
+    any_custom = (
+        custom_dtis is not None or custom_fdiffs is not None
+        or custom_chain_fdiffs is not None
+    )
+    if structure is None and active_mask is None and not any_custom:
         return w.tobytes()
     w.write_bits(1 if structure is not None else 0, 1)   # structure present
     w.write_bits(1 if active_mask is not None else 0, 1)  # active present
-    w.write_bits(0, 3)                                    # custom flags
+    w.write_bits(1 if custom_dtis is not None else 0, 1)
+    w.write_bits(1 if custom_fdiffs is not None else 0, 1)
+    w.write_bits(1 if custom_chain_fdiffs is not None else 0, 1)
     if structure is not None:
         w.write_bits(structure.structure_id & 0x3F, 6)
         w.write_bits(structure.num_decode_targets - 1, 5)
@@ -339,4 +429,20 @@ def build(
     if active_mask is not None:
         bits = mask_bits or (structure.num_decode_targets if structure else 0)
         w.write_bits(active_mask, bits)
+    if custom_dtis is not None:
+        for dti in custom_dtis:
+            w.write_bits(dti, 2)
+    if custom_fdiffs is not None:
+        for f in custom_fdiffs:
+            if not 1 <= f <= 4096:
+                # next_fdiff_size is 2 bits (1..3 nibbles): silently
+                # truncating would misalign every later field.
+                raise ValueError(f"custom fdiff {f} outside 1..4096")
+            size = max(1, ((f - 1).bit_length() + 3) // 4)
+            w.write_bits(size, 2)
+            w.write_bits(f - 1, 4 * size)
+        w.write_bits(0, 2)
+    if custom_chain_fdiffs is not None:
+        for cd in custom_chain_fdiffs:
+            w.write_bits(cd, 8)
     return w.tobytes()

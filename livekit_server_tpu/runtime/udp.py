@@ -1117,8 +1117,16 @@ class UDPMediaTransport(asyncio.DatagramProtocol):
                             dd_mod.parse(raw) if struct is None
                             else dd_mod.parse_with_structure(raw, struct)
                         )
+                    except dd_mod.NeedStructure:
+                        # Cold structure cache (restart mid-stream): the
+                        # descriptor can't be interpreted, but its bytes
+                        # are forwardable as-is — keep them on the packet
+                        # (ver -1 ⇒ egress never rewrites the mask).
+                        dd_start[j] = int(parsed["dd_off"][i])
+                        dd_length[j] = int(parsed["dd_len"][i])
+                        continue
                     except ValueError:
-                        continue  # malformed/needs-structure: keep defaults
+                        continue  # malformed: keep defaults, strip DD
                     if desc.structure is not None:
                         struct = desc.structure
                         ver += 1
@@ -1127,7 +1135,12 @@ class UDPMediaTransport(asyncio.DatagramProtocol):
                         kf[j] = True            # structures ride keyframes
                         layer_sync[j] = True
                     if struct is not None:
-                        sp, tp = desc.layer(struct)
+                        # refine_layer honors per-frame custom DTIs: a frame
+                        # skipped for low decode targets gets its effective
+                        # temporal raised so layer selection drops it for
+                        # those subscribers (the reference's custom-dti
+                        # precedence in the DD selector).
+                        sp, tp = desc.refine_layer(struct)
                         layer[j] = sp
                         temporal[j] = tp
                     begin_pic[j] = desc.first_packet_in_frame

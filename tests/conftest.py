@@ -45,11 +45,13 @@ def pytest_configure(config):
     config.addinivalue_line("markers", "asyncio: run test under asyncio.run")
 
 
-def free_port() -> int:
-    """One-shot ephemeral port (the shared bind-port-0 idiom)."""
+def free_port(kind=None) -> int:
+    """One-shot ephemeral port (the shared bind-port-0 idiom). Pass
+    socket.SOCK_DGRAM when the port will be bound for UDP — a TCP-probed
+    port can still be busy on the UDP side."""
     import socket
 
-    s = socket.socket()
+    s = socket.socket(socket.AF_INET, kind or socket.SOCK_STREAM)
     s.bind(("127.0.0.1", 0))
     port = s.getsockname()[1]
     s.close()

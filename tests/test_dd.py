@@ -99,3 +99,65 @@ def test_truncated_dd_rejected():
     raw = dd.build(True, True, template_id=3, frame_number=7, structure=s)
     with pytest.raises(ValueError):
         dd.parse(raw[:5])
+
+
+def test_custom_frame_deps_roundtrip():
+    """frame_dependency_definition: custom dtis/fdiffs/chain-fdiffs decode
+    (dependencydescriptorreader.go readFrameDtis/Fdiffs/Chains)."""
+    s = l2t2_structure()
+    raw = dd.build(
+        True, True, template_id=3, frame_number=10, structure=s,
+        active_mask=0b1011,
+        custom_dtis=[3, 0, 2, 1],
+        custom_fdiffs=[2, 17, 300],     # 1-, 2-, 3-nibble widths
+        custom_chain_fdiffs=[7, 200],
+    )
+    d = dd.parse(raw)
+    assert d.custom_dtis == [3, 0, 2, 1]
+    assert d.custom_fdiffs == [2, 17, 300]
+    assert d.custom_chain_fdiffs == [7, 200]
+    assert d.active_mask == 0b1011
+    # Custom dtis take precedence over the template's.
+    assert d.effective_dtis(d.structure) == [3, 0, 2, 1]
+    d_plain = dd.parse(dd.build(True, True, template_id=3, frame_number=11,
+                                structure=s))
+    assert d_plain.effective_dtis(d_plain.structure) == [3, 2, 3, 2]
+
+    # Without an attached structure the widths need the cache.
+    raw2 = dd.build(False, True, template_id=4, frame_number=12,
+                    custom_dtis=[0, 3, 0, 2], custom_chain_fdiffs=[1, 2],
+                    mask_bits=0)
+    with pytest.raises(dd.NeedStructure):
+        dd.parse(raw2)
+    d2 = dd.parse_with_structure(raw2, s)
+    assert d2.custom_dtis == [0, 3, 0, 2]
+    assert d2.custom_chain_fdiffs == [1, 2]
+    # custom fdiffs alone need no structure at all
+    raw3 = dd.build(False, False, template_id=4, frame_number=13,
+                    custom_fdiffs=[1])
+    assert dd.parse(raw3).custom_fdiffs == [1]
+
+
+def test_refine_layer_honors_custom_dtis():
+    """A frame marked not-present for low decode targets gets its
+    effective temporal raised; absent everywhere at its spatial → dropped
+    for every subscriber (the custom-dti precedence the reference's DD
+    selector applies)."""
+    s = l2t2_structure()
+    # Template (0,0) normally feeds dts 0..3. Custom dtis mark the frame
+    # present ONLY for dt1 (s0,t1) and dt3 (s1,t1) → effective temporal 1.
+    raw = dd.build(True, True, template_id=3, frame_number=20, structure=s,
+                   custom_dtis=[0, 1, 0, 1])
+    d = dd.parse(raw)
+    assert d.layer(d.structure) == (0, 0)
+    assert d.refine_layer(d.structure) == (0, 1)
+    # No custom dtis → template behavior, unchanged.
+    d2 = dd.parse(dd.build(True, True, template_id=3, frame_number=21,
+                           structure=s))
+    assert d2.refine_layer(d2.structure) == d2.layer(d2.structure)
+    # Absent from every decode target at its spatial layer → MAX_TEMPORAL
+    # (forwarded to nobody).
+    raw3 = dd.build(True, True, template_id=3, frame_number=22, structure=s,
+                    custom_dtis=[0, 0, 0, 0])
+    d3 = dd.parse(raw3)
+    assert d3.refine_layer(d3.structure) == (0, dd.MAX_TEMPORAL)

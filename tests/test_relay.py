@@ -62,7 +62,7 @@ async def test_relay_end_to_end_sealed_media():
     The relay holds no media keys — every forwarded byte string is sealed."""
     runtime = PlaneRuntime(DIMS, tick_ms=10)
     reg = MediaCryptoRegistry()
-    sfu_port, relay_port = free_port(), free_port()
+    sfu_port, relay_port = free_port(socket.SOCK_DGRAM), free_port(socket.SOCK_DGRAM)
     loop = asyncio.get_running_loop()
     tr, transport = await loop.create_datagram_endpoint(
         lambda: UDPMediaTransport(runtime.ingest, crypto=reg, require_encryption=True),
@@ -184,7 +184,7 @@ async def test_relay_admission_and_rebind():
     moves the allocation (NAT-rebind recovery) and revokes the old path."""
     runtime = PlaneRuntime(DIMS, tick_ms=10)
     reg = MediaCryptoRegistry()
-    sfu_port, relay_port = free_port(), free_port()
+    sfu_port, relay_port = free_port(socket.SOCK_DGRAM), free_port(socket.SOCK_DGRAM)
     loop = asyncio.get_running_loop()
     tr, transport = await loop.create_datagram_endpoint(
         lambda: UDPMediaTransport(runtime.ingest, crypto=reg, require_encryption=True),
@@ -253,7 +253,7 @@ async def test_relay_admission_and_rebind():
 async def test_relay_idle_allocations_expire():
     runtime = PlaneRuntime(DIMS, tick_ms=10)
     reg = MediaCryptoRegistry()
-    sfu_port, relay_port = free_port(), free_port()
+    sfu_port, relay_port = free_port(socket.SOCK_DGRAM), free_port(socket.SOCK_DGRAM)
     loop = asyncio.get_running_loop()
     tr, transport = await loop.create_datagram_endpoint(
         lambda: UDPMediaTransport(runtime.ingest, crypto=reg, require_encryption=True),

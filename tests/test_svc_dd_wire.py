@@ -121,3 +121,69 @@ async def test_svc_dd_forwarding_and_mask_rewrite():
     finally:
         transport.transport.close()
         await runtime.stop()
+
+
+async def test_cold_cache_custom_dti_dd_forwarded_intact():
+    """Structure cache cold (e.g. SFU restart mid-stream): a DD carrying
+    custom dtis can't be interpreted (NeedStructure) but its BYTES must
+    still ride the forwarded packet — stripping the descriptor would
+    blind downstream decoders until the next keyframe."""
+    runtime = PlaneRuntime(DIMS, tick_ms=10)
+    from tests.conftest import free_port
+
+    port = free_port(socket.SOCK_DGRAM)
+    transport = await start_udp_transport(runtime.ingest, "127.0.0.1", port)
+    try:
+        runtime.set_track(0, 0, published=True, is_video=True, is_svc=True)
+        runtime.set_subscription(0, 0, 1, subscribed=True)
+        ssrc = transport.assign_ssrc(0, 0, is_video=True, svc=True)
+        pub = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
+        pub.bind(("127.0.0.1", 0))
+        sub = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
+        sub.bind(("127.0.0.1", 0))
+        sub.setblocking(False)
+        transport.register_subscriber(0, 1, sub.getsockname())
+
+        struct = l1t2_structure()
+        got = []
+        for i in range(6):
+            if i == 0:
+                # Keyframe with structure starts the stream…
+                dd_bytes = dd.build(True, True, template_id=0, frame_number=0,
+                                    structure=struct, active_mask=0b11,
+                                    mask_bits=2)
+            else:
+                # …then the "restart": structure cache wiped; every later
+                # frame carries custom dtis, which need the lost cache.
+                dd_bytes = dd.build(True, True, template_id=i % 2,
+                                    frame_number=i, custom_dtis=[3, 3],
+                                    mask_bits=2)
+            pub.sendto(av1_packet(2000 + i, 3000 * i, ssrc, dd_bytes),
+                       ("127.0.0.1", port))
+            if i == 0:
+                await asyncio.sleep(0.02)
+                transport._dd_structs.clear()   # simulated restart
+            await asyncio.sleep(0.02)
+            res = await runtime.step_once()
+            transport.send_egress_batch(res.egress_batch)
+            await asyncio.sleep(0.01)
+            while True:
+                try:
+                    d = sub.recvfrom(4096)[0]
+                    if not 192 <= d[1] <= 223:
+                        got.append(d)
+                except BlockingIOError:
+                    break
+        assert len(got) >= 2, "no packets forwarded after cache loss"
+        assert (0, 0) not in transport._dd_structs  # cache stayed cold
+        from livekit_server_tpu.native import rtp as parser
+
+        for d in got[1:]:
+            out = parser.parse_batch(
+                d, np.asarray([0], np.int32), np.asarray([len(d)], np.int32),
+                dd_ext_id=DD_EXT_ID,
+            )[0]
+            assert int(out["dd_off"]) >= 0, "DD stripped on cold cache"
+    finally:
+        transport.transport.close()
+        await runtime.stop()
