@@ -85,7 +85,7 @@ def encode_plan_tick(
             n_len = jnp.where(p_valid, jnp.concatenate([p_len[None], c_len[:-1]]), c_len)
             return (n_sn, n_ts, n_len), out
 
-        (h_sn, h_ts, h_len), outs = jax.lax.scan(step, (h_sn, h_ts, h_len), xs)
+        (h_sn, h_ts, h_len), outs = jax.lax.scan(step, (h_sn, h_ts, h_len), xs, unroll=True)
         return (h_sn, h_ts, h_len), outs
 
     def run_one(h_sn, h_ts, h_len, t_sn, t_ts, t_len, t_valid):

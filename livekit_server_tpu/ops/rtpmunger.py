@@ -162,7 +162,7 @@ def munge_tick(
         return new_carry, (out_sn, out_ts, fwd)
 
     xs = (pkt_sn, pkt_ts, pkt_valid, forward, drop, switch, switch_ts_jump)
-    new_state, (out_sn, out_ts, send) = jax.lax.scan(step, state, xs)
+    new_state, (out_sn, out_ts, send) = jax.lax.scan(step, state, xs, unroll=True)
     return new_state, out_sn, out_ts, send
 
 

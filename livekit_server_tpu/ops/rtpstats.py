@@ -112,7 +112,7 @@ def update_tick(
         ), None
 
     xs = tuple(jnp.moveaxis(a, -1, 0) for a in (pkt_sn, pkt_ts, pkt_size, arrival_rtp, valid))
-    new_state, _ = jax.lax.scan(step, state, xs)
+    new_state, _ = jax.lax.scan(step, state, xs, unroll=True)
     return new_state
 
 

@@ -109,11 +109,158 @@ def select_tick(
         return new_carry, (fwd, drp, sw)
 
     xs = (pkt_spatial, pkt_temporal, pkt_keyframe, pkt_layer_sync, pkt_valid)
-    new_state, (fwd, drp, sw) = jax.lax.scan(step, state, xs)
+    new_state, (fwd, drp, sw) = jax.lax.scan(step, state, xs, unroll=True)
     need_keyframe = (new_state.target_spatial >= 0) & (
         new_state.target_spatial != new_state.current_spatial
     )
     return new_state, fwd, drp, sw, need_keyframe
+
+
+def _both_kernel(sp_ref, tp_ref, kf_ref, sync_ref, eof_ref, valid_ref,
+                 cur_sp_ref, cur_tp_ref, tgt_sp_ref, tgt_tp_ref, svc_ref,
+                 fwd_ref, drp_ref, sw_ref, out_sp_ref, out_tp_ref, nkf_ref):
+    """Pallas TPU kernel: simulcast AND SVC-onion selection for one room,
+    packet loop unrolled in VMEM, subscribers on lanes.
+
+    The scan formulations (select_tick here + svc.select_tick) are 2·K
+    dependent micro-steps per tick — the tick's longest serial chains
+    after allocation. This runs both paths per track (exactly like the
+    plane's where-merge) with the whole carry chain in registers. Packet
+    inputs are [T, K]; state and outputs are [T, S] / [T, K, S];
+    `svc_ref` [T, S] picks the path.
+    """
+    T, K = sp_ref.shape
+    is_svc = svc_ref[:, :] != 0                                    # [T, S]
+    tgt_sp = tgt_sp_ref[:, :]
+    tgt_tp = tgt_tp_ref[:, :]
+    sim_sp, sim_tp = cur_sp_ref[:, :], cur_tp_ref[:, :]
+    svc_sp, svc_tp = cur_sp_ref[:, :], cur_tp_ref[:, :]
+    paused = tgt_sp < 0
+
+    for k in range(K):
+        sp_k = sp_ref[:, k][:, None]
+        tp_k = tp_ref[:, k][:, None]
+        kf_k = kf_ref[:, k][:, None] != 0
+        sync_k = sync_ref[:, k][:, None] != 0
+        eof_k = eof_ref[:, k][:, None] != 0
+        val_k = valid_ref[:, k][:, None] != 0
+
+        # -- simulcast path (select_tick step) ---------------------------
+        want = (tgt_sp != sim_sp) & (tgt_sp >= 0)
+        sw = val_k & kf_k & want & (sp_k == tgt_sp)
+        c_sp = jnp.where(sw, tgt_sp, sim_sp)
+        c_tp = jnp.where(sw, tgt_tp, sim_tp)
+        on_cur = val_k & (sp_k == c_sp) & (c_sp >= 0)
+        can_up = on_cur & sync_k & (tp_k <= tgt_tp)
+        c_tp = jnp.where(can_up & (tp_k > c_tp), tp_k, c_tp)
+        c_tp = jnp.where(on_cur & (tgt_tp < c_tp), tgt_tp, c_tp)
+        fwd_sim = on_cur & (tp_k <= c_tp) & ~paused
+        drp_sim = (on_cur & ~(on_cur & (tp_k <= c_tp))) | (on_cur & paused)
+        sim_sp = jnp.where(paused, -1, c_sp)
+        sim_tp = c_tp
+
+        # -- SVC onion path (svc.select_tick step) -----------------------
+        up = val_k & kf_k & (tgt_sp > svc_sp) & (sp_k <= tgt_sp)
+        s_sp = jnp.where(up, tgt_sp, svc_sp)
+        down = val_k & eof_k & (tgt_sp >= 0) & (tgt_sp < s_sp)
+        s_sp_next = jnp.where(down, tgt_sp, s_sp)
+        on_stream = val_k & (s_sp >= 0)
+        s_tp = jnp.where(up, tgt_tp, svc_tp)
+        can_up2 = on_stream & sync_k & (tp_k <= tgt_tp) & (tp_k > s_tp)
+        s_tp = jnp.where(can_up2, tp_k, s_tp)
+        s_tp = jnp.where(on_stream & (tgt_tp < s_tp), tgt_tp, s_tp)
+        fwd_svc = on_stream & (sp_k <= s_sp) & (tp_k <= s_tp) & ~paused
+        drp_svc = on_stream & ~fwd_svc
+        svc_sp = jnp.where(paused, -1, s_sp_next)
+        svc_tp = s_tp
+
+        # Stay in the int domain for mask merges: Mosaic cannot lower
+        # bool-valued selects (i8 vector -> i1 truncation).
+        fwd_ref[:, k, :] = jnp.where(is_svc, jnp.where(fwd_svc, 1, 0),
+                                     jnp.where(fwd_sim, 1, 0))
+        drp_ref[:, k, :] = jnp.where(is_svc, jnp.where(drp_svc, 1, 0),
+                                     jnp.where(drp_sim, 1, 0))
+        sw_ref[:, k, :] = jnp.where(sw & ~is_svc, 1, 0)
+
+    out_sp = jnp.where(is_svc, svc_sp, sim_sp)
+    out_tp = jnp.where(is_svc, svc_tp, sim_tp)
+    out_sp_ref[:, :] = out_sp
+    out_tp_ref[:, :] = out_tp
+    nkf_sim = (tgt_sp >= 0) & (tgt_sp != out_sp)
+    nkf_svc = (tgt_sp >= 0) & (tgt_sp > out_sp)
+    nkf_ref[:, :] = jnp.where(is_svc, jnp.where(nkf_svc, 1, 0),
+                              jnp.where(nkf_sim, 1, 0))
+
+
+def select_both_tick(state: SelectorState, is_svc, pkt_spatial, pkt_temporal,
+                     pkt_keyframe, pkt_layer_sync, pkt_end_frame, pkt_valid,
+                     use_pallas: bool | None = None, interpret: bool = False):
+    """Merged simulcast + SVC selection for one room's [T] tracks.
+
+    Runs both selector variants over shared state and picks per track by
+    `is_svc` [T] — the plane's selection block as ONE op. TPU takes the
+    fused kernel; CPU (tests/dryrun) the scan formulations.
+
+    Returns (state', fwd [T,K,S] bool, drop, switch, need_kf [T,S] bool).
+    """
+    if use_pallas is None:
+        use_pallas = jax.default_backend() == "tpu"
+    if not (use_pallas or interpret):
+        from livekit_server_tpu.ops import svc as svc_mod
+
+        sel_state, v_fwd, v_drop, v_switch, nk_sim = jax.vmap(select_tick)(
+            state, pkt_spatial, pkt_temporal, pkt_keyframe, pkt_layer_sync,
+            pkt_valid,
+        )
+        svc_state, s_fwd, s_drop, _s_up, nk_svc = jax.vmap(svc_mod.select_tick)(
+            svc_mod.SVCSelectorState(*state), pkt_spatial, pkt_temporal,
+            pkt_keyframe, pkt_layer_sync, pkt_end_frame, pkt_valid,
+        )
+        merged = jax.tree.map(
+            lambda sim, sv: jnp.where(is_svc[:, None], sv, sim),
+            sel_state, SelectorState(*svc_state),
+        )
+        m = is_svc[:, None, None]
+        fwd = jnp.where(m, s_fwd, v_fwd)
+        drop = jnp.where(m, s_drop, v_drop)
+        switch = jnp.where(m, False, v_switch)
+        need_kf = jnp.where(is_svc[:, None], nk_svc, nk_sim)
+        return merged, fwd, drop, switch, need_kf
+
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    T, K = pkt_spatial.shape
+    S = state.current_spatial.shape[-1]
+    spec = pl.BlockSpec(memory_space=pltpu.VMEM)
+    i32 = lambda x: jnp.asarray(x, jnp.int32)  # noqa: E731
+    fwd, drp, sw, out_sp, out_tp, nkf = pl.pallas_call(
+        _both_kernel,
+        out_shape=(
+            jax.ShapeDtypeStruct((T, K, S), jnp.int32),
+            jax.ShapeDtypeStruct((T, K, S), jnp.int32),
+            jax.ShapeDtypeStruct((T, K, S), jnp.int32),
+            jax.ShapeDtypeStruct((T, S), jnp.int32),
+            jax.ShapeDtypeStruct((T, S), jnp.int32),
+            jax.ShapeDtypeStruct((T, S), jnp.int32),
+        ),
+        in_specs=[spec] * 11,
+        out_specs=(spec,) * 6,
+        interpret=interpret,
+    )(
+        i32(pkt_spatial), i32(pkt_temporal), i32(pkt_keyframe),
+        i32(pkt_layer_sync), i32(pkt_end_frame), i32(pkt_valid),
+        state.current_spatial, state.current_temporal,
+        state.target_spatial, state.target_temporal,
+        jnp.broadcast_to(i32(is_svc)[:, None], (T, S)),
+    )
+    new_state = SelectorState(
+        current_spatial=out_sp, current_temporal=out_tp,
+        target_spatial=state.target_spatial,
+        target_temporal=state.target_temporal,
+    )
+    return (new_state, fwd.astype(bool), drp.astype(bool), sw.astype(bool),
+            nkf.astype(bool))
 
 
 def set_target(state: SelectorState, target_spatial: jax.Array, target_temporal: jax.Array) -> SelectorState:

@@ -102,7 +102,7 @@ def select_tick(
 
     xs = (pkt_spatial, pkt_temporal, pkt_keyframe, pkt_switch_up,
           pkt_end_of_frame, pkt_valid)
-    new_state, (fwd, drp, up) = jax.lax.scan(step, state, xs)
+    new_state, (fwd, drp, up) = jax.lax.scan(step, state, xs, unroll=True)
     need_keyframe = (new_state.target_spatial >= 0) & (
         new_state.target_spatial > new_state.current_spatial
     )
@@ -172,7 +172,7 @@ def dd_select_tick(
         return new_carry, (fwd, drp, gap)
 
     xs = (pkt_dti_mask, pkt_switch_mask, pkt_frame, pkt_keyframe, pkt_valid)
-    new_state, (fwd, drp, gap) = jax.lax.scan(step, state, xs)
+    new_state, (fwd, drp, gap) = jax.lax.scan(step, state, xs, unroll=True)
     broken = jnp.any(gap, axis=0)
     return new_state, fwd, drp, broken
 

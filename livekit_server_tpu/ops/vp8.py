@@ -112,5 +112,5 @@ def munge_tick(
         return new_carry, (out_pid, out_tl0, out_ki)
 
     xs = (pid, tl0, keyidx, begin_pic, pkt_valid, forward, drop_pic, switch)
-    new_state, (out_pid, out_tl0, out_ki) = jax.lax.scan(step, state, xs)
+    new_state, (out_pid, out_tl0, out_ki) = jax.lax.scan(step, state, xs, unroll=True)
     return new_state, out_pid, out_tl0, out_ki

@@ -99,3 +99,35 @@ def test_vmap_over_subscribers():
     assert bool(f[0, 0]) and not bool(f[1, 0])   # sub0 on layer 0
     assert not bool(f[0, 1]) and bool(f[1, 1])   # sub1 on layer 1
     assert not f[:, 2].any()                     # sub2 paused
+
+
+def test_pallas_dual_selector_matches_scan():
+    """The fused Pallas simulcast+SVC selection kernel (TPU hot path) is
+    bit-equivalent to the two scan formulations + where-merge — run here
+    in interpreter mode on CPU."""
+    import numpy as np
+
+    from livekit_server_tpu.ops import selector as sel
+
+    rng = np.random.default_rng(3)
+    for _ in range(10):
+        T, K, S = int(rng.choice([4, 16])), int(rng.choice([4, 16])), int(rng.choice([4, 32]))
+        st = sel.SelectorState(
+            current_spatial=jnp.asarray(rng.integers(-1, 3, (T, S)), jnp.int32),
+            current_temporal=jnp.asarray(rng.integers(-1, 4, (T, S)), jnp.int32),
+            target_spatial=jnp.asarray(rng.integers(-1, 3, (T, S)), jnp.int32),
+            target_temporal=jnp.asarray(rng.integers(0, 4, (T, S)), jnp.int32),
+        )
+        is_svc = jnp.asarray(rng.random(T) < 0.5)
+        args = [jnp.asarray(rng.integers(0, 3, (T, K)), jnp.int32),
+                jnp.asarray(rng.integers(0, 4, (T, K)), jnp.int32),
+                jnp.asarray(rng.random((T, K)) < 0.2),
+                jnp.asarray(rng.random((T, K)) < 0.3),
+                jnp.asarray(rng.random((T, K)) < 0.3),
+                jnp.asarray(rng.random((T, K)) < 0.8)]
+        a = sel.select_both_tick(st, is_svc, *args, use_pallas=False)
+        b = sel.select_both_tick(st, is_svc, *args, interpret=True)
+        for xv, yv in zip(a[0], b[0]):
+            assert np.array_equal(np.asarray(xv), np.asarray(yv))
+        for x, y in zip(a[1:], b[1:]):
+            assert np.array_equal(np.asarray(x), np.asarray(y))
