@@ -44,7 +44,6 @@ from livekit_server_tpu.ops import (
     rtpmunger,
     rtpstats,
     selector,
-    sequencer,
     streamtracker,
     svc,
     vp8,
@@ -53,9 +52,10 @@ from livekit_server_tpu.ops import (
 MAX_LAYERS = 3          # simulcast spatial layers (reference: 3 — receiver.go)
 MAX_TEMPORAL = 4        # temporal sublayers tracked per spatial layer
 SPEAKER_TOP_K = 3
-NACK_SLOTS = 8          # max NACKed SNs resolvable per subscriber per tick
 SLAB_WINDOW = 64        # ticks of payload history the host retains for RTX
-                        # (sequencer.go rtt-bounded ring; 64×10 ms = 640 ms)
+                        # (sequencer.go rtt-bounded ring; 64×10 ms = 640 ms —
+                        # NACK resolution itself is host-side: see
+                        # runtime/plane_runtime.py HostSequencer)
 PAD_MAX = 8             # max probe-padding packets per subscriber per tick
                         # (8 × 255 B / 10 ms ≈ 1.6 Mbps of probe headroom)
 # Cold-start per-temporal-sublayer bitrate shares, used only until measured
@@ -103,7 +103,6 @@ class PlaneState(NamedTuple):
     sel: selector.SelectorState          # [R, T, S]
     bwe_state: bwe.BWEState              # [R, S]
     tracker: streamtracker.TrackerState  # [R, T*L] per (track, layer) stream
-    seq: sequencer.SequencerState        # [R, S, RING] — NACK replay rings
     red_state: red.REDState              # [R, T, D] — RED history rings
     temporal_bytes: jax.Array            # [R, T, L, MAX_TEMPORAL] float32 —
                                          # per-temporal byte/tick EMA (the
@@ -138,11 +137,9 @@ class TickInputs(NamedTuple):
     # Per-subscriber feedback, [R, S]:
     estimate: jax.Array        # float32 — TWCC/REMB estimate sample
     estimate_valid: jax.Array  # bool
-    nacks: jax.Array           # float32 — NACK count this tick
-    rtt_ms: jax.Array          # int32 — per-subscriber RTT (replay throttle)
-    # NACK resolution requests, [R, S, NACK_SLOTS] (-1 = empty):
-    nack_sn: jax.Array         # int32 — munged SNs subscribers NACKed
-    nack_track: jax.Array      # int32 — track each NACK targets
+    nacks: jax.Array           # float32 — NACK count this tick (BWE loss
+                               # channel; resolution is host-side — see
+                               # runtime HostSequencer)
     # BWE probe padding (probe_controller → WritePaddingRTP), [R, S]:
     pad_num: jax.Array         # int32 — padding packets to synthesize (≤ PAD_MAX)
     pad_track: jax.Array       # int32 — track whose downtrack carries them (-1 none)
@@ -151,9 +148,6 @@ class TickInputs(NamedTuple):
     roll_quality: jax.Array  # int32 bool-ish — close the stats window this
                              # tick (host sets it ~1/s; the quality outputs
                              # always score the accumulating window)
-    slab_base: jax.Array   # int32 — (tick mod SLAB_WINDOW) * T * K; packet
-                           # row p of this tick gets slab key slab_base + p
-    now_ms: jax.Array      # int32 — monotonic tick clock (sequencer aging)
 
 
 class TickOutputs(NamedTuple):
@@ -193,10 +187,6 @@ class TickOutputs(NamedTuple):
     track_loss_pct: jax.Array  # [R, T] float32
     track_jitter_ms: jax.Array # [R, T] float32
     track_bps: jax.Array       # [R, T] float32 — summed live-layer bitrate
-    # NACK replay resolution (sequencer.getExtPacketMetas analog):
-    replay_key: jax.Array      # [R, S, NACK_SLOTS] int32 slab key; -1 = miss
-    replay_ts: jax.Array       # [R, S, NACK_SLOTS] int32 original munged TS
-    replay_meta: jax.Array     # [R, S, NACK_SLOTS] int32 packed VP8 desc
     # Probe padding synthesized this tick (rtpmunger.padding_tick):
     pad_sn: jax.Array          # [R, S, PAD_MAX] int32 — munged padding SNs
     pad_ts: jax.Array          # [R, S, PAD_MAX] int32
@@ -244,7 +234,6 @@ def init_state(dims: PlaneDims) -> PlaneState:
         sel=jax.tree.map(lambda x: tile(x, R, T), selector.init_state(S)),
         bwe_state=jax.tree.map(lambda x: tile(x, R), bwe.init_state(S)),
         tracker=jax.tree.map(lambda x: tile(x, R), streamtracker.init_state(T * L)),
-        seq=jax.tree.map(lambda x: tile(x, R), sequencer.init_state(S)),
         red_state=jax.tree.map(lambda x: tile(x, R), red.init_state(T)),
         temporal_bytes=jnp.zeros((R, T, L, MAX_TEMPORAL), jnp.float32),
     )
@@ -367,25 +356,10 @@ def _room_tick(
         inp.valid, fwd, drop, switch,
     )
 
-    # ---- NACK replay resolution + sequencer ring push ------------------
-    # Resolve BEFORE pushing (NACKs target earlier ticks), then record this
-    # tick's sends. Entries older than the host's payload-history window
-    # are gated on-device so a stale slab slot is never dereferenced.
-    max_age = (SLAB_WINDOW - 2) * jnp.maximum(inp.tick_ms, 1)
-    seq, replay_key, replay_ts, replay_meta, _replay_ok = sequencer.lookup_nacks(
-        state.seq, inp.nack_sn, inp.nack_track, inp.now_ms, inp.rtt_ms, max_age
-    )
-    P = T * K
-    seq = sequencer.push_tick(
-        seq,
-        out_sn.reshape(P, S),
-        out_ts.reshape(P, S),
-        sequencer.pack_meta(out_pid, out_tl0, out_ki).reshape(P, S),
-        jnp.repeat(jnp.arange(T, dtype=jnp.int32), K),
-        send.reshape(P, S),
-        inp.slab_base + jnp.arange(P, dtype=jnp.int32),
-        inp.now_ms,
-    )
+    # (NACK/RTX replay is host-side: the egress batch already carries the
+    # munged SN/TS/descriptor of every send, so the host keeps the replay
+    # ring in numpy — runtime/plane_runtime.py HostSequencer — and answers
+    # NACKs at RTCP time instead of tick cadence.)
 
     # ---- probe padding (WritePaddingRTP, downtrack.go:764) -------------
     # The host probe controller asks for pad_num packets on pad_track's
@@ -520,7 +494,6 @@ def _room_tick(
         sel=sel_state,
         bwe_state=bwe_state,
         tracker=tracker,
-        seq=seq,
         red_state=red_state,
         temporal_bytes=temporal_bytes,
     )
@@ -560,9 +533,6 @@ def _room_tick(
         track_loss_pct=loss_pct,
         track_jitter_ms=jitter_ms,
         track_bps=jnp.sum(layer_bps, axis=-1),
-        replay_key=replay_key,
-        replay_ts=replay_ts,
-        replay_meta=replay_meta,
         pad_sn=pad_sn,
         pad_ts=pad_ts,
         pad_valid=pad_valid,
@@ -606,7 +576,7 @@ def media_plane_tick(
         return _room_tick(st, i, audio_params, bwe_params, egress_cap, red_enabled)
 
     inp_axes = TickInputs(**{f: 0 for f in TickInputs._fields})._replace(
-        tick_ms=None, roll_quality=None, slab_base=None, now_ms=None
+        tick_ms=None, roll_quality=None
     )
     return jax.vmap(tick_one, in_axes=(0, inp_axes))(state, inp)
 
@@ -631,8 +601,8 @@ _BOOL_FIELDS = {"keyframe", "layer_sync", "begin_pic", "end_frame", "valid"}
 
 
 def pack_tick_inputs(inp: TickInputs):
-    """Host-side: TickInputs → (pkt [F,R,T,K] i32, fb [6,R,S] f32,
-    nk [2,R,S,M] i32, tick_ms, roll_quality, slab_base, now_ms)."""
+    """Host-side: TickInputs → (pkt [F,R,T,K] i32, fb [5,R,S] f32,
+    tick_ms, roll_quality)."""
     import numpy as np
 
     pkt = np.stack([np.asarray(getattr(inp, f)).astype(np.int32) for f in PKT_FIELDS])
@@ -641,28 +611,19 @@ def pack_tick_inputs(inp: TickInputs):
             np.asarray(inp.estimate, np.float32),
             np.asarray(inp.estimate_valid).astype(np.float32),
             np.asarray(inp.nacks, np.float32),
-            np.asarray(inp.rtt_ms, np.float32),
             np.asarray(inp.pad_num, np.float32),
             np.asarray(inp.pad_track, np.float32),
         ]
     )
-    nk = np.stack(
-        [
-            np.asarray(inp.nack_sn, np.int32),
-            np.asarray(inp.nack_track, np.int32),
-        ]
-    )
     return (
-        pkt, fb, nk,
+        pkt, fb,
         np.int32(inp.tick_ms), np.int32(inp.roll_quality),
-        np.int32(inp.slab_base), np.int32(inp.now_ms),
     )
 
 
 def unpack_tick_inputs(
-    pkt: jax.Array, fb: jax.Array, nk: jax.Array,
+    pkt: jax.Array, fb: jax.Array,
     tick_ms: jax.Array, roll_quality: jax.Array,
-    slab_base: jax.Array, now_ms: jax.Array,
 ) -> TickInputs:
     """Device-side (traced): stacked arrays → TickInputs."""
     fields = {}
@@ -674,15 +635,10 @@ def unpack_tick_inputs(
         estimate=fb[0],
         estimate_valid=fb[1] > 0.5,
         nacks=fb[2],
-        rtt_ms=fb[3].astype(jnp.int32),
-        pad_num=fb[4].astype(jnp.int32),
-        pad_track=fb[5].astype(jnp.int32),
-        nack_sn=nk[0],
-        nack_track=nk[1],
+        pad_num=fb[3].astype(jnp.int32),
+        pad_track=fb[4].astype(jnp.int32),
         tick_ms=tick_ms,
         roll_quality=roll_quality,
-        slab_base=slab_base,
-        now_ms=now_ms,
     )
 
 
@@ -725,9 +681,6 @@ def unpack_tick_outputs(
         "track_loss_pct": (R, T),
         "track_jitter_ms": (R, T),
         "track_bps": (R, T),
-        "replay_key": (R, S, NACK_SLOTS),
-        "replay_ts": (R, S, NACK_SLOTS),
-        "replay_meta": (R, S, NACK_SLOTS),
         "pad_sn": (R, S, PAD_MAX),
         "pad_ts": (R, S, PAD_MAX),
         "pad_valid": (R, S, PAD_MAX),

@@ -215,15 +215,10 @@ def next_tick(
         estimate=estimate.astype(np.float32),
         estimate_valid=np.ones((R, S), bool),
         nacks=np.zeros((R, S), np.float32),
-        rtt_ms=np.full((R, S), 100, np.int32),
-        nack_sn=np.full((R, S, plane.NACK_SLOTS), -1, np.int32),
-        nack_track=np.full((R, S, plane.NACK_SLOTS), -1, np.int32),
         pad_num=np.zeros((R, S), np.int32),
         pad_track=np.full((R, S), -1, np.int32),
         tick_ms=np.int32(spec.tick_ms),
         roll_quality=np.int32(0),
-        slab_base=np.int32((tick_index % plane.SLAB_WINDOW) * T * K),
-        now_ms=np.int32((tick_index * spec.tick_ms) & 0x7FFFFFFF),
     )
     new_state = TrafficState(
         sn=new_sn, ts=new_ts, pid=(state.pid + pid_inc) & 0x7FFF,

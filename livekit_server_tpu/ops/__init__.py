@@ -14,7 +14,6 @@ pure-logic hot-path components (SURVEY.md §7 step 2):
   - bwe         — trend detection / channel observation (pkg/sfu/streamallocator)
   - quality     — E-model connection-quality scoring (pkg/sfu/connectionquality)
   - streamtracker — per-layer liveness/bitrate windows (pkg/sfu/streamtracker)
-  - sequencer   — NACK/RTX replay metadata rings (pkg/sfu/sequencer.go)
   - red         — RFC 2198 Opus redundancy planning (pkg/sfu/redreceiver.go)
   - pacer       — per-subscriber leaky-bucket egress pacing (pkg/sfu/pacer)
 

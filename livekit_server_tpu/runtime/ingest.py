@@ -19,6 +19,10 @@ import numpy as np
 
 from livekit_server_tpu.models import plane
 
+# Max NACKed SNs one feedback packet may add to the BWE loss channel (the
+# bound the old device-staging slots enforced; reference drops the same way).
+NACK_COUNT_CAP = 8
+
 
 def _wrap_i32(x: int) -> int:
     """uint32 bit pattern → int32 two's complement (numpy 2.x raises on
@@ -116,13 +120,10 @@ class IngestBuffer:
         self._estimate = np.zeros((R, S), np.float32)
         self._estimate_valid = np.zeros((R, S), bool)
         self._nacks = np.zeros((R, S), np.float32)
-        # NACK resolution requests (sequencer lookups) + per-sub RTT.
-        M = plane.NACK_SLOTS
-        self._nack_sn = np.full((R, S, M), -1, np.int32)
-        self._nack_track = np.full((R, S, M), -1, np.int32)
-        self._nack_cnt = np.zeros((R, S), np.int32)
+        # Per-sub RTT (host replay throttle) — NACK resolution itself is
+        # host-side (plane_runtime.HostSequencer).
         self.rtt_ms = np.full((R, S), 100, np.int32)  # persistent (RR-updated)
-        self.nack_overflow = 0
+        self.nack_overflow = 0   # NACK counts clipped by NACK_COUNT_CAP
         self.dupes = 0
 
     def _alloc_fields(self):
@@ -293,32 +294,21 @@ class IngestBuffer:
             self._nacks[room, sub] += nacks
 
     def push_nack(self, room: int, sub: int, track: int, sns) -> int:
-        """Stage NACKed munged SNs for device-side sequencer resolution
-        (buffer.go RTCP NACK → sequencer.getExtPacketMetas). Returns how
-        many were staged; overflow beyond NACK_SLOTS/tick is counted and
-        the client is expected to re-NACK (reference drops the same way)."""
-        staged = 0
-        for sn in sns:
-            c = self._nack_cnt[room, sub]
-            sn &= 0xFFFF
-            # Dedup within the tick: two feedback packets (or overlapping
-            # BLP masks) naming the same SN must not double-retransmit.
-            if any(
-                self._nack_sn[room, sub, i] == sn
-                and self._nack_track[room, sub, i] == track
-                for i in range(c)
-            ):
-                continue
-            if c >= self._nack_sn.shape[-1]:
-                self.nack_overflow += 1
-                continue
-            self._nack_sn[room, sub, c] = sn
-            self._nack_track[room, sub, c] = track
-            self._nack_cnt[room, sub] = c + 1
-            staged += 1
-        if staged:
-            self._nacks[room, sub] += staged
-        return staged
+        """Count NACKed SNs into the BWE loss channel (nacktracker.go ratio
+        semantics). Resolution/replay is host-side at RTCP time
+        (plane_runtime.HostSequencer.resolve) — not staged for the device.
+
+        Deduped within the feedback packet and capped per call so a client
+        re-sending huge/overlapping BLP masks cannot inflate the loss
+        signal without bound (the old device-staging path enforced the
+        same bound via its slot count)."""
+        unique = len(set(sn & 0xFFFF for sn in sns))
+        n = min(unique, NACK_COUNT_CAP)
+        if unique > n:
+            self.nack_overflow += unique - n
+        if n:
+            self._nacks[room, sub] += n
+        return n
 
     def set_rtt(self, room: int, sub: int, rtt_ms: int) -> None:
         """RR-derived round-trip time (replay throttle input)."""
@@ -397,15 +387,10 @@ class IngestBuffer:
             estimate=self._estimate.copy(),
             estimate_valid=self._estimate_valid.copy(),
             nacks=self._nacks.copy(),
-            rtt_ms=self.rtt_ms.copy(),
-            nack_sn=self._nack_sn.copy(),
-            nack_track=self._nack_track.copy(),
             pad_num=np.asarray(pad_num, np.int32),
             pad_track=np.asarray(pad_track, np.int32),
             tick_ms=np.int32(self.tick_ms),
             roll_quality=np.int32(1 if roll_quality else 0),
-            slab_base=np.int32((tick_index % plane.SLAB_WINDOW) * T * K),
-            now_ms=np.int32((tick_index * self.tick_ms) & 0x7FFFFFFF),
         )
         payloads = PayloadSlab(
             data=bytes(self._slab),
@@ -428,7 +413,4 @@ class IngestBuffer:
         self.audio_level[:] = 127
         self._estimate_valid[:] = False
         self._nacks[:] = 0.0
-        self._nack_sn[:] = -1
-        self._nack_track[:] = -1
-        self._nack_cnt[:] = 0
         return inp, payloads

@@ -104,6 +104,62 @@ class EgressBatch:
         return out
 
 
+class HostSequencer:
+    """Host-side NACK/RTX replay ring (pkg/sfu/sequencer.go:82-370 seat).
+
+    The device's egress batch already hands the host every send's munged
+    SN/TS/descriptor, so the replay ring lives in numpy and NACKs resolve
+    at RTCP time — one tick-cadence device round trip fewer, and the
+    device tick carries no scatter-heavy sequencer state (a TPU scatter
+    serializes per element; the device-side ring was measured at ~80% of
+    the whole tick).
+
+    One ring per (room, sub); slot = munged SN & (RING-1); cross-track
+    collisions evict (a miss makes the client re-NACK, exactly like an
+    evicted reference ring entry). Replays are RTT-throttled per slot
+    (sequencer.go:263 getExtPacketMetas semantics).
+    """
+
+    RING = 512
+
+    def __init__(self, dims: plane.PlaneDims):
+        R, S = dims.rooms, dims.subs
+        self._tk = dims.tracks * dims.pkts
+        self._k = dims.pkts
+        shape = (R, S, self.RING)
+        self.key = np.full(shape, -1, np.int32)       # slab history key
+        self.sn = np.full(shape, -1, np.int32)
+        self.track = np.full(shape, -1, np.int32)
+        self.ts = np.zeros(shape, np.int64)
+        self.pid = np.zeros(shape, np.int32)
+        self.tl0 = np.zeros(shape, np.int32)
+        self.keyidx = np.zeros(shape, np.int32)
+        self.at_tick = np.full(shape, -(1 << 30), np.int64)
+        self.last_ms = np.full(shape, -(1 << 60), np.int64)
+
+    def record(self, batch: "EgressBatch", tick_idx: int) -> None:
+        """Vectorized ring update from one tick's egress batch (the push
+        half of sequencer.go; duplicate slots resolve last-write-wins)."""
+        if not len(batch):
+            return
+        slot = batch.sn & (self.RING - 1)
+        r, s = batch.rooms, batch.subs
+        w = tick_idx % plane.SLAB_WINDOW
+        self.key[r, s, slot] = w * self._tk + batch.tracks * self._k + batch.ks
+        self.sn[r, s, slot] = batch.sn & 0xFFFF
+        self.track[r, s, slot] = batch.tracks
+        self.ts[r, s, slot] = batch.ts.astype(np.int64) & 0xFFFFFFFF
+        self.pid[r, s, slot] = batch.pid
+        self.tl0[r, s, slot] = batch.tl0
+        self.keyidx[r, s, slot] = batch.keyidx
+        self.at_tick[r, s, slot] = tick_idx
+
+    def clear_room(self, room: int) -> None:
+        self.sn[room] = -1
+        self.key[room] = -1
+        self.track[room] = -1
+
+
 @dataclass
 class TickResult:
     """Host-visible outputs of one tick."""
@@ -116,7 +172,9 @@ class TickResult:
     fwd_packets: int
     fwd_bytes: int
     tick_s: float                                    # wall time of the step
-    replays: list[EgressPacket] = field(default_factory=list)  # NACK retransmits
+    # NACK retransmits are no longer tick-cadence: HostSequencer resolves
+    # and transports send them at RTCP time (kept for API compat).
+    replays: list[EgressPacket] = field(default_factory=list)
     padding: list[EgressPacket] = field(default_factory=list)  # probe padding
     # Quality / stats tensors (numpy views of TickOutputs; consumers index
     # by room row). None until the first tick completes.
@@ -149,10 +207,8 @@ def _build_step(audio_params, bwe_params, egress_cap, red_enabled=True):
     """Packed-wire step: ONE input upload, ONE output fetch per tick
     (plane.pack_tick_inputs / pack_tick_outputs)."""
 
-    def tick(state, pkt, fb, nk, tick_ms, roll_quality, slab_base, now_ms):
-        inp = plane.unpack_tick_inputs(
-            pkt, fb, nk, tick_ms, roll_quality, slab_base, now_ms
-        )
+    def tick(state, pkt, fb, tick_ms, roll_quality):
+        inp = plane.unpack_tick_inputs(pkt, fb, tick_ms, roll_quality)
         state, out = plane.media_plane_tick(
             state, inp, audio_params, bwe_params, egress_cap=egress_cap,
             red_enabled=red_enabled,
@@ -220,10 +276,14 @@ class PlaneRuntime:
             # compilation cache instead of re-tracing a fresh closure.
             self._step = _build_step(self._ap, self._bp, self.egress_cap, red_enabled)
 
-        # Rolling payload history for NACK replay (sequencer slab keys
-        # reference slot tick % SLAB_WINDOW; sequencer.lookup_nacks age-gates
-        # on device so a recycled slot is never dereferenced).
+        # Rolling payload history for NACK replay (slab keys reference slot
+        # tick % SLAB_WINDOW; resolve_nacks age-gates so a recycled slot is
+        # never dereferenced) + the host-side replay ring it feeds.
         self._slab_history: list = [None] * plane.SLAB_WINDOW
+        self.host_seq = HostSequencer(dims)
+        # Transports reach the NACK resolver through the ingest seam they
+        # already hold (udp.py RTCP NACK handling).
+        self.ingest.runtime = self
         # BWE probe controller (probe_controller.go) + its inputs mirrored
         # from the previous tick's outputs.
         self.prober = ProbeController(dims, tick_ms)
@@ -274,6 +334,10 @@ class PlaneRuntime:
         self.meta.published[room, :] = False
         self.meta.pub_muted[room, :] = False
         self.ctrl.subscribed[room, :, :] = False
+        # Stale replay-ring entries must not survive row reuse: a new
+        # room's NACK aliasing an old slot would retransmit the PREVIOUS
+        # room's media bytes (cross-room leak).
+        self.host_seq.clear_room(room)
         self._ctrl_dirty = True
 
     def on_tick(self, cb: Callable[[TickResult], Awaitable[None] | None]) -> None:
@@ -392,40 +456,54 @@ class PlaneRuntime:
         self._mirror_probe_inputs(out)
         return await self._complete(out, inp, payloads, idx, roll, t0)
 
-    def _assemble_replays(self, out, inp) -> list[EgressPacket]:
-        """Resolve device replay keys → EgressPackets from the slab history
-        (the replay half of sequencer.go:263; cold path — loss events only,
-        so per-packet objects are fine here)."""
-        rk = np.asarray(out.replay_key)
-        hits = np.nonzero(rk >= 0)
-        if not len(hits[0]):
-            return []
-        from livekit_server_tpu.ops import sequencer
+    def resolve_nacks(self, room: int, sub: int, track: int, sns) -> list[EgressPacket]:
+        """NACKed munged SNs → replay EgressPackets, at RTCP time (the
+        resolve half of sequencer.go:263 getExtPacketMetas; cold path —
+        loss events only, so per-packet objects are fine here).
 
-        TK = self.dims.tracks * self.dims.pkts
+        Misses (evicted slot, wrong track, slab recycled) return nothing —
+        the client re-NACKs. A hit within one RTT of its last replay is
+        throttled."""
+        hs = self.host_seq
+        now_ms = int(time.monotonic() * 1000)
+        rtt = max(1, int(self.ingest.rtt_ms[room, sub]))
         K = self.dims.pkts
-        rts, rmeta = np.asarray(out.replay_ts), np.asarray(out.replay_meta)
         replays: list[EgressPacket] = []
-        for r, s, m in zip(*hits):
-            w, tk = divmod(int(rk[r, s, m]), TK)
+        for sn in sns:
+            sn &= 0xFFFF
+            slot = sn & (hs.RING - 1)
+            if int(hs.sn[room, sub, slot]) != sn:
+                continue
+            if int(hs.track[room, sub, slot]) != track:
+                continue
+            # Age gate: the slab slot recycles after SLAB_WINDOW ticks.
+            if self.tick_index - int(hs.at_tick[room, sub, slot]) > plane.SLAB_WINDOW - 2:
+                continue
+            if now_ms - int(hs.last_ms[room, sub, slot]) < rtt:
+                continue  # RTT replay throttle
+            w, tk = divmod(int(hs.key[room, sub, slot]), hs._tk)
             t, k = divmod(tk, K)
             slab = self._slab_history[w]
             if slab is None:
                 continue
-            payload, marker = slab.get(int(r), t, k)
+            payload, marker = slab.get(room, t, k)
             if not payload:
                 continue
-            pid, tl0, keyidx = sequencer.unpack_meta(int(rmeta[r, s, m]))
+            hs.last_ms[room, sub, slot] = now_ms
             replays.append(
                 EgressPacket(
-                    room=int(r), track=t, sub=int(s),
-                    sn=int(inp.nack_sn[r, s, m]) & 0xFFFF,
-                    ts=int(rts[r, s, m]) & 0xFFFFFFFF,
-                    pid=pid, tl0=tl0, keyidx=keyidx,
+                    room=room, track=t, sub=sub,
+                    sn=sn,
+                    ts=int(hs.ts[room, sub, slot]) & 0xFFFFFFFF,
+                    pid=int(hs.pid[room, sub, slot]),
+                    tl0=int(hs.tl0[room, sub, slot]),
+                    keyidx=int(hs.keyidx[room, sub, slot]),
                     size=len(payload), payload=payload, marker=marker,
-                    dd=slab.get_dd(int(r), t, k),
+                    dd=slab.get_dd(room, t, k),
                 )
             )
+        if replays:
+            self.stats["rtx_packets"] = self.stats.get("rtx_packets", 0) + len(replays)
         return replays
 
     def _assemble_padding(self, out, inp) -> list[EgressPacket]:
@@ -490,16 +568,15 @@ class PlaneRuntime:
         congested: dict[int, list[int]] = {}
         for r, s in zip(*np.nonzero(out.congested)):
             congested.setdefault(int(r), []).append(int(s))
-        replays = self._assemble_replays(out, inp)
-        if replays:
-            self.stats["rtx_packets"] = self.stats.get("rtx_packets", 0) + len(replays)
+        # Feed the host replay ring from this tick's sends (the push half
+        # of the sequencer, now host-side — NACKs resolve at RTCP time).
+        self.host_seq.record(batch, self.tick_index if tick_idx is None else tick_idx)
         padding = self._assemble_padding(out, inp)
         if padding:
             self.stats["pad_packets"] = self.stats.get("pad_packets", 0) + len(padding)
         return TickResult(
             tick_index=self.tick_index if tick_idx is None else tick_idx,
             egress_batch=batch,
-            replays=replays,
             padding=padding,
             speakers=speakers,
             need_keyframe=nk,
@@ -642,9 +719,13 @@ class PlaneRuntime:
         return {"arrays": [z[f"arr_{i}"] for i in range(len(z.files))]}
 
     def restore_room(self, row: int, snap: dict[str, Any]) -> None:
-        """Seed `row` from a snapshot taken on another node: munger/vp8/
-        sequencer offsets continue mid-stream, so migrated subscribers see
-        contiguous SN/TS instead of a stream reset.
+        """Seed `row` from a snapshot taken on another node: munger/VP8
+        offsets continue mid-stream, so migrated subscribers see
+        contiguous SN/TS instead of a stream reset. The host-side replay
+        ring is NOT carried: NACKs of pre-migration packets miss (the
+        payload slab did not travel either) until the destination ring
+        repopulates — clients simply re-request via PLI on a sustained
+        gap, like the reference's post-migration behavior.
 
         Subscription masks are NOT carried over: the destination's slot
         allocator hands out sub columns fresh, and a restored subscribed
@@ -653,6 +734,9 @@ class PlaneRuntime:
         re-subscribe; their (track, sub) munger lanes resume intact."""
         import jax.numpy as jnp
 
+        # The destination row's replay ring starts empty (see docstring) —
+        # and must not retain entries from whatever used the row before.
+        self.host_seq.clear_room(row)
         flat, treedef = jax.tree.flatten(self.state)
         if len(flat) != len(snap["arrays"]):
             raise ValueError(

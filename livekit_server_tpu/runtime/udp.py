@@ -737,7 +737,15 @@ class UDPMediaTransport(asyncio.DatagramProtocol):
                     continue
                 sns = parse_nack_fci(chunk[12:])
                 self.stats["nacks_rx"] += len(sns)
+                # BWE loss channel (count) + immediate host-side replay
+                # (sequencer.go:263 — answered at RTCP time, not on the
+                # next tick; the reference replies immediately too).
                 self.ingest.push_nack(room, sub, track, sns)
+                runtime = getattr(self.ingest, "runtime", None)
+                if runtime is not None:
+                    replays = runtime.resolve_nacks(room, sub, track, sns)
+                    if replays:
+                        self.send_egress(replays, rtx=True)
             elif pt == RTCP_PSFB and fmt == 1:
                 dest = self.egress_rev.get(media_ssrc)
                 if dest is None:

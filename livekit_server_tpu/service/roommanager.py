@@ -320,8 +320,9 @@ class RoomManager:
     async def handoff_room(self, name: str, target_node_id: str = "") -> bool:
         """Publish a room's media-plane row to the bus and unpin (or repin)
         it, so another node's get_or_create_room resumes mid-stream with
-        intact munger/VP8/sequencer offsets — migrated subscribers see
-        contiguous SN/TS instead of a stream reset."""
+        intact munger/VP8 offsets — migrated subscribers see contiguous
+        SN/TS instead of a stream reset. (The host-side NACK replay ring
+        does not travel; post-migration NACKs miss until it repopulates.)"""
         room = self.rooms.get(name)
         bus = getattr(self.router, "bus", None)
         if room is None or bus is None:
@@ -394,8 +395,6 @@ class RoomManager:
                     self.runtime.ctrl.max_spatial, self.runtime.ctrl.max_temporal
                 ),
             )
-            if res.replays:
-                self.udp.send_egress(res.replays, rtx=True)  # NACK retransmits
             if res.padding:
                 # BWE probe padding (UDP subscribers only — padding is a
                 # channel measurement, meaningless over the WS loopback).

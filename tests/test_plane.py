@@ -50,15 +50,10 @@ def make_inputs(dims: plane.PlaneDims, **over):
         estimate=jnp.zeros((R, S), jnp.float32),
         estimate_valid=jnp.zeros((R, S), jnp.bool_),
         nacks=jnp.zeros((R, S), jnp.float32),
-        rtt_ms=jnp.full((R, S), 100, jnp.int32),
-        nack_sn=jnp.full((R, S, plane.NACK_SLOTS), -1, jnp.int32),
-        nack_track=jnp.full((R, S, plane.NACK_SLOTS), -1, jnp.int32),
         pad_num=jnp.zeros((R, S), jnp.int32),
         pad_track=jnp.full((R, S), -1, jnp.int32),
         tick_ms=jnp.int32(20),
         roll_quality=jnp.int32(0),
-        slab_base=jnp.int32(0),
-        now_ms=jnp.int32(0),
     )
     return inp._replace(**over)
 

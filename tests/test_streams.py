@@ -8,7 +8,7 @@ pacer/leaky_bucket drain behavior.
 import jax.numpy as jnp
 import numpy as np
 
-from livekit_server_tpu.ops import pacer, red, sequencer, streamtracker
+from livekit_server_tpu.ops import pacer, red, streamtracker
 
 
 # ---- stream tracker ---------------------------------------------------
@@ -40,99 +40,69 @@ def test_tracker_bitrate_tracks_input():
     assert abs(float(bps[0]) - 1_000_000) < 1e-3
 
 
-# ---- sequencer / NACK -------------------------------------------------
+# ---- host sequencer / NACK --------------------------------------------
+# (pkg/sfu/sequencer.go semantics, host-side: the ring feeds from the
+# egress batch and resolves NACKs at RTCP time — plane_runtime.HostSequencer)
 
-def _push(st, out_sn, sent, keys, now_ms, track=None, ts=None, meta=None):
-    P, S = out_sn.shape
-    track = track if track is not None else jnp.zeros((P,), jnp.int32)
-    ts = ts if ts is not None else out_sn * 10
-    meta = meta if meta is not None else jnp.zeros((P, S), jnp.int32)
-    return sequencer.push_tick(st, out_sn, ts, meta, track, sent, keys, now_ms)
+def _mini_runtime():
+    from livekit_server_tpu.models import plane as plane_mod
+    from livekit_server_tpu.runtime import PlaneRuntime
+    from livekit_server_tpu.runtime.ingest import PacketIn
 
-
-def _lookup(st, nacks, now_ms, rtt, track=None, max_age=1 << 30):
-    track = track if track is not None else jnp.zeros_like(nacks)
-    return sequencer.lookup_nacks(st, nacks, track, now_ms, rtt, max_age)
-
-
-def test_sequencer_push_and_nack_replay():
-    st = sequencer.init_state(2)
-    out_sn = jnp.asarray([[100, 200], [101, 201]], jnp.int32)  # [P=2, S=2]
-    sent = jnp.asarray([[True, True], [True, False]])
-    st = _push(st, out_sn, sent, jnp.asarray([7, 8], jnp.int32), 1000)
-
-    nacks = jnp.asarray([[100, 101], [200, 201]], jnp.int32)
-    st, key, ts, meta, ok = _lookup(st, nacks, 1100, jnp.asarray([50, 50], jnp.int32))
-    assert ok.tolist() == [[True, True], [True, False]]  # 201 never sent to sub1
-    assert key.tolist() == [[7, 8], [7, -1]]
-    assert int(ts[0, 0]) == 1000  # original munged TS travels with the slot
+    rt = PlaneRuntime(plane_mod.PlaneDims(1, 2, 4, 2), tick_ms=10)
+    rt.set_track(0, 0, published=True, is_video=False)
+    rt.set_subscription(0, 0, 1, subscribed=True)
+    return rt, PacketIn
 
 
-def test_sequencer_track_mismatch_rejected():
-    st = sequencer.init_state(1)
-    st = _push(
-        st, jnp.asarray([[100]], jnp.int32), jnp.asarray([[True]]),
-        jnp.asarray([7], jnp.int32), 0, track=jnp.asarray([2], jnp.int32),
-    )
-    # NACK for the same SN on a different track misses (shared-ring safety).
-    st, key, _ts, _m, ok = _lookup(
-        st, jnp.asarray([[100]], jnp.int32), 10, jnp.asarray([1], jnp.int32),
-        track=jnp.asarray([[1]], jnp.int32),
-    )
-    assert not bool(ok[0, 0])
-    st, key, _ts, _m, ok = _lookup(
-        st, jnp.asarray([[100]], jnp.int32), 10, jnp.asarray([1], jnp.int32),
-        track=jnp.asarray([[2]], jnp.int32),
-    )
-    assert bool(ok[0, 0]) and int(key[0, 0]) == 7
+async def test_host_sequencer_resolve_replay():
+    rt, PacketIn = _mini_runtime()
+    for i in range(3):
+        rt.ingest.push(PacketIn(room=0, track=0, sn=600 + i, ts=960 * i,
+                                size=5, payload=b"opus" + bytes([i])))
+        await rt.step_once()
+    # The ring learned this tick's sends from the egress batch.
+    reps = rt.resolve_nacks(0, 1, 0, [601])
+    assert len(reps) == 1
+    rp = reps[0]
+    assert (rp.room, rp.sub, rp.track, rp.sn) == (0, 1, 0, 601)
+    assert rp.payload == b"opus\x01"
+    # Unknown SN and wrong track miss (shared-ring safety).
+    assert rt.resolve_nacks(0, 1, 0, [9999]) == []
+    assert rt.resolve_nacks(0, 1, 1, [600]) == []
 
 
-def test_sequencer_vp8_meta_roundtrip():
-    pid, tl0, ki = 12345, 200, 17
-    meta = sequencer.pack_meta(
-        jnp.asarray(pid), jnp.asarray(tl0), jnp.asarray(ki)
-    )
-    p, t, k = sequencer.unpack_meta(int(meta))
-    assert (p, t, k) == (pid, tl0, ki)
+async def test_host_sequencer_rtt_throttle_and_age_gate():
+    from livekit_server_tpu.models import plane as plane_mod
+
+    rt, PacketIn = _mini_runtime()
+    rt.ingest.push(PacketIn(room=0, track=0, sn=700, ts=0, size=4, payload=b"pay!"))
+    await rt.step_once()
+    assert len(rt.resolve_nacks(0, 1, 0, [700])) == 1
+    # Immediate duplicate within RTT (default 100 ms) → throttled.
+    assert rt.resolve_nacks(0, 1, 0, [700]) == []
+    # After the throttle clears → replayable again.
+    slot = 700 & (rt.host_seq.RING - 1)
+    rt.host_seq.last_ms[0, 1, slot] -= 10_000
+    assert len(rt.resolve_nacks(0, 1, 0, [700])) == 1
+    # Entry older than the slab window must not resolve (slot recycled).
+    rt.host_seq.last_ms[0, 1, slot] -= 10_000
+    rt.host_seq.at_tick[0, 1, slot] -= plane_mod.SLAB_WINDOW
+    assert rt.resolve_nacks(0, 1, 0, [700]) == []
 
 
-def test_sequencer_rtt_throttle():
-    st = sequencer.init_state(1)
-    st = _push(
-        st, jnp.asarray([[500]], jnp.int32), jnp.asarray([[True]]),
-        jnp.asarray([3], jnp.int32), 0,
-    )
-    nack = jnp.asarray([[500]], jnp.int32)
-    st, key, _ts, _m, ok = _lookup(st, nack, 10, jnp.asarray([100], jnp.int32))
-    assert bool(ok[0, 0])
-    # immediate repeat within RTT → throttled
-    st, key, _ts, _m, ok = _lookup(st, nack, 50, jnp.asarray([100], jnp.int32))
-    assert not bool(ok[0, 0])
-    # after RTT → replayable again
-    st, key, _ts, _m, ok = _lookup(st, nack, 200, jnp.asarray([100], jnp.int32))
-    assert bool(ok[0, 0])
-
-
-def test_sequencer_age_gate():
-    st = sequencer.init_state(1)
-    st = _push(
-        st, jnp.asarray([[500]], jnp.int32), jnp.asarray([[True]]),
-        jnp.asarray([3], jnp.int32), 0,
-    )
-    # Entry older than the host slab window must not resolve.
-    st, key, _ts, _m, ok = _lookup(
-        st, jnp.asarray([[500]], jnp.int32), 700, jnp.asarray([10], jnp.int32),
-        max_age=620,
-    )
-    assert not bool(ok[0, 0])
-
-
-def test_sequencer_unknown_sn_rejected():
-    st = sequencer.init_state(1)
-    st, key, _ts, _m, ok = _lookup(
-        st, jnp.asarray([[12345]], jnp.int32), 0, jnp.asarray([0], jnp.int32)
-    )
-    assert not bool(ok[0, 0]) and int(key[0, 0]) == -1
+async def test_host_sequencer_ring_eviction():
+    rt, PacketIn = _mini_runtime()
+    RING = rt.host_seq.RING
+    rt.ingest.push(PacketIn(room=0, track=0, sn=100, ts=0, size=1, payload=b"a"))
+    await rt.step_once()
+    # A later send whose SN aliases the same slot evicts the old entry.
+    rt.ingest.push(PacketIn(room=0, track=0, sn=100 + RING, ts=10, size=1,
+                            payload=b"b"))
+    await rt.step_once()
+    assert rt.resolve_nacks(0, 1, 0, [100]) == []           # evicted
+    reps = rt.resolve_nacks(0, 1, 0, [(100 + RING) & 0xFFFF])
+    assert len(reps) == 1 and reps[0].payload == b"b"
 
 
 # ---- RED --------------------------------------------------------------

@@ -309,7 +309,8 @@ async def test_udp_punch_latches_only_real_source():
 async def test_udp_nack_rtx_end_to_end():
     """A subscriber loses a packet, NACKs it over RTCP, and receives the
     retransmit with the original munged SN and payload bytes (the
-    buffer.go:673 → sequencer.go:263 replay loop, device-resolved)."""
+    buffer.go:673 → sequencer.go:263 replay loop — resolved host-side at
+    RTCP time by the HostSequencer, no device round trip)."""
     from livekit_server_tpu.runtime.udp import build_nack
 
     runtime = PlaneRuntime(DIMS, tick_ms=10)
@@ -346,19 +347,13 @@ async def test_udp_nack_rtx_end_to_end():
             except BlockingIOError:
                 break
 
-        # The client NACKs munged SN 602 on its downtrack SSRC.
+        # The client NACKs munged SN 602 on its downtrack SSRC; the
+        # retransmit comes back immediately (no tick in between).
         dt_ssrc = transport.subscriber_ssrc(0, 1, 0)
         sub.sendto(build_nack(0x1234, dt_ssrc, [602]), ("127.0.0.1", port))
-        await asyncio.sleep(0.03)
+        await asyncio.sleep(0.05)
         assert transport.stats["nacks_rx"] == 1
-
-        res = await runtime.step_once()
-        assert len(res.replays) == 1
-        rp = res.replays[0]
-        assert (rp.room, rp.sub, rp.track) == (0, 1, 0)
-        assert rp.sn == 602 and rp.payload == b"opus\x02"
-        transport.send_egress(res.replays)
-        await asyncio.sleep(0.03)
+        assert runtime.stats.get("rtx_packets", 0) == 1
         data, _ = sub.recvfrom(2048)
         out = parser.parse_batch(
             data, np.asarray([0], np.int32), np.asarray([len(data)], np.int32)
@@ -367,11 +362,15 @@ async def test_udp_nack_rtx_end_to_end():
         off, ln = int(out["payload_off"]), int(out["payload_len"])
         assert data[off : off + ln] == b"opus\x02"
 
-        # Immediate duplicate NACK is RTT-throttled on device.
+        # Immediate duplicate NACK is RTT-throttled host-side.
         sub.sendto(build_nack(0x1234, dt_ssrc, [602]), ("127.0.0.1", port))
-        await asyncio.sleep(0.03)
-        res = await runtime.step_once()
-        assert len(res.replays) == 0
+        await asyncio.sleep(0.05)
+        assert runtime.stats.get("rtx_packets", 0) == 1  # no second replay
+        try:
+            sub.recvfrom(2048)
+            raise AssertionError("throttled NACK produced a retransmit")
+        except BlockingIOError:
+            pass
         pub.close()
         sub.close()
     finally:
