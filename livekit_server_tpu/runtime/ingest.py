@@ -19,8 +19,8 @@ import numpy as np
 
 from livekit_server_tpu.models import plane
 
-# Max NACKed SNs one feedback packet may add to the BWE loss channel (the
-# bound the old device-staging slots enforced; reference drops the same way).
+# Max NACKed SNs per (room, sub) per TICK counted into the BWE loss channel
+# (the bound the old device-staging slots enforced; reference drops the same).
 NACK_COUNT_CAP = 8
 
 
@@ -124,6 +124,8 @@ class IngestBuffer:
         # host-side (plane_runtime.HostSequencer).
         self.rtt_ms = np.full((R, S), 100, np.int32)  # persistent (RR-updated)
         self.nack_overflow = 0   # NACK counts clipped by NACK_COUNT_CAP
+        self._nack_seen: set = set()           # per-tick (r, s, sn, track)
+        self._nack_tick_cnt = np.zeros((R, S), np.int32)
         self.dupes = 0
 
     def _alloc_fields(self):
@@ -298,17 +300,23 @@ class IngestBuffer:
         semantics). Resolution/replay is host-side at RTCP time
         (plane_runtime.HostSequencer.resolve) — not staged for the device.
 
-        Deduped within the feedback packet and capped per call so a client
-        re-sending huge/overlapping BLP masks cannot inflate the loss
-        signal without bound (the old device-staging path enforced the
-        same bound via its slot count)."""
-        unique = len(set(sn & 0xFFFF for sn in sns))
-        n = min(unique, NACK_COUNT_CAP)
-        if unique > n:
-            self.nack_overflow += unique - n
-        if n:
-            self._nacks[room, sub] += n
-        return n
+        Deduped per (sn, track) ACROSS the tick and hard-capped at
+        NACK_COUNT_CAP per (room, sub) per tick, so repeated/overlapping
+        feedback packets cannot inflate the loss signal without bound."""
+        staged = 0
+        for sn in sns:
+            key = (room, sub, sn & 0xFFFF, track)
+            if key in self._nack_seen:
+                continue
+            if self._nack_tick_cnt[room, sub] >= NACK_COUNT_CAP:
+                self.nack_overflow += 1
+                continue
+            self._nack_seen.add(key)
+            self._nack_tick_cnt[room, sub] += 1
+            staged += 1
+        if staged:
+            self._nacks[room, sub] += staged
+        return staged
 
     def set_rtt(self, room: int, sub: int, rtt_ms: int) -> None:
         """RR-derived round-trip time (replay throttle input)."""
@@ -413,4 +421,6 @@ class IngestBuffer:
         self.audio_level[:] = 127
         self._estimate_valid[:] = False
         self._nacks[:] = 0.0
+        self._nack_seen.clear()
+        self._nack_tick_cnt[:] = 0
         return inp, payloads

@@ -121,11 +121,20 @@ class HostSequencer:
     """
 
     RING = 512
+    # Retransmit-amplification bounds: one compound NACK (BLP masks) can
+    # name the whole slab window — tiny RTCP in must not buy full-history
+    # media out. Per-resolve burst cap + per-subscriber replay budget that
+    # refills each second (sequencer.go bounds the same pressure via its
+    # per-tick staging slots).
+    BURST_CAP = 16
+    BUDGET_PER_S = 256
 
     def __init__(self, dims: plane.PlaneDims):
         R, S = dims.rooms, dims.subs
         self._tk = dims.tracks * dims.pkts
         self._k = dims.pkts
+        self.budget = np.full((R, S), self.BUDGET_PER_S, np.int32)
+        self._budget_refill_ms = np.zeros((R, S), np.int64)
         shape = (R, S, self.RING)
         self.key = np.full(shape, -1, np.int32)       # slab history key
         self.sn = np.full(shape, -1, np.int32)
@@ -281,9 +290,6 @@ class PlaneRuntime:
         # never dereferenced) + the host-side replay ring it feeds.
         self._slab_history: list = [None] * plane.SLAB_WINDOW
         self.host_seq = HostSequencer(dims)
-        # Transports reach the NACK resolver through the ingest seam they
-        # already hold (udp.py RTCP NACK handling).
-        self.ingest.runtime = self
         # BWE probe controller (probe_controller.go) + its inputs mirrored
         # from the previous tick's outputs.
         self.prober = ProbeController(dims, tick_ms)
@@ -466,10 +472,15 @@ class PlaneRuntime:
         throttled."""
         hs = self.host_seq
         now_ms = int(time.monotonic() * 1000)
+        if now_ms - int(hs._budget_refill_ms[room, sub]) >= 1000:
+            hs.budget[room, sub] = hs.BUDGET_PER_S
+            hs._budget_refill_ms[room, sub] = now_ms
         rtt = max(1, int(self.ingest.rtt_ms[room, sub]))
         K = self.dims.pkts
         replays: list[EgressPacket] = []
         for sn in sns:
+            if len(replays) >= hs.BURST_CAP or hs.budget[room, sub] <= 0:
+                break  # amplification bound; the client re-NACKs what's left
             sn &= 0xFFFF
             slot = sn & (hs.RING - 1)
             if int(hs.sn[room, sub, slot]) != sn:
@@ -490,6 +501,7 @@ class PlaneRuntime:
             if not payload:
                 continue
             hs.last_ms[room, sub, slot] = now_ms
+            hs.budget[room, sub] -= 1
             replays.append(
                 EgressPacket(
                     room=room, track=t, sub=sub,

@@ -255,8 +255,12 @@ class UDPMediaTransport(asyncio.DatagramProtocol):
         ingest: IngestBuffer,
         crypto: MediaCryptoRegistry | None = None,
         require_encryption: bool = False,
+        nack_resolver=None,
     ):
         self.ingest = ingest
+        # NACK → replay-packet resolver (PlaneRuntime.resolve_nacks);
+        # None = RTX disabled (bare-ingest tooling/tests).
+        self.nack_resolver = nack_resolver
         # AEAD media-wire crypto (runtime/crypto.py — the DTLS-SRTP seat).
         # require_encryption drops every plaintext RTP/RTCP/punch datagram;
         # False keeps the legacy cleartext path for in-process tooling.
@@ -741,9 +745,8 @@ class UDPMediaTransport(asyncio.DatagramProtocol):
                 # (sequencer.go:263 — answered at RTCP time, not on the
                 # next tick; the reference replies immediately too).
                 self.ingest.push_nack(room, sub, track, sns)
-                runtime = getattr(self.ingest, "runtime", None)
-                if runtime is not None:
-                    replays = runtime.resolve_nacks(room, sub, track, sns)
+                if self.nack_resolver is not None:
+                    replays = self.nack_resolver(room, sub, track, sns)
                     if replays:
                         self.send_egress(replays, rtx=True)
             elif pt == RTCP_PSFB and fmt == 1:
@@ -1764,11 +1767,12 @@ async def start_udp_transport(
     port: int = 7882,
     crypto: MediaCryptoRegistry | None = None,
     require_encryption: bool = False,
+    nack_resolver=None,
 ) -> UDPMediaTransport:
     import socket as _socket
 
     loop = asyncio.get_running_loop()
-    protocol = UDPMediaTransport(ingest, crypto, require_encryption)
+    protocol = UDPMediaTransport(ingest, crypto, require_encryption, nack_resolver)
     is_v4 = ":" not in host  # rx_batch parses sockaddr_in (IPv4) only
     if native_egress is not None and is_v4:
         # Native batch-receive path: raw socket + recvmmsg per event-loop

@@ -226,6 +226,7 @@ class LivekitServer:
                     self.config.rtc.udp_port,
                     crypto=self.room_manager.crypto,
                     require_encryption=self.config.rtc.require_encryption,
+                    nack_resolver=self.room_manager.runtime.resolve_nacks,
                 )
                 # Client PLIs over RTCP reach signal-plane publishers too.
                 self.room_manager.udp.on_pli = self.room_manager.handle_pli

@@ -318,7 +318,9 @@ async def test_udp_nack_rtx_end_to_end():
     s.bind(("127.0.0.1", 0))
     port = s.getsockname()[1]
     s.close()
-    transport = await start_udp_transport(runtime.ingest, "127.0.0.1", port)
+    transport = await start_udp_transport(
+        runtime.ingest, "127.0.0.1", port, nack_resolver=runtime.resolve_nacks
+    )
     try:
         runtime.set_track(0, 0, published=True, is_video=False)
         runtime.set_subscription(0, 0, 1, subscribed=True)
