@@ -392,6 +392,7 @@ def main() -> None:
             try:
                 r = device_bench(d, s, ticks=15, warmup=3)
                 configs[name] = r["fwd_writes_per_s"]
+                configs[name + "_tick_ms"] = r["device_tick_ms"]
             except Exception as e:  # noqa: BLE001
                 configs[name] = f"error: {type(e).__name__}"
         result["configs"] = configs

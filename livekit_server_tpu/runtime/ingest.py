@@ -308,10 +308,12 @@ class IngestBuffer:
             key = (room, sub, sn & 0xFFFF, track)
             if key in self._nack_seen:
                 continue
+            # Dedup BEFORE the cap check so re-sent duplicates above the
+            # cap don't inflate the overflow stat.
+            self._nack_seen.add(key)
             if self._nack_tick_cnt[room, sub] >= NACK_COUNT_CAP:
                 self.nack_overflow += 1
                 continue
-            self._nack_seen.add(key)
             self._nack_tick_cnt[room, sub] += 1
             staged += 1
         if staged:

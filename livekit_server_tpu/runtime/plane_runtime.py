@@ -167,6 +167,10 @@ class HostSequencer:
         self.sn[room] = -1
         self.key[room] = -1
         self.track[room] = -1
+        # A recycled row must not inherit the previous room's drained
+        # replay budget.
+        self.budget[room] = self.BUDGET_PER_S
+        self._budget_refill_ms[room] = 0
 
 
 @dataclass

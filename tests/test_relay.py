@@ -279,3 +279,131 @@ async def test_relay_idle_allocations_expire():
     finally:
         relay.close()
         tr.close()
+
+
+async def test_relay_through_full_server():
+    """Service tier: a publisher AND subscriber that never touch the SFU
+    media port — relay allocations minted over the signal channel, sealed
+    punch + sealed media both ways through the embedded relay (turn.go:47
+    capability through the whole product stack)."""
+    import base64
+
+    import aiohttp
+
+    from livekit_server_tpu.runtime.udp import PUNCH_ACK, PUNCH_REQ
+    from tests.conftest import free_port
+    from tests.test_native import rtp_packet
+    from tests.test_service import SignalClient, running_server
+
+    relay_port = free_port(socket.SOCK_DGRAM)
+
+    async def wait_rr(client, key, timeout=3.0):
+        # wait_for returns the OLDEST request_response; pick by payload key
+        # (relay_info responses precede the udp ones in the log).
+        deadline = asyncio.get_event_loop().time() + timeout
+        while asyncio.get_event_loop().time() < deadline:
+            for m in client.signals:
+                rr = m.get("request_response")
+                if rr and key in rr:
+                    return rr
+            await asyncio.sleep(0.01)
+        raise TimeoutError(f"no request_response with {key!r}")
+
+    def enable_relay(cfg):
+        cfg.relay.enabled = True
+        cfg.relay.udp_port = relay_port
+
+    async with running_server(configure=enable_relay,
+                              require_encryption=True) as server:
+        relay_addr = ("127.0.0.1", relay_port)
+        async with aiohttp.ClientSession() as s:
+            alice = SignalClient(s, server.port)
+            bob = SignalClient(s, server.port)
+            join_a = await alice.connect("relay-room", "alice")
+            join_b = await bob.connect("relay-room", "bob")
+            a_crypt = MediaCryptoClient(
+                join_a["media_crypto"]["key_id"],
+                base64.b64decode(join_a["media_crypto"]["key"]),
+            )
+            b_crypt = MediaCryptoClient(
+                join_b["media_crypto"]["key_id"],
+                base64.b64decode(join_b["media_crypto"]["key"]),
+            )
+
+            # Both participants allocate on the relay.
+            socks = {}
+            for client, who in ((alice, "a"), (bob, "b")):
+                await client.send_signal("request_relay", {})
+                rr = await wait_rr(client, "relay_info")
+                info = rr["relay_info"]
+                assert (info["host"], info["port"]) == relay_addr
+                sk = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
+                sk.bind(("127.0.0.1", 0))
+                sk.setblocking(False)
+                sk.sendto(RELAY_MAGIC + bytes([BIND_REQ])
+                          + bytes.fromhex(info["token"]), relay_addr)
+                deadline = asyncio.get_event_loop().time() + 2
+                while asyncio.get_event_loop().time() < deadline:
+                    await asyncio.sleep(0.02)
+                    try:
+                        ack = sk.recvfrom(64)[0]
+                        assert ack[4] == BIND_ACK
+                        break
+                    except BlockingIOError:
+                        continue
+                else:
+                    raise TimeoutError("no BIND ack")
+                socks[who] = sk
+
+            # Publish over UDP-via-relay; subscribe likewise.
+            await alice.send_signal(
+                "add_track", {"cid": "mic", "type": 0, "name": "m",
+                              "transport": "udp"}
+            )
+            rr = await wait_rr(alice, "udp_media")
+            ssrc = rr["udp_media"]["ssrc"]
+            track_sid = rr["udp_media"]["track_sid"]
+            await bob.wait_for("track_subscribed")
+            await bob.send_signal(
+                "subscription",
+                {"track_sids": [track_sid], "subscribe": True, "udp": True},
+            )
+            rr = await wait_rr(bob, "udp_punch")
+            punch = int(rr["udp_punch"]["punch_id"])
+            socks["b"].sendto(
+                b_crypt.seal(PUNCH_REQ + punch.to_bytes(4, "big")), relay_addr
+            )
+            deadline = asyncio.get_event_loop().time() + 2
+            while asyncio.get_event_loop().time() < deadline:
+                await asyncio.sleep(0.02)
+                try:
+                    ack = b_crypt.open(socks["b"].recvfrom(2048)[0])
+                    if ack == PUNCH_ACK + punch.to_bytes(4, "big"):
+                        break
+                except BlockingIOError:
+                    continue
+            else:
+                raise TimeoutError("no punch ack through relay")
+
+            got = []
+            for i in range(20):
+                socks["a"].sendto(
+                    a_crypt.seal(rtp_packet(sn=300 + i, ts=960 * i, ssrc=ssrc,
+                                            audio_level=20,
+                                            payload=b"via-relay" + bytes([i]))),
+                    relay_addr,
+                )
+                await asyncio.sleep(0.05)
+                while True:
+                    try:
+                        inner = b_crypt.open(socks["b"].recvfrom(4096)[0])
+                        if inner is not None and not (192 <= inner[1] <= 223):
+                            got.append(inner)
+                    except BlockingIOError:
+                        break
+                if len(got) >= 5:
+                    break
+            assert len(got) >= 5, f"only {len(got)} media packets via relay"
+            assert any(b"via-relay" in g for g in got)
+            for sk in socks.values():
+                sk.close()
