@@ -168,9 +168,12 @@ class HostSequencer:
         self.key[room] = -1
         self.track[room] = -1
         # A recycled row must not inherit the previous room's drained
-        # replay budget.
+        # replay budget OR its per-slot RTT throttle stamps (record()
+        # never rewrites last_ms, so stale stamps would gate the new
+        # room's first retransmits for up to one RTT).
         self.budget[room] = self.BUDGET_PER_S
         self._budget_refill_ms[room] = 0
+        self.last_ms[room] = -(1 << 60)
 
 
 @dataclass

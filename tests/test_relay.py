@@ -290,9 +290,6 @@ async def test_relay_through_full_server():
 
     import aiohttp
 
-    from livekit_server_tpu.runtime.udp import PUNCH_ACK, PUNCH_REQ
-    from tests.conftest import free_port
-    from tests.test_native import rtp_packet
     from tests.test_service import SignalClient, running_server
 
     relay_port = free_port(socket.SOCK_DGRAM)
@@ -340,8 +337,7 @@ async def test_relay_through_full_server():
                 sk = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
                 sk.bind(("127.0.0.1", 0))
                 sk.setblocking(False)
-                sk.sendto(RELAY_MAGIC + bytes([BIND_REQ])
-                          + bytes.fromhex(info["token"]), relay_addr)
+                _bind_via(sk, relay_addr, bytes.fromhex(info["token"]))
                 deadline = asyncio.get_event_loop().time() + 2
                 while asyncio.get_event_loop().time() < deadline:
                     await asyncio.sleep(0.02)
