@@ -307,9 +307,10 @@ def load_config(
 
 
 def _validate(cfg: Config) -> None:
-    if cfg.rtc.pacer not in ("", "no-queue"):
+    if cfg.rtc.pacer not in ("", "no-queue", "leaky-bucket"):
         raise ConfigError(
-            f"rtc.pacer must be '' or 'no-queue', got {cfg.rtc.pacer!r}"
+            "rtc.pacer must be '', 'no-queue' or 'leaky-bucket', "
+            f"got {cfg.rtc.pacer!r}"
         )
     if not cfg.development and not cfg.keys:
         raise ConfigError("one or more API keys are required (or set development: true)")

@@ -39,6 +39,7 @@ from livekit_server_tpu.ops import (
     allocation,
     audio,
     bwe,
+    pacer,
     quality,
     red,
     rtpmunger,
@@ -103,6 +104,7 @@ class PlaneState(NamedTuple):
     sel: selector.SelectorState          # [R, T, S]
     bwe_state: bwe.BWEState              # [R, S]
     tracker: streamtracker.TrackerState  # [R, T*L] per (track, layer) stream
+    pacer_state: pacer.PacerState        # [R, S] — leaky-bucket egress pacing
     red_state: red.REDState              # [R, T, D] — RED history rings
     temporal_bytes: jax.Array            # [R, T, L, MAX_TEMPORAL] float32 —
                                          # per-temporal byte/tick EMA (the
@@ -193,6 +195,8 @@ class TickOutputs(NamedTuple):
     pad_valid: jax.Array       # [R, S, PAD_MAX] bool
     # Allocator budget per subscriber (probe goal baseline + telemetry):
     committed_bps: jax.Array   # [R, S] float32
+    pacer_allowed: jax.Array   # [R, S] float32 — leaky-bucket byte budget
+                               # the host egress may write this tick
     deficient: jax.Array       # [R, S] bool — allocation under-served this
                                # sub (probe trigger; streamallocator
                                # "deficient" state)
@@ -234,6 +238,7 @@ def init_state(dims: PlaneDims) -> PlaneState:
         sel=jax.tree.map(lambda x: tile(x, R, T), selector.init_state(S)),
         bwe_state=jax.tree.map(lambda x: tile(x, R), bwe.init_state(S)),
         tracker=jax.tree.map(lambda x: tile(x, R), streamtracker.init_state(T * L)),
+        pacer_state=jax.tree.map(lambda x: tile(x, R), pacer.init_state(S)),
         red_state=jax.tree.map(lambda x: tile(x, R), red.init_state(T)),
         temporal_bytes=jnp.zeros((R, T, L, MAX_TEMPORAL), jnp.float32),
     )
@@ -387,6 +392,17 @@ def _room_tick(
         pkts_sent, inp.nacks,
     )
 
+    # ---- leaky-bucket egress pacing (pacer/leaky_bucket.go:47-200) ------
+    # Budgets from the allocator's committed rate gate the HOST egress
+    # (runtime/udp.py _pacer_gate) when rtc.pacer == "leaky-bucket"; in
+    # other modes the output is simply unused.
+    sent_bytes = jnp.sum(
+        jnp.where(send, inp.size[:, :, None], 0), axis=(0, 1)
+    ).astype(jnp.float32)                                            # [S]
+    pacer_state, pacer_allowed, _pacer_backlog = pacer.update_tick(
+        state.pacer_state, pacer.PacerParams(), sent_bytes, budget, inp.tick_ms
+    )
+
     # ---- allocation across tracks per subscriber → targets for next tick
     video_active = state.meta.is_video & state.meta.published & ~state.meta.pub_muted
     alloc_muted = ~(
@@ -494,6 +510,7 @@ def _room_tick(
         sel=sel_state,
         bwe_state=bwe_state,
         tracker=tracker,
+        pacer_state=pacer_state,
         red_state=red_state,
         temporal_bytes=temporal_bytes,
     )
@@ -537,6 +554,7 @@ def _room_tick(
         pad_ts=pad_ts,
         pad_valid=pad_valid,
         committed_bps=budget,
+        pacer_allowed=pacer_allowed,
         deficient=any_deficient,
         red_sn=red_sn.astype(jnp.int32),
         red_off=red_off.astype(jnp.int32),
@@ -685,13 +703,14 @@ def unpack_tick_outputs(
         "pad_ts": (R, S, PAD_MAX),
         "pad_valid": (R, S, PAD_MAX),
         "committed_bps": (R, S),
+        "pacer_allowed": (R, S),
         "deficient": (R, S),
         "red_sn": (R, T, K if red_enabled else 0, red.RED_DISTANCE),
         "red_off": (R, T, K if red_enabled else 0, red.RED_DISTANCE),
         "red_ok": (R, T, K if red_enabled else 0, red.RED_DISTANCE),
     }
     floats = {"speaker_levels", "track_mos", "track_loss_pct", "track_jitter_ms",
-              "track_bps", "committed_bps"}
+              "track_bps", "committed_bps", "pacer_allowed"}
     bools = {"need_keyframe", "congested", "pad_valid", "deficient", "red_ok"}
     buf = np.asarray(buf)
     pieces, off = {}, 0

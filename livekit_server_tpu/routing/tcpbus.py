@@ -162,21 +162,36 @@ class BusServer:
 
 
 class TCPBusClient:
-    """MessageBus over one TCP connection (the Redis-client seat)."""
+    """MessageBus over one TCP connection (the Redis-client seat).
 
-    def __init__(self, reader: asyncio.StreamReader, writer: asyncio.StreamWriter):
+    Reconnects automatically with backoff when the connection drops (the
+    go-redis behavior the node registry depends on — a blip must not
+    permanently sever a node from the cluster): in-flight calls fail with
+    ConnectionError (callers like the 2 s heartbeat retry naturally), and
+    every live subscription is re-issued on the fresh connection. Pushes
+    published during the outage are lost — exactly Redis pub/sub
+    semantics, which every consumer (heartbeats, signal relay seq-resume)
+    already tolerates."""
+
+    RECONNECT_MAX_S = 5.0
+
+    def __init__(self, reader: asyncio.StreamReader, writer: asyncio.StreamWriter,
+                 host: str = "", port: int = 0, token: str = ""):
         self._reader = reader
         self._writer = writer
+        self._host, self._port, self._token = host, port, token
         self._next_id = 0
         self._pending: dict[int, asyncio.Future] = {}
         self._subs: dict[str, list[Subscription]] = {}
         self._task = asyncio.ensure_future(self._read_loop())
         self.closed = False
+        self._connected = True
+        self.reconnects = 0
 
     @classmethod
     async def connect(cls, host: str, port: int, token: str = "") -> "TCPBusClient":
         reader, writer = await asyncio.open_connection(host, port)
-        client = cls(reader, writer)
+        client = cls(reader, writer, host=host, port=port, token=token)
         if token:
             await client._call("auth", token)
         return client
@@ -187,30 +202,72 @@ class TCPBusClient:
         return await cls.connect(host or "127.0.0.1", int(port), token=token)
 
     async def _read_loop(self) -> None:
-        try:
-            while True:
-                msg = await _read_frame(self._reader)
-                if "p" in msg:  # push
-                    for sub in list(self._subs.get(msg["p"], [])):
-                        sub._offer(msg["m"])
-                    continue
-                fut = self._pending.pop(msg["i"], None)
-                if fut is not None and not fut.done():
-                    if "e" in msg:
-                        fut.set_exception(RuntimeError(msg["e"]))
-                    else:
-                        fut.set_result(msg.get("r"))
-        except (asyncio.IncompleteReadError, ConnectionError, ConnectionResetError):
-            pass
-        finally:
-            self.closed = True
+        while True:
+            try:
+                while True:
+                    msg = await _read_frame(self._reader)
+                    if "p" in msg:  # push
+                        for sub in list(self._subs.get(msg["p"], [])):
+                            sub._offer(msg["m"])
+                        continue
+                    fut = self._pending.pop(msg["i"], None)
+                    if fut is not None and not fut.done():
+                        if "e" in msg:
+                            fut.set_exception(RuntimeError(msg["e"]))
+                        else:
+                            fut.set_result(msg.get("r"))
+            except (asyncio.IncompleteReadError, ConnectionError,
+                    ConnectionResetError, OSError):
+                pass
+            # Connection dropped: fail in-flight calls now; callers retry.
+            self._connected = False
             for fut in self._pending.values():
                 if not fut.done():
                     fut.set_exception(ConnectionError("bus connection lost"))
             self._pending.clear()
+            if self.closed or not self._host:
+                self.closed = True
+                return
+            if not await self._reconnect():
+                self.closed = True
+                return
+
+    async def _reconnect(self) -> bool:
+        """Dial until the bus answers (bounded backoff), then re-auth and
+        re-subscribe every live channel. Returns False only on close()."""
+        delay = 0.05
+        while not self.closed:
+            try:
+                reader, writer = await asyncio.open_connection(self._host, self._port)
+                try:
+                    self._writer.close()   # old transport: no fd leak
+                except Exception:  # noqa: BLE001 — already torn down
+                    pass
+                self._reader, self._writer = reader, writer
+                # Mark live BEFORE re-issuing auth/subs: they go through
+                # _send, which fails fast while disconnected.
+                self._connected = True
+                if self._token:
+                    # _send writes on the NEW connection; the response is
+                    # read by the outer loop after we return.
+                    self._send("auth", self._token).add_done_callback(
+                        lambda f: f.exception()
+                    )
+                for channel in self._subs:
+                    self._send("sub", channel).add_done_callback(
+                        lambda f: f.exception()
+                    )
+                self.reconnects += 1
+                return True
+            except OSError:
+                await asyncio.sleep(delay)
+                delay = min(delay * 2, self.RECONNECT_MAX_S)
+        return False
 
     def _send(self, op: str, *args) -> asyncio.Future:
-        if self.closed:
+        if self.closed or not self._connected:
+            # Fail fast mid-outage: a write to the dead transport would be
+            # silently dropped and the call would hang forever.
             raise ConnectionError("bus connection lost")
         self._next_id += 1
         fut = asyncio.get_event_loop().create_future()
@@ -256,7 +313,12 @@ class TCPBusClient:
         sub = Subscription(self, channel, size)
         self._subs.setdefault(channel, []).append(sub)
         # Fire-and-forget op (response discarded via the pending future).
-        self._send("sub", channel).add_done_callback(lambda f: f.exception())
+        # Mid-outage the send fails — the registration stands and
+        # _reconnect re-issues it, so subscribe works across blips.
+        try:
+            self._send("sub", channel).add_done_callback(lambda f: f.exception())
+        except ConnectionError:
+            pass
         return sub
 
     def _unsubscribe(self, channel: str, sub: Subscription) -> None:

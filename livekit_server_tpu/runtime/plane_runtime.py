@@ -205,6 +205,7 @@ class TickResult:
     red_sn: Any = None            # [R, T, K, D] int32
     red_off: Any = None           # [R, T, K, D] int32
     red_ok: Any = None            # [R, T, K, D] bool
+    pacer_allowed: Any = None     # [R, S] float32 — leaky-bucket byte budgets
     track_bps: Any = None         # [R, T] float32
     quality_window_closed: bool = False  # this tick rolled the stats window
     _egress_cache: list[EgressPacket] | None = None
@@ -613,6 +614,7 @@ class PlaneRuntime:
             red_sn=out.red_sn,
             red_off=out.red_off,
             red_ok=out.red_ok,
+            pacer_allowed=out.pacer_allowed,
         )
 
     # -- loop ------------------------------------------------------------

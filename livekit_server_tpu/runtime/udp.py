@@ -343,6 +343,11 @@ class UDPMediaTransport(asyncio.DatagramProtocol):
         # sendmmsg chunks across this many ms; 0 = burst. Paced sends
         # run on a dedicated worker thread (they sleep).
         self.pacer_spread_ms: float = 0.0
+        # Leaky-bucket pacing (pkg/sfu/pacer leaky_bucket.go:47-200 seat):
+        # per-(room, sub) byte budgets computed by the device pacer op;
+        # over-budget UDP entries defer FIFO to later ticks (bounded).
+        self.pacer_mode: str = ""
+        self._pacer_queue: list = []
         self._pace_pool = None
         self._pace_pending = None
         # Media-loss proxy (medialossproxy.go): max subscriber-reported
@@ -1233,7 +1238,71 @@ class UDPMediaTransport(asyncio.DatagramProtocol):
                 if addr is not None:
                     self._sendto(build_rr(self.node_ssrc, ssrc, frac), addr, b.session)
 
-    def send_egress_batch(self, batch, red_plan=None, layer_caps=None) -> np.ndarray:
+    def _pacer_gate(self, batch, allowed, udp_mask) -> np.ndarray:
+        """Leaky-bucket egress gate: drain the deferred queue under this
+        tick's per-(room, sub) byte budgets, then admit in-batch UDP
+        entries FIFO until each subscriber's budget runs out. Returns the
+        admit mask; over-budget entries are queued as packets (bounded —
+        overflow drops newest, a pacer is loss-tolerant by design)."""
+        PACER_QUEUE_MAX = 4096
+        remaining = np.asarray(allowed, np.float64).copy()
+        blocked: set = set()
+        if self._pacer_queue:
+            send_now, keep = [], []
+            for pkt in self._pacer_queue:
+                key = (pkt.room, pkt.sub)
+                if key in blocked or remaining[key] < pkt.size:
+                    blocked.add(key)   # FIFO per sub: block all behind it
+                    keep.append(pkt)
+                else:
+                    remaining[key] -= pkt.size
+                    send_now.append(pkt)
+            self._pacer_queue = keep
+            if send_now:
+                self.send_egress(send_now)
+        n = len(batch)
+        r, t, k, s = batch.rooms, batch.tracks, batch.ks, batch.subs
+        sizes = np.maximum(batch.payloads.length[r, t, k].astype(np.int64), 0)
+        S = remaining.shape[1]
+        key = r.astype(np.int64) * S + s
+        order = np.argsort(key, kind="stable")          # per-sub FIFO kept
+        ks_ = key[order]
+        cs = np.cumsum(np.where(udp_mask[order], sizes[order], 0))
+        grp_first = np.r_[True, ks_[1:] != ks_[:-1]] if n else np.zeros(0, bool)
+        first_idx = np.flatnonzero(grp_first)
+        base = np.repeat(
+            np.r_[0, cs[first_idx[1:] - 1]] if len(first_idx) else np.zeros(0),
+            np.diff(np.r_[first_idx, n]),
+        )
+        cum = cs - base
+        rem_sorted = remaining[r[order], s[order]]
+        blk = np.zeros(n, bool)
+        if blocked:
+            blk = np.fromiter(
+                ((int(a), int(b)) in blocked
+                 for a, b in zip(r[order], s[order])), bool, n,
+            )
+        ok_sorted = (cum <= rem_sorted) & ~blk
+        mask = np.empty(n, bool)
+        mask[order] = ok_sorted
+        mask |= ~udp_mask                                # pace UDP only
+        defer = ~mask & udp_mask
+        if defer.any():
+            deferred = batch.to_packets(defer)
+            space = PACER_QUEUE_MAX - len(self._pacer_queue)
+            if len(deferred) > space:
+                self.stats["pacer_dropped"] = (
+                    self.stats.get("pacer_dropped", 0) + len(deferred) - space
+                )
+                deferred = deferred[:space]
+            self._pacer_queue.extend(deferred)
+            self.stats["pacer_deferred"] = (
+                self.stats.get("pacer_deferred", 0) + len(deferred)
+            )
+        return mask
+
+    def send_egress_batch(self, batch, red_plan=None, layer_caps=None,
+                          pacer_allowed=None) -> np.ndarray:
         """Vectorized tick egress (the hot half of DownTrack.WriteRTP +
         pion/srtp + pacer socket writes): per-entry field arrays are
         assembled with numpy index math and handed to ONE native call that
@@ -1245,6 +1314,10 @@ class UDPMediaTransport(asyncio.DatagramProtocol):
         """
         n = len(batch)
         if n == 0:
+            # A quiet tick still drains the pacer's deferred queue.
+            if (self.pacer_mode == "leaky-bucket" and pacer_allowed is not None
+                    and self._pacer_queue):
+                self._pacer_gate(batch, pacer_allowed, np.zeros(0, bool))
             return np.zeros(0, bool)
         r, t, k, s = batch.rooms, batch.tracks, batch.ks, batch.subs
         # Destination resolution: pure array gathers from the persistent
@@ -1254,11 +1327,16 @@ class UDPMediaTransport(asyncio.DatagramProtocol):
         e_port = self._sub_port[r, s]
         e_tcp = self._sub_tcp[r, s]
         has_dest = (e_port != 0) | e_tcp
+        pacing = self.pacer_mode == "leaky-bucket" and pacer_allowed is not None
 
         if native_egress is None or self.transport is None:
             # Toolchain-free fallback: the per-packet Python path.
+            pace_ok = (
+                self._pacer_gate(batch, pacer_allowed, e_port != 0)
+                if pacing else np.ones(n, bool)
+            )
             if self.transport is not None or self.tcp_sinks:
-                self.send_egress(batch.to_packets(has_dest))
+                self.send_egress(batch.to_packets(has_dest & pace_ok))
             return has_dest
 
         po = batch.payloads.off[r, t, k]
@@ -1274,7 +1352,14 @@ class UDPMediaTransport(asyncio.DatagramProtocol):
             )
             if red_mask.any():
                 self._send_red(batch, red_plan, red_mask, po, pl, now_ms)
-        idx = np.nonzero((e_port != 0) & (po >= 0) & ~red_mask)[0]
+        # RED entries already left on the wire above, so the pacer must not
+        # also defer them (duplicate delivery); low-rate RED audio rides
+        # unpaced, like the reference pacer's priority audio.
+        pace_ok = (
+            self._pacer_gate(batch, pacer_allowed, (e_port != 0) & ~red_mask)
+            if pacing else np.ones(n, bool)
+        )
+        idx = np.nonzero((e_port != 0) & (po >= 0) & ~red_mask & pace_ok)[0]
         if len(idx):
             rr_, tt_, ss_ = r[idx], t[idx], s[idx]
             kk_ = k[idx]

@@ -394,6 +394,7 @@ class RoomManager:
                 layer_caps=(
                     self.runtime.ctrl.max_spatial, self.runtime.ctrl.max_temporal
                 ),
+                pacer_allowed=res.pacer_allowed,
             )
             if res.padding:
                 # BWE probe padding (UDP subscribers only — padding is a

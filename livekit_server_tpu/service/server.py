@@ -234,6 +234,10 @@ class LivekitServer:
                     self.room_manager.udp.pacer_spread_ms = (
                         self.config.plane.tick_ms / 2.0
                     )
+                elif self.config.rtc.pacer == "leaky-bucket":
+                    # Per-subscriber byte budgets from the device pacer op
+                    # gate egress; over-budget packets defer FIFO.
+                    self.room_manager.udp.pacer_mode = "leaky-bucket"
                 if self.config.room.playout_delay_max_ms > 0:
                     # Video egress carries the playout-delay extension
                     # (rtpextension/playoutdelay.go; config room section).

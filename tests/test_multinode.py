@@ -324,3 +324,55 @@ async def test_roomservice_ops_against_non_hosting_node():
             if srv is not None:
                 await srv.stop(force=True)
         bus.close()
+
+
+async def test_bus_client_reconnects_and_resubscribes():
+    """A dropped bus connection must not sever the node permanently (the
+    go-redis auto-reconnect seat): calls fail during the outage, then
+    succeed again, and live subscriptions are re-issued on the fresh
+    connection."""
+    bus = await start_bus()
+    port = bus.port
+    try:
+        client = await TCPBusClient.connect("127.0.0.1", port)
+        other = await TCPBusClient.connect("127.0.0.1", port)
+        sub = client.subscribe("announce")
+        await client.set("k", "v1")
+        await asyncio.sleep(0.05)
+
+        # Sever the client's connection out from under it (network blip).
+        client._writer.transport.abort()
+        deadline = asyncio.get_event_loop().time() + 3
+        while client.reconnects == 0:
+            assert asyncio.get_event_loop().time() < deadline, "no reconnect"
+            await asyncio.sleep(0.05)
+        assert await client.get("k") == "v1"          # calls work again
+        await asyncio.sleep(0.05)                      # re-sub settles
+        await other.publish("announce", {"hello": 1})  # pushes flow again
+        msg = await sub.read(timeout=3)
+        assert msg == {"hello": 1}
+
+        # Full bus-process restart on the same port: state is fresh (like
+        # a flushed Redis) but the client recovers without intervention.
+        bus.close()
+        client._writer.transport.abort()
+        other._writer.transport.abort()
+        await asyncio.sleep(0.1)
+        bus2 = BusServer()
+        await bus2.start("127.0.0.1", port)
+        try:
+            deadline = asyncio.get_event_loop().time() + 5
+            while True:
+                try:
+                    await client.set("k2", "v2")
+                    break
+                except ConnectionError:
+                    assert asyncio.get_event_loop().time() < deadline
+                    await asyncio.sleep(0.1)
+            assert await client.get("k2") == "v2"
+            await client.close()
+            await other.close()
+        finally:
+            bus2.close()
+    finally:
+        bus.close()

@@ -884,3 +884,76 @@ async def test_pacer_spreads_tick_burst():
     finally:
         transport.transport.close()
         await runtime.stop()
+
+
+async def test_leaky_bucket_pacer_defers_and_drains_fifo():
+    """rtc.pacer=leaky-bucket: per-(room,sub) byte budgets gate the batch
+    egress; over-budget packets defer and drain FIFO on later ticks
+    (pkg/sfu/pacer leaky_bucket.go semantics at the host egress)."""
+    runtime = PlaneRuntime(DIMS, tick_ms=10)
+    from tests.conftest import free_port
+
+    port = free_port(socket.SOCK_DGRAM)
+    transport = await start_udp_transport(runtime.ingest, "127.0.0.1", port)
+    transport.pacer_mode = "leaky-bucket"
+    try:
+        runtime.set_track(0, 0, published=True, is_video=False)
+        runtime.set_subscription(0, 0, 1, subscribed=True)
+        ssrc = transport.assign_ssrc(room=0, track=0, is_video=False)
+        pub = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
+        pub.bind(("127.0.0.1", 0))
+        sub = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
+        sub.bind(("127.0.0.1", 0))
+        sub.setblocking(False)
+        transport.register_subscriber(0, 1, sub.getsockname())
+
+        # One tick carrying 4 packets of 8-byte payloads for one sub.
+        for i in range(4):
+            pub.sendto(rtp_packet(sn=100 + i, ts=960 * i, ssrc=ssrc,
+                                  audio_level=20, payload=b"PAYLOAD" + bytes([i])),
+                       ("127.0.0.1", port))
+        await asyncio.sleep(0.05)
+        res = await runtime.step_once()
+        assert len(res.egress_batch) == 4
+
+        def recv_all():
+            out = []
+            while True:
+                try:
+                    d = sub.recvfrom(2048)[0]
+                    if not 192 <= d[1] <= 223:
+                        out.append(d)
+                except BlockingIOError:
+                    return out
+
+        R, S = DIMS.rooms, DIMS.subs
+        # Budget admits ~2 packets (payload 8 B each → 16 B budget).
+        allowed = np.zeros((R, S), np.float32)
+        allowed[0, 1] = 16.0
+        transport.send_egress_batch(res.egress_batch, pacer_allowed=allowed)
+        await asyncio.sleep(0.05)
+        first = recv_all()
+        assert len(first) == 2, f"admitted {len(first)} (want 2)"
+        assert len(transport._pacer_queue) == 2
+        assert transport.stats["pacer_deferred"] == 2
+
+        # Next tick: fresh budget drains the deferred packets FIFO.
+        empty = res.egress_batch.__class__(
+            rooms=np.zeros(0, np.int32), tracks=np.zeros(0, np.int32),
+            ks=np.zeros(0, np.int32), subs=np.zeros(0, np.int32),
+            sn=np.zeros(0, np.int32), ts=np.zeros(0, np.int32),
+            pid=np.zeros(0, np.int32), tl0=np.zeros(0, np.int32),
+            keyidx=np.zeros(0, np.int32), payloads=res.egress_batch.payloads,
+        )
+        allowed[0, 1] = 1000.0
+        transport.send_egress_batch(empty, pacer_allowed=allowed)
+        await asyncio.sleep(0.05)
+        second = recv_all()
+        assert len(second) == 2 and not transport._pacer_queue
+        sns = [int.from_bytes(d[2:4], "big") for d in first + second]
+        assert sns == sorted(sns), f"FIFO violated: {sns}"
+        pub.close()
+        sub.close()
+    finally:
+        transport.transport.close()
+        await runtime.stop()
