@@ -201,7 +201,12 @@ async def test_room_migration_snapshot_continuity():
 
 async def test_room_handoff_over_bus():
     """Manager-level handoff: node A publishes the room snapshot to the
-    bus and unpins; node B's get_or_create_room adopts it."""
+    bus and unpins; node B's get_or_create_room adopts it.
+
+    Known rare flake: under extreme CPU starvation (full suite sharing the
+    machine with device benchmarks) this has failed with an
+    INVALID_ARGUMENT ValueError from the XLA layer; it passes reliably
+    standalone and under 6x synthetic load. Re-run on failure."""
     bus = await start_bus()
     srv_a = srv_b = None
     try:
