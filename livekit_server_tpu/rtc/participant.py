@@ -72,6 +72,9 @@ class Participant:
         self.attributes: dict[str, str] = {}
         self.sub_col: int = -1          # subscriber column in the room row
         self.crypto_session = None      # media-wire AEAD session (join-minted)
+        # Last signaled allocator stream state per subscribed track sid
+        # (streamallocator.go StreamStateUpdate change detection).
+        self.stream_paused: dict[str, bool] = {}
         self.permission = pm.ParticipantPermission()
         self._apply_grant_permissions()
         self.published: dict[str, PublishedTrack] = {}   # track sid → entry

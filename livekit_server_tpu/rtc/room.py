@@ -194,6 +194,7 @@ class Room:
         self.slots.release_track(sid)
         for p in self.participants.values():
             p.subscribed_tracks.discard(sid)
+            p.stream_paused.pop(sid, None)   # sids never reuse; no growth
             if p.sid != publisher.sid:
                 p.send("track_unpublished", {"track_sid": sid, "participant_sid": publisher.sid})
         self.broadcast_participant_state(publisher)
@@ -230,6 +231,10 @@ class Room:
     def unsubscribe(self, subscriber: Participant, track_sid: str) -> None:
         ent = self.tracks.get(track_sid)
         subscriber.subscribed_tracks.discard(track_sid)
+        # Forget the signaled pause state: a later re-subscribe starts from
+        # the implicit 'active' baseline, so a still-paused allocation is
+        # re-signaled instead of silently suppressed.
+        subscriber.stream_paused.pop(track_sid, None)
         if ent is None or subscriber.sub_col < 0:
             return
         _pub, track = ent
@@ -398,6 +403,36 @@ class Room:
             return
         for p in self.participants.values():
             p.send("connection_quality", {"updates": updates})
+
+    def update_stream_states(self, target_layers) -> None:
+        """Allocator pause/resume transitions → stream_state_update
+        (streamallocator.go StreamStateUpdate → signal): a subscriber whose
+        video allocation went to -1 (congestion pause, caps, mute) learns
+        the stream is intentionally stopped, not lost. Only transitions are
+        signaled; the initial active state is implicit."""
+        for p in self.participants.values():
+            if p.sub_col < 0 or not p.subscribed_tracks:
+                continue
+            states = []
+            for sid in list(p.subscribed_tracks):
+                ent = self.tracks.get(sid)
+                if ent is None or not ent[1].is_video:
+                    continue
+                paused = int(target_layers[p.sub_col, ent[1].track_col]) < 0
+                prev = p.stream_paused.get(sid)
+                if prev is None:
+                    p.stream_paused[sid] = paused
+                    if not paused:
+                        continue  # initial active is implicit
+                elif prev == paused:
+                    continue
+                p.stream_paused[sid] = paused
+                states.append({
+                    "track_sid": sid,
+                    "state": "paused" if paused else "active",
+                })
+            if states:
+                p.send("stream_state_update", {"stream_states": states})
 
     def reconcile_dynacast(self) -> None:
         """Aggregate subscriber layer demand → subscribed_quality_update to

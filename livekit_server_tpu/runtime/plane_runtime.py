@@ -206,6 +206,7 @@ class TickResult:
     red_off: Any = None           # [R, T, K, D] int32
     red_ok: Any = None            # [R, T, K, D] bool
     pacer_allowed: Any = None     # [R, S] float32 — leaky-bucket byte budgets
+    target_layers: Any = None     # [R, S, T] int32 flat layer targets (-1 = paused)
     track_bps: Any = None         # [R, T] float32
     quality_window_closed: bool = False  # this tick rolled the stats window
     _egress_cache: list[EgressPacket] | None = None
@@ -615,6 +616,7 @@ class PlaneRuntime:
             red_off=out.red_off,
             red_ok=out.red_ok,
             pacer_allowed=out.pacer_allowed,
+            target_layers=out.target_layers,
         )
 
     # -- loop ------------------------------------------------------------

@@ -427,6 +427,8 @@ class RoomManager:
                     res.track_quality[row], res.track_mos[row], res.sub_quality[row]
                 )
                 room.reconcile_dynacast()
+                if res.target_layers is not None:
+                    room.update_stream_states(res.target_layers[row])
             if self.telemetry is not None:
                 # Windowed device reductions → quality histograms + one
                 # analytics record per published track (statsworker.go).

@@ -514,3 +514,69 @@ async def test_checkpoint_restore_mid_stream(runtime):
     for pkt in res.egress:
         room.deliver_egress(pkt)
     assert [p.sn for p in got] == [100, 101, 102, 103]
+
+
+async def test_stream_state_update_on_pause_and_resume(runtime):
+    """Allocator pause transitions reach subscribers as stream_state_update
+    (streamallocator.go StreamStateUpdate → signal relay): capping a sub's
+    layers to nothing pauses the stream; restoring them resumes it. Only
+    transitions are signaled."""
+    room = Room("ssu", runtime)
+    alice, _ = make_participant(room, "alice")
+    bob, b_sink = make_participant(room, "bob")
+    room.join(alice)
+    room.join(bob)
+    handle_participant_signal(
+        room, alice,
+        SignalRequest("add_track", {"cid": "cam", "type": 1, "name": "c",
+                                    "layers": [{"quality": 0}, {"quality": 1}]}),
+    )
+    track = alice.publish_pending("cam")
+    assert track is not None and track.is_video
+    sid = track.info.sid
+    room.subscribe(bob, sid)
+
+    sn = [100]
+
+    async def window():
+        # live traffic each tick (a silent track allocates as paused),
+        # then a quality-window dispatch with fresh targets
+        for _ in range(3):
+            for _k in range(2):
+                runtime.ingest.push(PacketIn(
+                    room=room.slots.row, track=track.track_col, sn=sn[0],
+                    ts=sn[0] * 3000, size=900, payload=b"x" * 900,
+                    layer=0, keyframe=sn[0] == 100, layer_sync=True,
+                ))
+                sn[0] += 1
+            res = await runtime.step_once()
+        return res
+
+    res = await window()
+    room.update_stream_states(res.target_layers[room.slots.row])
+    drain_sink(b_sink)  # initial active is implicit — nothing asserted here
+
+    # Cap to nothing → allocator target -1 → paused.
+    runtime.set_layer_caps(room.slots.row, track.track_col, bob.sub_col,
+                           max_spatial=-1, max_temporal=-1)
+    res = await window()
+    room.update_stream_states(res.target_layers[room.slots.row])
+    msgs = [m for m in drain_sink(b_sink) if m.kind == "stream_state_update"]
+    assert msgs and msgs[-1].data["stream_states"] == [
+        {"track_sid": sid, "state": "paused"}
+    ]
+
+    # Same state again → no repeat signal.
+    res = await window()
+    room.update_stream_states(res.target_layers[room.slots.row])
+    assert not [m for m in drain_sink(b_sink) if m.kind == "stream_state_update"]
+
+    # Restore caps → active transition.
+    runtime.set_layer_caps(room.slots.row, track.track_col, bob.sub_col,
+                           max_spatial=2, max_temporal=3)
+    res = await window()
+    room.update_stream_states(res.target_layers[room.slots.row])
+    msgs = [m for m in drain_sink(b_sink) if m.kind == "stream_state_update"]
+    assert msgs and msgs[-1].data["stream_states"] == [
+        {"track_sid": sid, "state": "active"}
+    ]
