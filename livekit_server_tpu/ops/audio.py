@@ -22,7 +22,7 @@ from typing import NamedTuple
 import jax
 import jax.numpy as jnp
 
-SILENT_LEVEL = jnp.float32(127.0)
+SILENT_LEVEL = 127.0  # plain float: module import must not init a jax backend
 
 
 class AudioLevelParams(NamedTuple):

@@ -23,7 +23,7 @@ from typing import NamedTuple
 import jax
 import jax.numpy as jnp
 
-INVALID_LAYER = jnp.int32(-1)
+INVALID_LAYER = -1  # plain int: module import must not init a jax backend
 
 
 class SelectorState(NamedTuple):

@@ -14,8 +14,8 @@ from __future__ import annotations
 
 import jax.numpy as jnp
 
-MASK16 = jnp.int32(0xFFFF)
-HALF16 = jnp.int32(0x8000)
+MASK16 = 0xFFFF  # plain ints: module import must not init a jax backend
+HALF16 = 0x8000
 
 
 def diff16(a, b):

@@ -26,7 +26,7 @@ from typing import NamedTuple
 import jax
 import jax.numpy as jnp
 
-INVALID = jnp.int32(-1)
+INVALID = -1  # plain int: module import must not init a jax backend
 
 
 class SVCSelectorState(NamedTuple):

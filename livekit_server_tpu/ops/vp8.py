@@ -22,9 +22,9 @@ from typing import NamedTuple
 import jax
 import jax.numpy as jnp
 
-MASK15 = jnp.int32(0x7FFF)
-MASK8 = jnp.int32(0xFF)
-MASK5 = jnp.int32(0x1F)
+MASK15 = 0x7FFF  # plain ints: module import must not init a jax backend
+MASK8 = 0xFF
+MASK5 = 0x1F
 
 
 def sub15(a, d):
