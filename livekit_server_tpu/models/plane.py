@@ -397,7 +397,8 @@ def _room_tick(
     # (runtime/udp.py _pacer_gate) when rtc.pacer == "leaky-bucket"; in
     # other modes the output is simply unused.
     sent_bytes = jnp.sum(
-        jnp.where(send, inp.size[:, :, None], 0), axis=(0, 1)
+        jnp.where(send, inp.size[:, :, None] + pacer.WIRE_OVERHEAD_BYTES, 0),
+        axis=(0, 1),
     ).astype(jnp.float32)                                            # [S]
     pacer_state, pacer_allowed, _pacer_backlog = pacer.update_tick(
         state.pacer_state, pacer.PacerParams(), sent_bytes, budget, inp.tick_ms

@@ -17,6 +17,15 @@ import jax
 import jax.numpy as jnp
 
 
+# Fixed per-packet wire overhead beyond the RTP payload slab bytes: sealed
+# frame header (crypto.HEADER_LEN = 14) + AES-GCM tag (16) + RTP header (12).
+# Codec descriptors / header extensions vary per packet and are approximated
+# by this constant too — budgets model wire bytes, not payload bytes, so the
+# device bucket and the host gate (runtime/udp.py _pacer_gate) must both
+# charge it or egress admits a few percent more than the bucket granted.
+WIRE_OVERHEAD_BYTES = 42
+
+
 class PacerParams(NamedTuple):
     burst_ms: int = 100       # bucket depth in ms of target rate
     min_rate_bps: float = 64_000.0

@@ -219,6 +219,14 @@ class TCPBusClient:
             except (asyncio.IncompleteReadError, ConnectionError,
                     ConnectionResetError, OSError):
                 pass
+            except (ValueError, KeyError, TypeError):
+                # Malformed frame (bad JSON, missing 'i'/'p', wrong types): the
+                # stream is desynced, so this connection is unusable. Treat it
+                # exactly like a connection loss — fall through to fail
+                # pendings and reconnect — instead of letting the exception
+                # kill the reader task while _connected stays True (which
+                # would hang every pending and future call forever).
+                pass
             # Connection dropped: fail in-flight calls now; callers retry.
             self._connected = False
             for fut in self._pending.values():

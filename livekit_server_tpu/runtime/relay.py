@@ -27,8 +27,43 @@ Admission: a BIND datagram carrying a token minted by the SFU —
 
 key_id is the participant's media-crypto session id: one allocation per
 session, so a leaked token cannot multiply allocations, and a re-BIND from
-a new source address *moves* the allocation (the NAT-rebind recovery path —
-only the token holder can re-aim it, and moving it revokes the old path).
+a new source address *moves* the allocation (the NAT-rebind recovery path).
+
+Move continuity. A bare v1 BIND is replayable for its TTL: an on-path
+observer who captures one can replay it from another address and re-aim
+(hijack) the allocation — media stays AEAD-sealed, so the impact is a
+targeted DoS of the victim's relay path, not disclosure. Clients that want
+moves to be token-holder-only append a hash-chain continuity extension:
+
+    BIND v2 = "LKRL" | 0x01 | token(32) | reveal(16) | commit(16)
+
+The first BIND pins `commit` (reveal is ignored; send zeros). Every later
+BIND from a *different* address must carry `reveal` with
+SHA-256(reveal)[:16] == pinned commit, and supplies the next commit. An
+observer sees only the hash (one-way) before a move and an already-spent
+preimage after it, so captured (replayed) datagrams cannot re-aim the
+allocation. v1 (37-byte) BINDs remain accepted for clients that opt out.
+
+Token freshness is the recovery escape hatch. The relay remembers which
+token nonces each allocation has already seen; a move whose token nonce is
+*fresh* is accepted even without a chain proof (and re-pins to the BIND's
+commit, or unpins for v1). Fresh tokens are mintable only over the
+authenticated signal channel, so this stays token-holder-only, and it
+covers two corners the chain alone cannot: (a) a client that lost its
+chain state (crash) re-requests a token and recovers; (b) an on-path
+attacker who wins the race against a legitimate move in flight — spending
+the victim's reveal with an attacker commit — cannot lock the victim out,
+because the victim mints a fresh token and takes the allocation back.
+Replays still fail: an accepted BIND's nonce is spent on arrival.
+
+Pin updates (set or rotate) happen only on origin-authorized frames —
+creation, a valid reveal, or a fresh nonce — never on a replay, so a
+source-spoofed replay of an old v2 BIND cannot reset the pin to a
+commitment whose preimage has since been publicly spent.
+
+Residual risk, accepted: media is AEAD-sealed end-to-end, so every attack
+above is at worst a *recoverable* DoS of the victim's relay path; the
+relay never learns or affects media confidentiality/integrity.
 """
 
 from __future__ import annotations
@@ -44,7 +79,13 @@ BIND_REQ = 0x01
 BIND_ACK = 0x02
 BIND_ERR = 0x03
 TOKEN_LEN = 32
+CONT_LEN = 16  # reveal(16) + commit(16) in the v2 continuity extension
 _HMAC_CTX = b"lk-relay"
+
+
+def continuity_commit(reveal: bytes) -> bytes:
+    """The pin a BIND's 16-byte reveal must hash to (see module docstring)."""
+    return hashlib.sha256(reveal).digest()[:CONT_LEN]
 
 
 def mint_relay_token(secret: bytes, key_id: int, ttl_s: float) -> bytes:
@@ -93,13 +134,30 @@ class _Upstream(asyncio.DatagramProtocol):
 
 
 class _Allocation:
-    __slots__ = ("key_id", "client_addr", "upstream", "last_active")
+    __slots__ = (
+        "key_id", "client_addr", "upstream", "last_active", "commit",
+        "seen_nonces",
+    )
+
+    MAX_SEEN_NONCES = 256
 
     def __init__(self, key_id: int, client_addr, upstream: _Upstream) -> None:
         self.key_id = key_id
         self.client_addr = client_addr
         self.upstream = upstream
         self.last_active = time.monotonic()
+        # Continuity pin (v2 BINDs): sha256(next reveal)[:16], or None for
+        # v1 clients whose moves are token-gated only.
+        self.commit: bytes | None = None
+        # Token nonces already accepted on this allocation (insertion-
+        # ordered; bounded). A BIND reusing a seen nonce is a replay and
+        # can never move the allocation or touch the pin.
+        self.seen_nonces: dict[bytes, None] = {}
+
+    def spend_nonce(self, nonce: bytes) -> None:
+        self.seen_nonces[nonce] = None
+        while len(self.seen_nonces) > self.MAX_SEEN_NONCES:
+            self.seen_nonces.pop(next(iter(self.seen_nonces)))
 
 
 class MediaRelay(asyncio.DatagramProtocol):
@@ -136,7 +194,10 @@ class MediaRelay(asyncio.DatagramProtocol):
         self._sweeper = asyncio.ensure_future(self._sweep())
 
     def datagram_received(self, data: bytes, addr) -> None:
-        is_bind = len(data) == 5 + TOKEN_LEN and data[:4] == RELAY_MAGIC
+        is_bind = (
+            len(data) in (5 + TOKEN_LEN, 5 + TOKEN_LEN + 2 * CONT_LEN)
+            and data[:4] == RELAY_MAGIC
+        )
         alloc = self.by_client.get(addr)
         if alloc is not None and not is_bind:
             alloc.last_active = time.monotonic()
@@ -156,10 +217,18 @@ class MediaRelay(asyncio.DatagramProtocol):
             self.transport.sendto(RELAY_MAGIC + bytes([BIND_ERR]), addr)
 
     async def _bind(self, token: bytes, addr) -> None:
+        reveal = commit = None
+        if len(token) == TOKEN_LEN + 2 * CONT_LEN:  # v2: continuity extension
+            token, reveal, commit = (
+                token[:TOKEN_LEN],
+                token[TOKEN_LEN:TOKEN_LEN + CONT_LEN],
+                token[TOKEN_LEN + CONT_LEN:],
+            )
         key_id = verify_relay_token(self.secret, token)
         if key_id is None:
             self._reject(addr)
             return
+        nonce = token[12:16]  # payload = expiry(8) | key_id(4) | nonce(4)
         alloc = self.allocs.get(key_id)
         if alloc is None:
             if key_id in self._pending:
@@ -184,12 +253,39 @@ class MediaRelay(asyncio.DatagramProtocol):
             finally:
                 self._pending.discard(key_id)
             alloc = _Allocation(key_id, addr, proto)
+            alloc.commit = commit  # None for v1 clients
+            alloc.spend_nonce(nonce)
             self.allocs[key_id] = alloc
-        elif alloc.client_addr != addr:
-            # NAT rebind: the token holder moves the allocation; the old
-            # client address stops receiving (re-aim is revocation).
-            self.by_client.pop(alloc.client_addr, None)
-            alloc.client_addr = addr
+        else:
+            # Origin authorization (see module docstring): a valid chain
+            # reveal proves continuity; a fresh token nonce proves access
+            # to the authenticated signal channel (recovery path). A
+            # replayed datagram has neither.
+            proof_ok = (
+                alloc.commit is not None
+                and reveal is not None
+                and hmac.compare_digest(continuity_commit(reveal), alloc.commit)
+            )
+            fresh = nonce not in alloc.seen_nonces
+            if alloc.client_addr != addr:
+                # NAT rebind: moves the allocation; the old client address
+                # stops receiving (re-aim is revocation). Pinned
+                # allocations move only for origin-authorized frames.
+                if alloc.commit is not None and not (proof_ok or fresh):
+                    self._reject(addr)
+                    return
+                # The mover chooses the next pin (None for v1: an explicit,
+                # token-holder-authorized unpin).
+                alloc.commit = commit
+                self.by_client.pop(alloc.client_addr, None)
+                alloc.client_addr = addr
+            elif commit is not None and (proof_ok or fresh):
+                # Same-address refresh may set/rotate the pin — including
+                # first-pinning an allocation a v1 BIND created — but only
+                # when origin-authorized, so a source-spoofed replay of an
+                # old v2 BIND cannot reset the pin to a spent commitment.
+                alloc.commit = commit
+            alloc.spend_nonce(nonce)
         alloc.last_active = time.monotonic()
         self.by_client[addr] = alloc
         self.stats["binds"] += 1

@@ -23,6 +23,15 @@ from dataclasses import dataclass
 import numpy as np
 
 from livekit_server_tpu.native import egress as native_egress, rtp
+from livekit_server_tpu.ops.pacer import WIRE_OVERHEAD_BYTES
+from livekit_server_tpu.runtime import crypto as _crypto
+
+# ops/pacer (a device-ops module that must not import host runtime code)
+# hardcodes the per-packet wire overhead; pin it to the real frame layout
+# here so a crypto-header change cannot silently drift the pacer budgets.
+assert WIRE_OVERHEAD_BYTES == _crypto.HEADER_LEN + 16 + 12, (
+    "ops/pacer.WIRE_OVERHEAD_BYTES out of sync with sealed-frame layout"
+)
 from livekit_server_tpu.runtime.crypto import (
     DIR_C2S,
     MAGIC as CRYPTO_MAGIC,
@@ -1251,18 +1260,25 @@ class UDPMediaTransport(asyncio.DatagramProtocol):
             send_now, keep = [], []
             for pkt in self._pacer_queue:
                 key = (pkt.room, pkt.sub)
-                if key in blocked or remaining[key] < pkt.size:
+                cost = pkt.size + WIRE_OVERHEAD_BYTES
+                if key in blocked or remaining[key] < cost:
                     blocked.add(key)   # FIFO per sub: block all behind it
                     keep.append(pkt)
                 else:
-                    remaining[key] -= pkt.size
+                    remaining[key] -= cost
                     send_now.append(pkt)
             self._pacer_queue = keep
             if send_now:
                 self.send_egress(send_now)
         n = len(batch)
         r, t, k, s = batch.rooms, batch.tracks, batch.ks, batch.subs
-        sizes = np.maximum(batch.payloads.length[r, t, k].astype(np.int64), 0)
+        # Budgets model wire bytes: charge the fixed per-packet overhead the
+        # device bucket charges too (ops/pacer.WIRE_OVERHEAD_BYTES), or the
+        # host admits a few percent more wire bytes than the bucket granted.
+        sizes = (
+            np.maximum(batch.payloads.length[r, t, k].astype(np.int64), 0)
+            + WIRE_OVERHEAD_BYTES
+        )
         S = remaining.shape[1]
         key = r.astype(np.int64) * S + s
         order = np.argsort(key, kind="stable")          # per-sub FIFO kept

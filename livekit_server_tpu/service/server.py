@@ -10,6 +10,7 @@ Endpoints: /rtc (WS signal+media), /twirp/livekit.RoomService/* (admin),
 from __future__ import annotations
 
 import asyncio
+import secrets
 import time
 
 from aiohttp import web
@@ -267,10 +268,13 @@ class LivekitServer:
                     from livekit_server_tpu.runtime.relay import start_media_relay
 
                     rcfg = self.config.relay
-                    secret = (
-                        next(iter(self.config.keys.values())) if self.config.keys
-                        else "dev"
-                    ).encode()
+                    # Relay tokens are minted and verified only by this
+                    # process, so the HMAC secret never needs to be derived
+                    # from (or leak) API-key material — and a config-derived
+                    # secret would be the constant "dev" in keyless dev mode,
+                    # making tokens forgeable. A fresh random secret per
+                    # process is strictly stronger and costs nothing.
+                    secret = secrets.token_bytes(32)
                     # A wildcard bind is not a connectable upstream
                     # destination (0.0.0.0→loopback only works on Linux);
                     # the relay's per-allocation sockets dial loopback.

@@ -381,3 +381,41 @@ async def test_bus_client_reconnects_and_resubscribes():
             bus2.close()
     finally:
         bus.close()
+
+async def test_bus_client_survives_malformed_frame():
+    """A malformed frame (bad JSON, or a frame with neither 'p' nor 'i')
+    means the stream is desynced: the client must treat it as connection
+    loss and reconnect — not die with _connected=True, which would hang
+    every pending and future call forever."""
+    import json as _json
+
+    bus = await start_bus()
+    try:
+        client = await TCPBusClient.connect("127.0.0.1", bus.port)
+        await client.set("k", "v1")
+
+        def inject(raw: bytes) -> None:
+            client._reader.feed_data(len(raw).to_bytes(4, "big") + raw)
+
+        # Structurally invalid frame: valid JSON lacking both 'p' and 'i'.
+        inject(_json.dumps({"x": 1}).encode())
+        deadline = asyncio.get_event_loop().time() + 3
+        while client.reconnects == 0:
+            assert asyncio.get_event_loop().time() < deadline, (
+                "malformed frame killed the reader without reconnecting"
+            )
+            await asyncio.sleep(0.05)
+        assert not client.closed
+        assert await client.get("k") == "v1"
+
+        # Byte-garbage frame (json.JSONDecodeError path), on the fresh
+        # connection this time.
+        inject(b"\xff not json \xff")
+        deadline = asyncio.get_event_loop().time() + 3
+        while client.reconnects < 2:
+            assert asyncio.get_event_loop().time() < deadline, "no 2nd reconnect"
+            await asyncio.sleep(0.05)
+        assert await client.get("k") == "v1"
+        await client.close()
+    finally:
+        bus.close()

@@ -403,3 +403,96 @@ async def test_relay_through_full_server():
             assert any(b"via-relay" in g for g in got)
             for sk in socks.values():
                 sk.close()
+
+async def test_relay_move_requires_continuity_proof():
+    """v2 BINDs pin a hash-chain commitment: a captured BIND datagram
+    (v1 or v2) replayed from another address can no longer move the
+    allocation; only the holder of the unrevealed preimage can."""
+    import secrets as _secrets
+
+    from livekit_server_tpu.runtime.relay import continuity_commit
+
+    runtime = PlaneRuntime(DIMS, tick_ms=10)
+    reg = MediaCryptoRegistry()
+    sfu_port, relay_port = free_port(socket.SOCK_DGRAM), free_port(socket.SOCK_DGRAM)
+    loop = asyncio.get_running_loop()
+    tr, _ = await loop.create_datagram_endpoint(
+        lambda: UDPMediaTransport(runtime.ingest, crypto=reg, require_encryption=True),
+        local_addr=("127.0.0.1", sfu_port),
+    )
+    relay = await start_media_relay(
+        "127.0.0.1", relay_port, ("127.0.0.1", sfu_port), SECRET, ttl_s=30
+    )
+    relay_addr = ("127.0.0.1", relay_port)
+
+    def mksock():
+        s = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
+        s.bind(("127.0.0.1", 0))
+        s.setblocking(False)
+        return s
+
+    try:
+        sess = reg.mint()
+        token = mint_relay_token(SECRET, sess.key_id, 30)
+        reveal1, reveal2 = _secrets.token_bytes(16), _secrets.token_bytes(16)
+        commit1, commit2 = continuity_commit(reveal1), continuity_commit(reveal2)
+
+        owner, mover, attacker = mksock(), mksock(), mksock()
+        # First BIND (v2) pins commit1.
+        first_bind = token + b"\x00" * 16 + commit1
+        _bind_via(owner, relay_addr, first_bind)
+        await asyncio.sleep(0.05)
+        assert _recv(owner)[-1][4] == BIND_ACK
+        assert relay.allocs[sess.key_id].client_addr == owner.getsockname()
+
+        # Captured v1 BIND replayed from elsewhere: cannot move a pinned
+        # allocation.
+        _bind_via(attacker, relay_addr, token)
+        # Captured first v2 BIND replayed verbatim: zeros don't hash to
+        # the pin either.
+        _bind_via(attacker, relay_addr, first_bind)
+        await asyncio.sleep(0.05)
+        assert all(f[4] == BIND_ERR for f in _recv(attacker))
+        assert relay.allocs[sess.key_id].client_addr == owner.getsockname()
+
+        # Legitimate move: reveal the pinned preimage, pin the next one.
+        move_bind = token + reveal1 + commit2
+        _bind_via(mover, relay_addr, move_bind)
+        await asyncio.sleep(0.05)
+        assert _recv(mover)[-1][4] == BIND_ACK
+        assert relay.allocs[sess.key_id].client_addr == mover.getsockname()
+
+        # Replaying the captured move datagram: reveal1 is spent (pin is
+        # now commit2) — still cannot hijack.
+        _bind_via(attacker, relay_addr, move_bind)
+        await asyncio.sleep(0.05)
+        assert all(f[4] == BIND_ERR for f in _recv(attacker))
+        assert relay.allocs[sess.key_id].client_addr == mover.getsockname()
+
+        # The chain continues: reveal2 moves it again.
+        _bind_via(owner, relay_addr, token + reveal2 + continuity_commit(b"x" * 16))
+        await asyncio.sleep(0.05)
+        assert _recv(owner)[-1][4] == BIND_ACK
+        assert relay.allocs[sess.key_id].client_addr == owner.getsockname()
+
+        # Recovery: chain state lost (crash, or an attacker raced a move
+        # and spent our reveal) — a FRESH token, mintable only over the
+        # authenticated signal channel, re-pins without a proof...
+        tok2 = mint_relay_token(SECRET, sess.key_id, 30)
+        reveal3 = _secrets.token_bytes(16)
+        rec_bind = tok2 + b"\x00" * 16 + continuity_commit(reveal3)
+        _bind_via(mover, relay_addr, rec_bind)
+        await asyncio.sleep(0.05)
+        assert _recv(mover)[-1][4] == BIND_ACK
+        assert relay.allocs[sess.key_id].client_addr == mover.getsockname()
+        # ...and replaying the captured recovery BIND is useless: its
+        # nonce was spent on arrival.
+        _bind_via(attacker, relay_addr, rec_bind)
+        await asyncio.sleep(0.05)
+        assert all(f[4] == BIND_ERR for f in _recv(attacker))
+        assert relay.allocs[sess.key_id].client_addr == mover.getsockname()
+        for s in (owner, mover, attacker):
+            s.close()
+    finally:
+        relay.close()
+        tr.close()

@@ -927,9 +927,12 @@ async def test_leaky_bucket_pacer_defers_and_drains_fifo():
                     return out
 
         R, S = DIMS.rooms, DIMS.subs
-        # Budget admits ~2 packets (payload 8 B each → 16 B budget).
+        # Budget admits exactly 2 packets: budgets count wire bytes
+        # (payload 8 B + WIRE_OVERHEAD_BYTES fixed per-packet overhead).
+        from livekit_server_tpu.ops.pacer import WIRE_OVERHEAD_BYTES
+
         allowed = np.zeros((R, S), np.float32)
-        allowed[0, 1] = 16.0
+        allowed[0, 1] = 2.0 * (8 + WIRE_OVERHEAD_BYTES)
         transport.send_egress_batch(res.egress_batch, pacer_allowed=allowed)
         await asyncio.sleep(0.05)
         first = recv_all()
