@@ -142,6 +142,11 @@ class TickInputs(NamedTuple):
     nacks: jax.Array           # float32 — NACK count this tick (BWE loss
                                # channel; resolution is host-side — see
                                # runtime HostSequencer)
+    # Publisher-path RTT per track, [R, T] float32: measured host-side from
+    # RTCP SR/RR (ingest.rtt_ms, RFC 3550 A.8) and gathered through the
+    # track→publisher-slot mapping. Feeds the E-model delay term
+    # (scorer.go:45-120 includes RTT); 0 where unknown.
+    pub_rtt_ms: jax.Array
     # BWE probe padding (probe_controller → WritePaddingRTP), [R, S]:
     pad_num: jax.Array         # int32 — padding packets to synthesize (≤ PAD_MAX)
     pad_track: jax.Array       # int32 — track whose downtrack carries them (-1 none)
@@ -442,7 +447,7 @@ def _room_tick(
     jitter_ms = jitter_rtp.astype(jnp.float32) / clock_khz
     has_pkts = (rcv_t > 0) & state.meta.published
     track_mos, track_q = quality.connection_quality(
-        loss_pct, jnp.float32(0.0), jitter_ms, has_pkts
+        loss_pct, inp.pub_rtt_ms, jitter_ms, has_pkts
     )
     # A pub-muted track legitimately sends nothing — it must not read as
     # LOST (connectionstats.go excludes muted tracks from LOST detection).
@@ -621,7 +626,7 @@ _BOOL_FIELDS = {"keyframe", "layer_sync", "begin_pic", "end_frame", "valid"}
 
 def pack_tick_inputs(inp: TickInputs):
     """Host-side: TickInputs → (pkt [F,R,T,K] i32, fb [5,R,S] f32,
-    tick_ms, roll_quality)."""
+    tf [1,R,T] f32, tick_ms, roll_quality)."""
     import numpy as np
 
     pkt = np.stack([np.asarray(getattr(inp, f)).astype(np.int32) for f in PKT_FIELDS])
@@ -634,14 +639,15 @@ def pack_tick_inputs(inp: TickInputs):
             np.asarray(inp.pad_track, np.float32),
         ]
     )
+    tf = np.asarray(inp.pub_rtt_ms, np.float32)[None]
     return (
-        pkt, fb,
+        pkt, fb, tf,
         np.int32(inp.tick_ms), np.int32(inp.roll_quality),
     )
 
 
 def unpack_tick_inputs(
-    pkt: jax.Array, fb: jax.Array,
+    pkt: jax.Array, fb: jax.Array, tf: jax.Array,
     tick_ms: jax.Array, roll_quality: jax.Array,
 ) -> TickInputs:
     """Device-side (traced): stacked arrays → TickInputs."""
@@ -654,6 +660,7 @@ def unpack_tick_inputs(
         estimate=fb[0],
         estimate_valid=fb[1] > 0.5,
         nacks=fb[2],
+        pub_rtt_ms=tf[0],
         pad_num=fb[3].astype(jnp.int32),
         pad_track=fb[4].astype(jnp.int32),
         tick_ms=tick_ms,

@@ -215,6 +215,7 @@ def next_tick(
         estimate=estimate.astype(np.float32),
         estimate_valid=np.ones((R, S), bool),
         nacks=np.zeros((R, S), np.float32),
+        pub_rtt_ms=np.full((R, T), 50.0, np.float32),
         pad_num=np.zeros((R, S), np.int32),
         pad_track=np.full((R, S), -1, np.int32),
         tick_ms=np.int32(spec.tick_ms),

@@ -163,6 +163,7 @@ class Room:
             is_video=info.type == pm.TrackType.VIDEO,
             pub_muted=info.muted,
             is_svc=is_svc,
+            pub_sub=publisher.sub_col,
         )
         if self.udp is not None:
             self.udp.set_track_kind(self.slots.row, col, info.type == pm.TrackType.VIDEO)

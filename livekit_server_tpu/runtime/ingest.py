@@ -123,6 +123,10 @@ class IngestBuffer:
         # Per-sub RTT (host replay throttle) — NACK resolution itself is
         # host-side (plane_runtime.HostSequencer).
         self.rtt_ms = np.full((R, S), 100, np.int32)  # persistent (RR-updated)
+        # Track → publishing participant's subscriber slot (-1 unknown):
+        # lets the tick score each track's MOS with its publisher-path RTT
+        # (scorer.go includes RTT in the E-model delay term).
+        self.track_pub_sub = np.full((R, T), -1, np.int32)
         self.nack_overflow = 0   # NACK counts clipped by NACK_COUNT_CAP
         self._nack_seen: set = set()           # per-tick (r, s, sn, track)
         self._nack_tick_cnt = np.zeros((R, S), np.int32)
@@ -397,6 +401,13 @@ class IngestBuffer:
             estimate=self._estimate.copy(),
             estimate_valid=self._estimate_valid.copy(),
             nacks=self._nacks.copy(),
+            pub_rtt_ms=np.where(
+                self.track_pub_sub >= 0,
+                np.take_along_axis(
+                    self.rtt_ms, np.clip(self.track_pub_sub, 0, S - 1), axis=1
+                ),
+                0,
+            ).astype(np.float32),
             pad_num=np.asarray(pad_num, np.int32),
             pad_track=np.asarray(pad_track, np.int32),
             tick_ms=np.int32(self.tick_ms),

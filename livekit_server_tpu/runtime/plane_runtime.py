@@ -225,8 +225,8 @@ def _build_step(audio_params, bwe_params, egress_cap, red_enabled=True):
     """Packed-wire step: ONE input upload, ONE output fetch per tick
     (plane.pack_tick_inputs / pack_tick_outputs)."""
 
-    def tick(state, pkt, fb, tick_ms, roll_quality):
-        inp = plane.unpack_tick_inputs(pkt, fb, tick_ms, roll_quality)
+    def tick(state, pkt, fb, tf, tick_ms, roll_quality):
+        inp = plane.unpack_tick_inputs(pkt, fb, tf, tick_ms, roll_quality)
         state, out = plane.media_plane_tick(
             state, inp, audio_params, bwe_params, egress_cap=egress_cap,
             red_enabled=red_enabled,
@@ -323,14 +323,21 @@ class PlaneRuntime:
 
     # -- control-plane mutation API (host mirrors; applied at tick edge) --
     def set_track(self, room: int, track: int, *, published: bool, is_video: bool,
-                  pub_muted: bool = False, is_svc: bool = False) -> None:
+                  pub_muted: bool = False, is_svc: bool = False,
+                  pub_sub: int | None = None) -> None:
         self.meta.published[room, track] = published
         self.meta.is_video[room, track] = is_video
         self.meta.pub_muted[room, track] = pub_muted
         self.meta.is_svc[room, track] = is_svc
+        # pub_sub: the publishing participant's subscriber slot — lets the
+        # tick score this track's MOS with the publisher-path RTT. None
+        # leaves the existing mapping (mute toggles re-call set_track).
+        if pub_sub is not None:
+            self.ingest.track_pub_sub[room, track] = pub_sub
         if not published:
             # Free the columns' subscriber state implicitly: masks go false.
             self.ctrl.subscribed[room, track, :] = False
+            self.ingest.track_pub_sub[room, track] = -1
         self._ctrl_dirty = True
 
     def set_subscription(self, room: int, track: int, sub: int, *,
@@ -349,6 +356,7 @@ class PlaneRuntime:
         self.meta.published[room, :] = False
         self.meta.pub_muted[room, :] = False
         self.ctrl.subscribed[room, :, :] = False
+        self.ingest.track_pub_sub[room, :] = -1
         # Stale replay-ring entries must not survive row reuse: a new
         # room's NACK aliasing an old slot would retransmit the PREVIOUS
         # room's media bytes (cross-room leak).

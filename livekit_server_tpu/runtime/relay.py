@@ -275,8 +275,13 @@ class MediaRelay(asyncio.DatagramProtocol):
                     self._reject(addr)
                     return
                 # The mover chooses the next pin (None for v1: an explicit,
-                # token-holder-authorized unpin).
-                alloc.commit = commit
+                # token-holder-authorized unpin) — but ONLY when origin-
+                # authorized. A replayed frame may still move an UNPINNED
+                # allocation (that is v1's documented risk model), yet it
+                # must never plant a pin: an attacker pinning a v1 client's
+                # allocation would block the victim's own re-BIND reclaim.
+                if proof_ok or fresh:
+                    alloc.commit = commit
                 self.by_client.pop(alloc.client_addr, None)
                 alloc.client_addr = addr
             elif commit is not None and (proof_ok or fresh):

@@ -29,9 +29,11 @@ from livekit_server_tpu.runtime import crypto as _crypto
 # ops/pacer (a device-ops module that must not import host runtime code)
 # hardcodes the per-packet wire overhead; pin it to the real frame layout
 # here so a crypto-header change cannot silently drift the pacer budgets.
-assert WIRE_OVERHEAD_BYTES == _crypto.HEADER_LEN + 16 + 12, (
-    "ops/pacer.WIRE_OVERHEAD_BYTES out of sync with sealed-frame layout"
-)
+# Explicit raise, not assert: the tripwire must survive `python -O`.
+if WIRE_OVERHEAD_BYTES != _crypto.HEADER_LEN + 16 + 12:
+    raise ImportError(
+        "ops/pacer.WIRE_OVERHEAD_BYTES out of sync with sealed-frame layout"
+    )
 from livekit_server_tpu.runtime.crypto import (
     DIR_C2S,
     MAGIC as CRYPTO_MAGIC,

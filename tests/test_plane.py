@@ -50,6 +50,7 @@ def make_inputs(dims: plane.PlaneDims, **over):
         estimate=jnp.zeros((R, S), jnp.float32),
         estimate_valid=jnp.zeros((R, S), jnp.bool_),
         nacks=jnp.zeros((R, S), jnp.float32),
+        pub_rtt_ms=jnp.zeros((R, T), jnp.float32),
         pad_num=jnp.zeros((R, S), jnp.int32),
         pad_track=jnp.full((R, S), -1, jnp.int32),
         tick_ms=jnp.int32(20),
@@ -304,6 +305,32 @@ def test_quality_outputs_and_window_roll():
     assert float(out.raw.track_loss_pct[0, 0]) > 50.0
     assert int(out.raw.track_quality[0, 0]) == 0  # POOR
     assert int(out.raw.track_quality[0, 1]) == 2  # clean track unaffected
+
+
+def test_rtt_lowers_mos():
+    """Measured publisher-path RTT feeds the E-model delay term
+    (scorer.go:45-120): the same clean stream scores a lower MOS on a
+    high-RTT path than on a low-RTT one."""
+    dims, st = two_party_audio_state()
+    step = dense_step(jax.jit(plane.media_plane_tick), dims)
+    st_hi = st
+    for i in range(10):
+        base = dict(
+            sn=jnp.asarray([[[i], [i]]], jnp.int32),
+            size=jnp.full((1, 2, 1), 120, jnp.int32),
+            valid=jnp.ones((1, 2, 1), jnp.bool_),
+        )
+        st, out_lo = step(st, make_inputs(dims, **base))
+        st_hi, out_hi = step(
+            st_hi,
+            make_inputs(
+                dims, pub_rtt_ms=jnp.full((1, 2), 400.0, jnp.float32), **base
+            ),
+        )
+    mos_lo = float(out_lo.raw.track_mos[0, 0])
+    mos_hi = float(out_hi.raw.track_mos[0, 0])
+    assert mos_hi < mos_lo - 0.2, (mos_lo, mos_hi)
+    assert mos_lo > 4.1  # clean + zero RTT stays excellent
 
 
 def test_svc_single_stream_stats_no_false_loss():

@@ -475,6 +475,26 @@ async def test_relay_move_requires_continuity_proof():
         assert _recv(owner)[-1][4] == BIND_ACK
         assert relay.allocs[sess.key_id].client_addr == owner.getsockname()
 
+        # A replayed frame must never PLANT a pin on an unpinned (v1)
+        # allocation: it may move it (v1's documented risk model), but the
+        # victim's plain v1 re-BIND must still reclaim the path.
+        sessv1 = reg.mint()
+        tokv1 = mint_relay_token(SECRET, sessv1.key_id, 30)
+        _bind_via(owner, relay_addr, tokv1)           # v1 creation
+        await asyncio.sleep(0.05)
+        assert _recv(owner)[-1][4] == BIND_ACK
+        # Attacker crafts a v2 move from the captured token: spent nonce,
+        # no proof — it moves (unpinned) but must not pin.
+        _bind_via(attacker, relay_addr, tokv1 + b"\x00" * 16 + continuity_commit(b"evil" * 4))
+        await asyncio.sleep(0.05)
+        assert _recv(attacker)[-1][4] == BIND_ACK  # moved (v1 semantics)...
+        assert relay.allocs[sessv1.key_id].client_addr == attacker.getsockname()
+        assert relay.allocs[sessv1.key_id].commit is None  # ...but no pin
+        _bind_via(owner, relay_addr, tokv1)           # victim reclaims
+        await asyncio.sleep(0.05)
+        assert _recv(owner)[-1][4] == BIND_ACK
+        assert relay.allocs[sessv1.key_id].client_addr == owner.getsockname()
+
         # Recovery: chain state lost (crash, or an attacker raced a move
         # and spent our reveal) — a FRESH token, mintable only over the
         # authenticated signal channel, re-pins without a proof...

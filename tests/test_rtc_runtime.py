@@ -319,6 +319,29 @@ async def test_quality_window_rolls_in_runtime(runtime):
     assert res.track_quality.shape == (DIMS.rooms, DIMS.tracks)
 
 
+async def test_publisher_rtt_feeds_track_mos(runtime):
+    """The measured publisher-path RTT (ingest.rtt_ms via the track→
+    publisher-slot mapping) reaches the device E-model: identical clean
+    streams score worse on a high-RTT publisher path."""
+    runtime.set_track(0, 0, published=True, is_video=False, pub_sub=1)
+    runtime.set_track(0, 1, published=True, is_video=False, pub_sub=2)
+    runtime.set_subscription(0, 0, 3, subscribed=True)
+    runtime.set_subscription(0, 1, 3, subscribed=True)
+    runtime.ingest.set_rtt(0, 1, 600)   # track 0's publisher: bad path
+    runtime.ingest.set_rtt(0, 2, 1)     # track 1's publisher: pristine
+    res = None
+    for i in range(12):
+        for t in (0, 1):
+            runtime.ingest.push(PacketIn(
+                room=0, track=t, sn=100 + i, ts=960 * i, size=120,
+                payload=b"x" * 120,
+            ))
+        res = await runtime.step_once()
+    mos_hi_rtt = float(res.track_mos[0, 0])
+    mos_lo_rtt = float(res.track_mos[0, 1])
+    assert mos_hi_rtt < mos_lo_rtt - 0.2, (mos_hi_rtt, mos_lo_rtt)
+
+
 async def test_dynacast_subscribed_quality_update(runtime):
     """Subscriber caps aggregate to a subscribed_quality_update for the
     publisher; upgrades fire immediately (dynacastmanager.go:187-255)."""
