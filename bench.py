@@ -167,22 +167,21 @@ async def host_path_bench(dims, spec, ticks: int, device_tick_ms: float) -> dict
     from livekit_server_tpu.runtime import PlaneRuntime
     from livekit_server_tpu.runtime.udp import start_udp_transport
 
+    import socket as _socket
+
     runtime = PlaneRuntime(dims, tick_ms=spec.tick_ms)
     udp = await start_udp_transport(runtime.ingest, host="127.0.0.1", port=0)
 
-    # A loopback receiver so egress sendto hits the real kernel socket path.
-    class _Sink(asyncio.DatagramProtocol):
-        def __init__(self):
-            self.rx = 0
-
-        def datagram_received(self, data, addr):
-            self.rx += 1
-
+    # A loopback receiver socket so egress hits the real kernel send path.
+    # Deliberately NEVER read (and not registered with asyncio): a real
+    # subscriber is a remote host — an in-process Python consumer would
+    # bill ~5k asyncio callbacks/tick of its own cost to the SFU's
+    # forward-latency measurement. Packets beyond rcvbuf drop in-kernel.
     loop = asyncio.get_running_loop()
-    sink_transport, _sink = await loop.create_datagram_endpoint(
-        _Sink, local_addr=("127.0.0.1", 0)
-    )
-    sink_addr = sink_transport.get_extra_info("sockname")
+    sink_sock = _socket.socket(_socket.AF_INET, _socket.SOCK_DGRAM)
+    sink_sock.bind(("127.0.0.1", 0))
+    sink_sock.setblocking(False)
+    sink_addr = sink_sock.getsockname()
 
     nv = min(spec.video_tracks, dims.tracks)
     used = min(nv + spec.audio_tracks, dims.tracks)
@@ -242,10 +241,17 @@ async def host_path_bench(dims, spec, ticks: int, device_tick_ms: float) -> dict
         spec.video_tracks * spec.video_kbps + spec.audio_tracks * spec.audio_kbps
     )
 
+    # Host time is the SUM of the directly-timed host segments (rx/stage
+    # before the device step, fan-out/egress after) rather than wall time
+    # minus the in-loop device call: through a tunneled dev chip the
+    # in-loop dispatch takes ~100 ms and its client-side marshaling
+    # contends with the measuring thread, inflating wall-minus-device by
+    # GIL-scheduling artifacts a locally-attached chip does not have. The
+    # segments below are the actual serialized per-tick host work.
     host_ms = []
     sent0 = 0
     seq_t0 = time.perf_counter()
-    src = ("127.0.0.1", 50000)
+    loop = asyncio.get_running_loop()
     for i in range(ticks + 2):
         if i == 2:  # first ticks pay jit compile; time/count from here
             sent0 = udp.stats["tx"]
@@ -253,13 +259,20 @@ async def host_path_bench(dims, spec, ticks: int, device_tick_ms: float) -> dict
         t0 = time.perf_counter()
         blob, offs, lens, ips, ports_a = pre[i]
         udp.feed_batch(blob, offs, lens, ips, ports_a, len(offs))
-        udp._flush_rx()  # one native batch parse (the event-loop coalesce)
+        udp._flush_rx()  # asyncio-path drain (no-op after feed_batch)
         runtime.ingest._estimate[:] = est
         runtime.ingest._estimate_valid[:] = True
-        await runtime.step_once()  # on_tick → send_egress inside
-        total = time.perf_counter() - t0
+        staged = runtime._stage()
+        pre_dev = time.perf_counter() - t0
+        out = await loop.run_in_executor(
+            runtime._executor, runtime._device_step, staged[0]
+        )
+        t1 = time.perf_counter()
+        runtime._mirror_probe_inputs(out)
+        await runtime._complete(out, *staged)  # on_tick → send_egress inside
+        post_dev = time.perf_counter() - t1
         if i >= 2:
-            host_ms.append((total - dev_times[-1]) * 1000.0)
+            host_ms.append((pre_dev + post_dev) * 1000.0)
     seq_wall = time.perf_counter() - seq_t0
     sent = udp.stats["tx"] - sent0
 
@@ -290,18 +303,27 @@ async def host_path_bench(dims, spec, ticks: int, device_tick_ms: float) -> dict
 
     runtime._device_step = orig_step
     udp.transport.close()
-    sink_transport.close()
+    sink_sock.close()
     await runtime.stop()
 
     fwd = np.asarray(host_ms) + device_tick_ms
+    host_p50 = float(np.percentile(host_ms, 50)) if host_ms else 0.0
     return {
         "p50_forward_ms": round(float(np.percentile(fwd, 50)), 3),
         "p99_forward_ms": round(float(np.percentile(fwd, 99)), 3),
+        "host_ms_p50": round(host_p50, 3),
         "host_egress_pps": round(sent / (np.sum(host_ms) / 1000.0), 1)
         if host_ms and sent else 0.0,
         "wire_packets": int(sent),
+        # Wall-clock rates below include the dev tunnel's ~100 ms dispatch
+        # RTT per tick and are therefore tunnel-bound on this rig;
+        # tick_hz_local_estimate is what a locally-attached chip sustains
+        # (pipelined loop: host and device overlap, budget = max of both).
         "tick_hz_sequential": round(ticks / seq_wall, 1) if seq_wall else 0.0,
         "tick_hz_pipelined": round(P / pipe_wall, 1) if pipe_wall else 0.0,
+        "tick_hz_local_estimate": round(
+            1000.0 / max(host_p50, device_tick_ms, 1e-6), 1
+        ),
     }
 
 

@@ -76,7 +76,23 @@ class _NativeRTP:
             ctypes.c_void_p, ctypes.c_void_p, ctypes.c_void_p,
             ctypes.c_void_p, ctypes.c_void_p, ctypes.c_void_p, ctypes.c_void_p,
         ]
+        self.lib.gather_ranges.restype = ctypes.c_int64
+        self.lib.gather_ranges.argtypes = [
+            ctypes.c_void_p, ctypes.c_void_p, ctypes.c_void_p, ctypes.c_int,
+            ctypes.c_void_p,
+        ]
         self.native = True
+
+    def gather_ranges(self, blob: np.ndarray, starts, lens) -> bytes:
+        """bytes(blob[s0:s0+l0] + blob[s1:s1+l1] + ...) in one C call."""
+        starts_c = np.ascontiguousarray(starts, np.int64)
+        lens_c = np.ascontiguousarray(lens, np.int64)
+        out = np.empty(int(lens_c.sum()), np.uint8)
+        n = self.lib.gather_ranges(
+            blob.ctypes.data, starts_c.ctypes.data, lens_c.ctypes.data,
+            len(starts_c), out.ctypes.data,
+        )
+        return out[: int(n)].tobytes()
 
     def parse_batch(
         self,
@@ -93,7 +109,16 @@ class _NativeRTP:
         mask = np.zeros(16, np.uint8)
         for pt in vp8_pts or ():
             mask[pt >> 3] |= 1 << (pt & 7)
-        b = np.frombuffer(bytes(buf), np.uint8)
+        # A contiguous uint8 ndarray passes zero-copy; anything else pays
+        # one copy (the hot rx path always hands the former).
+        if (
+            isinstance(buf, np.ndarray)
+            and buf.dtype == np.uint8
+            and buf.flags.c_contiguous
+        ):
+            b = buf
+        else:
+            b = np.frombuffer(bytes(buf), np.uint8)
         offs = np.ascontiguousarray(offsets, np.int32)
         lens = np.ascontiguousarray(lengths, np.int32)
         self.lib.parse_rtp_batch(

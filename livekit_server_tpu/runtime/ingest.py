@@ -24,6 +24,25 @@ from livekit_server_tpu.models import plane
 NACK_COUNT_CAP = 8
 
 
+def _gather_ranges(blob: np.ndarray, starts: np.ndarray, lens: np.ndarray) -> bytes:
+    """Concatenate blob[starts[i] : starts[i] + lens[i]] for all i in ONE
+    call — the per-packet `bytes` slicing this replaces was the slab's
+    per-tick Python hot spot. Native memcpy loop when available."""
+    from livekit_server_tpu.native import rtp
+
+    if getattr(rtp, "native", False):
+        return rtp.gather_ranges(blob, starts, lens)
+    total = int(lens.sum())
+    if total == 0:
+        return b""
+    # Index trick: repeat each range's start minus the running output
+    # offset, add arange → absolute source index per output byte.
+    out_base = np.repeat(
+        starts - np.concatenate([[np.int64(0)], np.cumsum(lens[:-1])]), lens
+    )
+    return (blob[out_base + np.arange(total, dtype=np.int64)]).tobytes()
+
+
 def _wrap_i32(x: int) -> int:
     """uint32 bit pattern → int32 two's complement (numpy 2.x raises on
     out-of-range np.int32(...) casts)."""
@@ -266,9 +285,11 @@ class IngestBuffer:
         self.pay_off[idx] = np.where(lens > 0, offs, -1)
         self.pay_len[idx] = lens
         self.marker[idx] = marker[keep]
-        self._slab += b"".join(
-            blob[o : o + l] for o, l in zip(starts.tolist(), lens.tolist())
+        blob_arr = (
+            blob if isinstance(blob, np.ndarray)
+            else np.frombuffer(blob, np.uint8)
         )
+        self._slab += _gather_ranges(blob_arr, starts, lens)
         # DD extension bytes (SVC): appended after the payload bytes.
         dmask = dd_start[keep] >= 0
         if dmask.any():
@@ -279,9 +300,7 @@ class IngestBuffer:
             self.dd_off[didx] = doffs
             self.dd_len[didx] = dlens
             self.dd_ver[didx] = dd_version[keep][dmask]
-            self._slab += b"".join(
-                blob[o : o + l] for o, l in zip(dstarts.tolist(), dlens.tolist())
-            )
+            self._slab += _gather_ranges(blob_arr, dstarts, dlens)
         # New per-group counts (capped at K).
         uniq_rt = sorted_rt[grp_start]
         self._count.reshape(-1)[uniq_rt] = np.minimum(

@@ -57,6 +57,10 @@ SVC_PT = 98               # single-stream SVC video (VP9/AV1) payload type
 # a signal message is never trusted — traffic-reflection hardening).
 PUNCH_REQ = b"LKPUNCH0"
 PUNCH_ACK = b"LKPUNCH1"
+# Sentinel for "SSRC has no latched address yet" in the vectorized rx
+# path; outside both the IPv4 code space (≥ 0) and the synthetic negative
+# codes (small negatives).
+_NO_LATCH = -(1 << 62)
 
 # RTCP payload types (rtcp-mux demux range per RFC 5761: byte1 in 192-223).
 RTCP_SR = 200
@@ -282,6 +286,15 @@ class UDPMediaTransport(asyncio.DatagramProtocol):
         self.transport: asyncio.DatagramTransport | None = None
         self.bindings: dict[int, SSRCBinding] = {}       # ssrc → coords
         self.addrs: dict[int, tuple] = {}                # ssrc → latched addr
+        # Integer address identities for the vectorized rx path: IPv4
+        # addresses code as (ip << 16) | port; anything else (IPv6 via the
+        # asyncio endpoint) gets a synthetic negative code. Latch
+        # comparisons then run as one numpy equality over the batch
+        # instead of tuple hashing per packet.
+        self._addr_code: dict[int, int] = {}    # ssrc → latched addr code
+        self._tuple_code: dict[tuple, int] = {} # addr tuple → code
+        self._code_tuple: dict[int, tuple] = {} # code → addr tuple
+        self._syn_code = -2
         self.sub_addrs: dict[tuple, tuple] = {}          # (room,sub) → addr
         self.sub_ssrc: dict[tuple, dict[int, int]] = {}  # (room,sub) → {track: ssrc}
         self.track_kind: dict[tuple, bool] = {}          # (room,track) → is_video
@@ -336,7 +349,6 @@ class UDPMediaTransport(asyncio.DatagramProtocol):
         self._sess_ctr = np.zeros(0, np.uint64)
         self._subs_rev = 0
         self._subs_synced = (-1, -1, -1)  # (rev, len(sub_addrs), len(sub_sessions))
-        self._ip_str: dict[int, str] = {}  # batch-rx source ip cache
         self._txsr_pkts = np.zeros((R, S, T), np.int64)
         self._txsr_oct = np.zeros((R, S, T), np.int64)
         self._txsr_ts = np.zeros((R, S, T), np.uint32)
@@ -448,6 +460,7 @@ class UDPMediaTransport(asyncio.DatagramProtocol):
     def release_ssrc(self, ssrc: int) -> None:
         self.bindings.pop(ssrc, None)
         self.addrs.pop(ssrc, None)
+        self._addr_code.pop(ssrc, None)
         self._rx_hi.pop(ssrc, None)
         self._rx_missing.pop(ssrc, None)
         self.pub_sr.pop(ssrc, None)
@@ -604,32 +617,74 @@ class UDPMediaTransport(asyncio.DatagramProtocol):
         ):
             self._sess_active[j] = 1
 
+    def _prune_addr_caches(self) -> None:
+        """Bound the addr↔code mirrors under a spoofed-source flood while
+        keeping every entry a latched SSRC still points at — evicting a
+        live latch would permanently sever a non-IPv4 client, whose
+        synthetic code cannot be re-derived from its tuple."""
+        live = set(self._addr_code.values())
+        self._code_tuple = {
+            c: t for c, t in self._code_tuple.items() if c in live
+        }
+        self._tuple_code = {
+            t: c for t, c in self._tuple_code.items() if c in live
+        }
+
+    def _addr_code_of(self, addr) -> int:
+        """Integer identity for an address tuple (see __init__)."""
+        c = self._tuple_code.get(addr)
+        if c is None:
+            import socket as _socket
+
+            try:
+                ip = int.from_bytes(_socket.inet_aton(addr[0]), "big")
+                c = (ip << 16) | (int(addr[1]) & 0xFFFF)
+            except (OSError, IndexError, TypeError, ValueError):
+                c = self._syn_code   # non-IPv4: synthetic negative code
+                self._syn_code -= 1
+            if len(self._tuple_code) >= 8192 or len(self._code_tuple) >= 8192:
+                self._prune_addr_caches()
+            self._tuple_code[addr] = c
+            self._code_tuple[c] = addr
+        return c
+
+    def _tuple_of_code(self, code: int) -> tuple:
+        t = self._code_tuple.get(code)
+        if t is None:
+            import socket as _socket
+
+            if code < 0:
+                return ("0.0.0.0", 0)  # unknown synthetic code (never live)
+            t = (
+                _socket.inet_ntoa(int(code >> 16).to_bytes(4, "big")),
+                int(code) & 0xFFFF,
+            )
+            if len(self._tuple_code) >= 8192 or len(self._code_tuple) >= 8192:
+                self._prune_addr_caches()
+            self._code_tuple[code] = t
+            self._tuple_code[t] = code
+        return t
+
     def feed_batch(self, blob, offs, lens, ips, ports, n) -> None:
         """Batch ingress from the native recvmmsg reader: sealed frames are
         opened with ONE native AES-GCM batch call (replay windows and the
-        client-active latch stay host-side), then every datagram runs the
-        normal demux. Replaces one asyncio protocol callback per datagram."""
-        import socket as _socket
-
+        client-active latch stay host-side), datagrams are classified
+        vectorized (punch / RTCP / RTP media), and all media goes through
+        ONE array demux+stage pass (_process_media_arrays) — no per-packet
+        Python objects on the media path."""
         self.stats["rx"] += int(n)
         offs = offs[:n]
         lens = lens[:n]
+        ips = ips[:n]
+        ports = ports[:n]
         valid = lens > 0
-        b0 = np.where(valid, blob[offs], 0xFF)
+        b0 = np.where(valid, blob[np.minimum(offs, len(blob) - 1)], 0xFF)
         sealed = (
             (b0 == CRYPTO_MAGIC) & valid
             if self.crypto is not None else np.zeros(n, bool)
         )
-
-        def addr_of(i):
-            ip = int(ips[i])
-            s_ = self._ip_str.get(ip)
-            if s_ is None:
-                if len(self._ip_str) >= 4096:
-                    # Spoofed-source flood must not grow this unbounded.
-                    self._ip_str.clear()
-                s_ = self._ip_str[ip] = _socket.inet_ntoa(ip.to_bytes(4, "big"))
-            return (s_, int(ports[i]))
+        addr_code = (ips.astype(np.int64) << 16) | ports.astype(np.int64)
+        now_ms = asyncio.get_event_loop().time() * 1000.0
 
         if sealed.any():
             si = np.nonzero(sealed)[0]
@@ -658,7 +713,11 @@ class UDPMediaTransport(asyncio.DatagramProtocol):
             ctr = np.zeros(len(si), np.uint64)
             for b in range(8):
                 ctr = (ctr << np.uint64(8)) | blob[o + 6 + b].astype(np.uint64)
-            for j, i in enumerate(si):
+            # Replay windows are inherently sequential per session; the
+            # loop is per *sealed* packet but does dict/bitmask work only.
+            good = np.zeros(len(si), bool)
+            scodes = np.zeros(len(si), np.int64)
+            for j in range(len(si)):
                 if olen[j] < 0:
                     self.stats["bad_frame"] += 1
                     continue
@@ -667,22 +726,58 @@ class UDPMediaTransport(asyncio.DatagramProtocol):
                     self.stats["bad_frame"] += 1
                     continue
                 self._mark_client_active(sess)
-                inner = bytes(out[int(ooff[j]) : int(ooff[j]) + int(olen[j])])
-                self._dispatch_inner(inner, addr_of(i), sess)
+                good[j] = True
+                scodes[j] = int(kid[j]) + 1
+            gi = np.nonzero(good)[0]
+            if len(gi):
+                self._classify_and_process(
+                    out, ooff[gi].astype(np.int32), olen[gi],
+                    addr_code[si[gi]], scodes[gi], sessions, kid[gi], now_ms,
+                )
 
-        clear = np.nonzero(valid & ~sealed)[0]
-        if len(clear):
+        clear = valid & ~sealed
+        nclear = int(clear.sum())
+        if nclear:
             if self.require_encryption:
                 # Secure mode: the cleartext media wire does not exist —
                 # but punch probes ride sealed frames only, so anything
                 # cleartext here is droppable wholesale.
-                self.stats["plaintext_drop"] += len(clear)
+                self.stats["plaintext_drop"] += nclear
             else:
-                for i in clear:
-                    oo = int(offs[i])
-                    self._dispatch_inner(
-                        bytes(blob[oo : oo + int(lens[i])]), addr_of(i), None
-                    )
+                ci = np.nonzero(clear)[0]
+                self._classify_and_process(
+                    blob, offs[ci], lens[ci], addr_code[ci],
+                    np.zeros(len(ci), np.int64), None, None, now_ms,
+                )
+
+    def _classify_and_process(self, blob, offs, lens, addr_code, sess_code,
+                              sessions, kid, now_ms) -> None:
+        """Split one (possibly decrypted) datagram batch into punch / RTCP
+        (cold, per-packet) and RTP media (hot, one vectorized pass)."""
+        b0 = blob[np.minimum(offs.astype(np.int64), len(blob) - 1)]
+        b1 = blob[np.minimum(offs.astype(np.int64) + 1, len(blob) - 1)]
+        maybe_punch = (b0 == PUNCH_REQ[0]) & (lens >= 12)
+        is_rtcp = ~maybe_punch & (b1 >= 192) & (b1 <= 223) & (lens >= 8)
+        media = ~maybe_punch & ~is_rtcp
+        for i in np.nonzero(maybe_punch)[0]:
+            oo = int(offs[i])
+            d = bytes(blob[oo : oo + int(lens[i])])
+            sess = sessions.get(int(kid[i])) if sessions is not None else None
+            if d[:8] == PUNCH_REQ:
+                self._handle_punch(d, self._tuple_of_code(int(addr_code[i])), sess)
+            # else: first byte 'L' is not a valid RTP version — drop like
+            # the parser would.
+        for i in np.nonzero(is_rtcp)[0]:
+            oo = int(offs[i])
+            self._handle_rtcp(
+                bytes(blob[oo : oo + int(lens[i])]),
+                self._tuple_of_code(int(addr_code[i])),
+            )
+        mi = np.nonzero(media)[0]
+        if len(mi):
+            self._process_media_arrays(
+                blob, offs[mi], lens[mi], addr_code[mi], sess_code[mi], now_ms
+            )
 
     def datagram_received(self, data: bytes, addr) -> None:
         self.stats["rx"] += 1
@@ -955,43 +1050,42 @@ class UDPMediaTransport(asyncio.DatagramProtocol):
         self._sendto(PUNCH_ACK + data[8:12], addr, session)
 
     def _flush_rx(self) -> None:
-        """One native parse + one vectorized ingest stage per event-loop
-        coalesce window. Per-PACKET Python is limited to unique-SSRC
-        binding resolution and video loss tracking; everything else is
-        numpy group math (the batch design this module documents)."""
+        """Drain the asyncio per-datagram queue (datagram_received / TCP
+        framing path) into the shared array demux. The native recvmmsg
+        reader bypasses this entirely — feed_batch goes straight to
+        _process_media_arrays."""
         self._rx_scheduled = False
         pending, self._rx_pending = self._rx_pending, []
         if not pending:
             return
         now_ms = asyncio.get_event_loop().time() * 1000.0
         n = len(pending)
-        lengths = np.empty(n, np.int32)
-        offsets = np.empty(n, np.int32)
-        addr_ids = np.empty(n, np.int64)
-        sess_ids = np.empty(n, np.int64)
-        addr_map: dict = {}
-        addr_list: list = []
-        sess_map: dict = {}
-        sess_list: list = [None]  # index 0 = no session (plaintext)
-        off = 0
-        for i, (d, addr, session) in enumerate(pending):
-            offsets[i] = off
-            lengths[i] = len(d)
-            off += len(d)
-            ai = addr_map.get(addr)
-            if ai is None:
-                ai = addr_map[addr] = len(addr_list)
-                addr_list.append(addr)
-            addr_ids[i] = ai
-            if session is None:
-                sess_ids[i] = 0
-            else:
-                si = sess_map.get(id(session))
-                if si is None:
-                    si = sess_map[id(session)] = len(sess_list)
-                    sess_list.append(session)
-                sess_ids[i] = si
-        blob = b"".join(d for d, _, _ in pending)
+        lengths = np.fromiter((len(d) for d, _, _ in pending), np.int32, n)
+        offsets = np.zeros(n, np.int32)
+        np.cumsum(lengths[:-1], out=offsets[1:])
+        blob = np.frombuffer(b"".join(d for d, _, _ in pending), np.uint8)
+        addr_code = np.fromiter(
+            (self._addr_code_of(a) for _, a, _ in pending), np.int64, n
+        )
+        sess_code = np.fromiter(
+            (0 if s is None else s.key_id + 1 for _, _, s in pending),
+            np.int64, n,
+        )
+        self._process_media_arrays(
+            blob, offsets, lengths, addr_code, sess_code, now_ms
+        )
+
+    def _process_media_arrays(
+        self, blob, offsets, lengths, addr_code, sess_code, now_ms
+    ) -> None:
+        """One native parse + one vectorized ingest stage per receive
+        batch. Per-PACKET Python is limited to rare paths (RED decap, DD
+        descriptors, loss-gap fallback); binding resolution is per UNIQUE
+        SSRC; everything else is numpy group math. `blob` is one
+        contiguous uint8 array; `addr_code`/`sess_code` are the integer
+        identities from _addr_code_of / key_id + 1 (0 = plaintext)."""
+        if not isinstance(blob, np.ndarray):
+            blob = np.frombuffer(blob, np.uint8)
         parsed = rtp.parse_batch(
             blob, offsets, lengths,
             audio_level_ext=AUDIO_LEVEL_EXT_ID, vp8_pts={VP8_PT},
@@ -1029,10 +1123,10 @@ class UDPMediaTransport(asyncio.DatagramProtocol):
         u_video = np.zeros(U, bool)
         u_svc = np.zeros(U, bool)
         u_keyed = np.zeros(U, bool)
-        u_sess = np.full(U, -1, np.int64)     # bound session's index this flush
+        u_scode = np.zeros(U, np.int64)       # bound session's key_id + 1
         u_aligned = np.zeros(U, bool)
         u_delta = np.zeros(U, np.int64)
-        u_latch = np.full(U, -1, np.int64)    # latched addr id (-2: not seen)
+        u_latch = np.full(U, _NO_LATCH, np.int64)  # latched addr code
         for j, sv in enumerate(uniq.tolist()):
             b = self.bindings.get(sv)
             if b is None:
@@ -1045,24 +1139,27 @@ class UDPMediaTransport(asyncio.DatagramProtocol):
             u_svc[j] = b.svc
             if b.session is not None:
                 u_keyed[j] = True
-                u_sess[j] = sess_map.get(id(b.session), -1)
+                u_scode[j] = b.session.key_id + 1
             delta = self._ts_delta.get((b.room, b.track, b.layer))
             if delta is not None:
                 u_aligned[j] = True
                 u_delta[j] = delta
-            latched = self.addrs.get(sv)
-            if latched is not None:
-                u_latch[j] = addr_map.get(latched, -2)
+            code = self._addr_code.get(sv)
+            if code is None and sv in self.addrs:
+                # Latched before the code mirror existed (restore paths).
+                code = self._addr_code[sv] = self._addr_code_of(self.addrs[sv])
+            if code is not None:
+                u_latch[j] = code
 
         known = ok & u_known[inv]
         self.stats["unknown_ssrc"] += int((ok & ~u_known[inv]).sum())
         # SSRC pinned to its publisher's key: valid media sealed under a
         # DIFFERENT participant's session must not inject here. In
-        # cleartext-allowed mode a plaintext packet (session index 0) is
+        # cleartext-allowed mode a plaintext packet (sess_code 0) is
         # legal even for a keyed SSRC (legacy client).
         keyed = u_keyed[inv]
-        same = (sess_ids == u_sess[inv]) & (u_sess[inv] > 0)
-        mismatch = keyed & ~same & ((sess_ids != 0) | self.require_encryption)
+        same = (sess_code == u_scode[inv]) & (u_scode[inv] > 0)
+        mismatch = keyed & ~same & ((sess_code != 0) | self.require_encryption)
         self.stats["session_mismatch"] += int((known & mismatch).sum())
         cand = known & ~mismatch
 
@@ -1072,19 +1169,56 @@ class UDPMediaTransport(asyncio.DatagramProtocol):
         first = np.full(U, -1, np.int64)
         pos = np.nonzero(cand)[0]
         first[inv[pos][::-1]] = pos[::-1]  # smallest position wins
-        for j in np.nonzero((u_latch == -1) & (first >= 0))[0]:
-            aid = addr_ids[first[j]]
-            self.addrs[int(uniq[j])] = addr_list[int(aid)]
-            u_latch[j] = aid
-        addr_ok = addr_ids == u_latch[inv]
+        for j in np.nonzero((u_latch == _NO_LATCH) & (first >= 0))[0]:
+            code = int(addr_code[first[j]])
+            sv = int(uniq[j])
+            self.addrs[sv] = self._tuple_of_code(code)
+            self._addr_code[sv] = code
+            u_latch[j] = code
+        addr_ok = addr_code == u_latch[inv]
         self.stats["addr_mismatch"] += int((cand & ~addr_ok).sum())
         final = cand & addr_ok
 
         # NACK generation is video-only (the reference negotiates NACK for
-        # video; audio loss is concealed, never replayed).
+        # video; audio loss is concealed, never replayed). Fast path: an
+        # SSRC whose batch continues its watermark contiguously with no
+        # tracked holes needs no per-packet work at all — loss is the
+        # exception, so per-packet Python runs only on gap/reorder ticks.
         sn_arr = parsed["sn"]
-        for i in np.nonzero(final & u_video[inv])[0]:
-            self._track_upstream_loss(int(ssrcs[i]), int(sn_arr[i]), now_ms)
+        vid_pkts = np.nonzero(final & u_video[inv])[0]
+        if len(vid_pkts):
+            v_inv = inv[vid_pkts]
+            order = np.argsort(v_inv, kind="stable")   # per-SSRC, arrival order
+            sel = vid_pkts[order]
+            v_sorted = v_inv[order]
+            nv = len(sel)
+            grp = np.concatenate(
+                [[0], np.nonzero(np.diff(v_sorted))[0] + 1]
+            )
+            sns = sn_arr[sel].astype(np.int64)
+            # Per-group watermark continuity check, fully vectorized: the
+            # predecessor of each group's first packet is its SSRC's
+            # stored watermark; every other predecessor is the previous
+            # packet in the group.
+            prev = np.empty(nv, np.int64)
+            prev[1:] = sns[:-1]
+            g_ssrc = [int(ssrcs[sel[g]]) for g in grp.tolist()]
+            g_hi = [self._rx_hi.get(sv) for sv in g_ssrc]
+            prev[grp] = [h if h is not None else -1 for h in g_hi]
+            contiguous = ((sns - prev) & 0xFFFF) == 1
+            g_ok = np.logical_and.reduceat(contiguous, grp)
+            g_last = np.concatenate([grp[1:], [nv]]) - 1
+            for gi_, (g, sv, hi) in enumerate(zip(grp.tolist(), g_ssrc, g_hi)):
+                if (
+                    g_ok[gi_]
+                    and hi is not None
+                    and not self._rx_missing.get(sv)
+                ):
+                    self._rx_hi[sv] = int(sns[g_last[gi_]])
+                    continue
+                e = int(grp[gi_ + 1]) if gi_ + 1 < len(grp) else nv
+                for sn_v in sns[g:e].tolist():
+                    self._track_upstream_loss(sv, sn_v, now_ms)
 
         if self.sub_red:
             # Primary-payload ring per audio track — the bytes the RED
@@ -1104,7 +1238,9 @@ class UDPMediaTransport(asyncio.DatagramProtocol):
                         maxlen=RED_DISTANCE + self.ingest.dims.pkts
                     )
                 st = int(offsets[i]) + int(parsed["payload_off"][i])
-                ring.appendleft((int(sn_arr[i]), blob[st : st + int(plen[i])]))
+                ring.appendleft(
+                    (int(sn_arr[i]), bytes(blob[st : st + int(plen[i])]))
+                )
 
         idx = np.nonzero(final)[0]
         if len(idx):
@@ -1132,10 +1268,10 @@ class UDPMediaTransport(asyncio.DatagramProtocol):
                 for j in svc_dd:
                     i = idx[j]
                     key = (int(u_room[e_inv[j]]), int(u_track[e_inv[j]]))
-                    raw = blob[
+                    raw = bytes(blob[
                         int(parsed["dd_off"][i]) :
                         int(parsed["dd_off"][i]) + int(parsed["dd_len"][i])
-                    ]
+                    ])
                     hist = self._dd_structs.get(key)
                     struct = hist[-1][1] if hist else None
                     ver = hist[-1][0] if hist else -1
@@ -1379,6 +1515,13 @@ class UDPMediaTransport(asyncio.DatagramProtocol):
         )
         idx = np.nonzero((e_port != 0) & (po >= 0) & ~red_mask & pace_ok)[0]
         if len(idx):
+            # Destination-major order (stable in k): consecutive entries to
+            # one subscriber make long equal-size runs the native sender
+            # collapses into single GSO messages — the syscall count drops
+            # from per-datagram to per-(subscriber, track) burst. Within a
+            # (room, sub, track) stream k-order is preserved, so SNs still
+            # leave the host in order.
+            idx = idx[np.lexsort((k[idx], t[idx], s[idx], r[idx]))]
             rr_, tt_, ss_ = r[idx], t[idx], s[idx]
             kk_ = k[idx]
             ssrc = self._egress_ssrc_arr[rr_, ss_, tt_].copy()
