@@ -430,6 +430,19 @@ def main() -> None:
             result["mem_1k_rooms_50subs_ok"] = False
             result["mem_error"] = f"{type(e).__name__}"
 
+        # North-star tick: the FULL 10k-rooms × 50-subs plane on ONE chip
+        # (the BASELINE target shape is 10k×50 on v5e-8; room-sharding
+        # divides this by the mesh size, so single-chip-tick/8 estimates
+        # the per-chip cost on the target pod).
+        try:
+            d = plane.PlaneDims(10240, 8, 16, 50)
+            s = synth.TrafficSpec(video_tracks=2, audio_tracks=6, tick_ms=20,
+                                  video_kbps=1500, svc=True)
+            r = device_bench(d, s, ticks=3, warmup=1)
+            result["northstar_10240rooms_50subs_tick_ms"] = r["device_tick_ms"]
+        except Exception as e:  # noqa: BLE001
+            result["northstar_error"] = f"{type(e).__name__}"
+
     print(json.dumps(result))
 
 

@@ -270,11 +270,18 @@ def _room_tick(
     eff_layer = jnp.where(
         state.meta.is_svc[:, None], 0, jnp.clip(inp.layer, 0, L - 1)
     )
-    stream_idx = jnp.arange(T, dtype=jnp.int32)[:, None] * L + eff_layer
-    # Scatter packets into [T*L, K] rows by (track, layer).
+    # Route packets into [T*L, K] rows by (track, layer) — as an
+    # elementwise one-hot select, NOT a scatter: k is preserved, so
+    # (t, k) → (t, eff_layer, k) can never collide, and data-dependent
+    # scatters serialize per element on TPU while this select/transpose
+    # fuses (the cfg4-scale tick was dominated by exactly this scatter).
+    lanes = jnp.arange(L, dtype=jnp.int32)[None, None, :]            # [1,1,L]
     def to_streams(x, fill):
-        out = jnp.full((T * L, K), fill, x.dtype)
-        return out.at[stream_idx.reshape(-1), jnp.tile(jnp.arange(K), T)].set(x.reshape(-1))
+        routed = jnp.where(
+            eff_layer[:, :, None] == lanes, x[:, :, None],
+            jnp.asarray(fill, x.dtype),
+        )                                                            # [T,K,L]
+        return routed.transpose(0, 2, 1).reshape(T * L, K)
 
     st_sn = to_streams(inp.sn, 0)
     st_ts = to_streams(inp.ts, 0)

@@ -61,41 +61,80 @@ def encode_plan_tick(
     T, K = sn.shape
     D = RED_DISTANCE
 
-    def per_track(hist, xs):
-        h_sn, h_ts, h_len = hist
+    # Candidate j for packet k is simply the (j+1)-th most recent VALID
+    # packet before k — from this tick if the packet's exclusive valid-
+    # rank r covers it (r-1-j ≥ 0), else history slot j-r. Formulated as
+    # gathers over the K axis instead of the per-packet scan the original
+    # used: the scan's per-step shift chain dominated the cfg4 tick.
+    valid_i = valid.astype(jnp.int32)
+    rank = jnp.cumsum(valid_i, axis=-1) - valid_i           # [T, K] excl.
+    js = jnp.arange(D, dtype=jnp.int32)                     # [D]
+    cand_rank = rank[:, :, None] - 1 - js[None, None, :]    # [T, K, D]
+    from_tick = cand_rank >= 0
+    # Rank-match masked sums instead of sort + gather (both lower poorly
+    # on TPU at these shapes; K and D are tiny, so the [T,K,D,K] compare
+    # stays elementwise and fuses). Exact for int32 — a float32 one-hot
+    # contraction would corrupt 32-bit timestamps. A valid packet's
+    # exclusive rank is unique within the tick, so each candidate rank
+    # matches at most one source packet.
+    tick_oh = (
+        valid[:, None, None, :]
+        & (rank[:, None, None, :] == cand_rank[..., None])
+    )                                                        # [T,K,D,K']
+    hist_slot = -cand_rank - 1                               # = j - r
+    hist_oh = hist_slot[..., None] == js                     # [T,K,D,D']
 
-        def step(carry, x):
-            c_sn, c_ts, c_len = carry
-            p_sn, p_ts, p_len, p_valid = x
-            # Candidates: current history, most recent first.
-            off = p_ts - c_ts
-            ok = (
-                (c_sn >= 0)
-                & p_valid
-                & (off > 0)
-                & (off <= MAX_TS_OFFSET)
-                & (c_len <= MAX_BLOCK_LEN)
-                # redundancy must be the immediately preceding SNs
-                & ((p_sn - c_sn) & 0xFFFF <= D)
-            )
-            out = (c_sn, off, c_len, ok)
-            # Shift history: new packet enters slot 0.
-            n_sn = jnp.where(p_valid, jnp.concatenate([p_sn[None], c_sn[:-1]]), c_sn)
-            n_ts = jnp.where(p_valid, jnp.concatenate([p_ts[None], c_ts[:-1]]), c_ts)
-            n_len = jnp.where(p_valid, jnp.concatenate([p_len[None], c_len[:-1]]), c_len)
-            return (n_sn, n_ts, n_len), out
-
-        (h_sn, h_ts, h_len), outs = jax.lax.scan(step, (h_sn, h_ts, h_len), xs, unroll=True)
-        return (h_sn, h_ts, h_len), outs
-
-    def run_one(h_sn, h_ts, h_len, t_sn, t_ts, t_len, t_valid):
-        (n_sn, n_ts, n_len), (r_sn, r_off, r_len, r_ok) = per_track(
-            (h_sn, h_ts, h_len), (t_sn, t_ts, t_len, t_valid)
+    def pick(tick_arr, hist_arr):
+        # When from_tick is false, hist_slot = j - r ∈ [0, j] ⊂ [0, D) is
+        # always a real slot; empty slots carry sn = -1, which r_ok
+        # rejects — no separate fill branch needed.
+        tick_v = jnp.sum(
+            jnp.where(tick_oh, tick_arr[:, None, None, :], 0), axis=-1
         )
-        return n_sn, n_ts, n_len, r_sn, r_off, r_len, r_ok
+        hist_v = jnp.sum(
+            jnp.where(hist_oh, hist_arr[:, None, None, :], 0), axis=-1
+        )
+        return jnp.where(from_tick, tick_v, hist_v)
 
-    n_sn, n_ts, n_len, r_sn, r_off, r_len, r_ok = jax.vmap(run_one)(
-        state.hist_sn, state.hist_ts, state.hist_len, sn, ts, length, valid
+    c_sn = pick(sn, state.hist_sn)
+    c_ts = pick(ts, state.hist_ts)
+    c_len = pick(length, state.hist_len)
+    off = ts[:, :, None] - c_ts
+    r_ok = (
+        (c_sn >= 0)
+        & valid[:, :, None]
+        & (off > 0)
+        & (off <= MAX_TS_OFFSET)
+        & (c_len <= MAX_BLOCK_LEN)
+        # redundancy must be the immediately preceding SNs
+        & (((sn[:, :, None] - c_sn) & 0xFFFF) <= D)
     )
-    new_state = REDState(hist_sn=n_sn, hist_ts=n_ts, hist_len=n_len)
-    return new_state, r_sn, r_off, r_len, r_ok
+
+    # New history: the last D valid packets overall (tick + old history),
+    # most recent first — same rank-match selection with r = the tick's
+    # total valids.
+    total = jnp.sum(valid_i, axis=-1, keepdims=True)        # [T, 1]
+    h_rank = total - 1 - js[None, :]                        # [T, D]
+    h_from_tick = h_rank >= 0
+    h_tick_oh = (
+        valid[:, None, :] & (rank[:, None, :] == h_rank[..., None])
+    )                                                       # [T,D,K']
+    h_slot = -h_rank - 1
+    h_hist_oh = h_slot[..., None] == js                     # [T,D,D']
+
+    def pick_hist(tick_arr, hist_arr):
+        # Same slot-range argument as pick(): the fill branch cannot fire.
+        tick_v = jnp.sum(
+            jnp.where(h_tick_oh, tick_arr[:, None, :], 0), axis=-1
+        )
+        hist_v = jnp.sum(
+            jnp.where(h_hist_oh, hist_arr[:, None, :], 0), axis=-1
+        )
+        return jnp.where(h_from_tick, tick_v, hist_v)
+
+    new_state = REDState(
+        hist_sn=pick_hist(sn, state.hist_sn),
+        hist_ts=pick_hist(ts, state.hist_ts),
+        hist_len=pick_hist(length, state.hist_len),
+    )
+    return new_state, c_sn, off, c_len, r_ok

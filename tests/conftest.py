@@ -23,6 +23,17 @@ import jax  # noqa: E402
 
 jax.config.update("jax_platforms", "cpu")
 
+# Persistent compilation cache: the suite compiles the full media-plane
+# tick many times (sharded/unsharded/donated variants, plus the graft
+# dryrun's fresh subprocess); identical computations then hit the disk
+# cache instead of recompiling. Shared location so the dryrun subprocess
+# and repeat suite runs benefit too.
+jax.config.update(
+    "jax_compilation_cache_dir",
+    os.environ.get("JAX_COMPILATION_CACHE_DIR", "/tmp/jax_cache_livekit_tpu"),
+)
+jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
+
 # Minimal async-test support (pytest-asyncio isn't in this image): any
 # `async def test_*` runs under asyncio.run, `@pytest.mark.asyncio` or not.
 import asyncio  # noqa: E402

@@ -100,8 +100,10 @@ def test_pallas_budget_kernel_matches_scan():
     to the scan formulation across random caps/mutes/budgets — run here in
     interpreter mode on CPU."""
     rng = np.random.default_rng(7)
-    for _ in range(10):
-        T, S = int(rng.choice([4, 8, 16])), int(rng.choice([4, 32]))
+    # Fixed shape set (small/asymmetric/large): interpret-mode Pallas pays
+    # a full retrace per distinct shape, so random shapes made this the
+    # slowest test in the suite (~4 min) for no extra kernel coverage.
+    for T, S in ((4, 4), (8, 32), (16, 4)):
         bit = (rng.random((T, 4, 4)) * 2e5 * (rng.random((T, 4, 4)) > 0.3)).astype(np.float32)
         ms = rng.integers(-1, 4, (S, T)).astype(np.int32)
         mt = rng.integers(-1, 4, (S, T)).astype(np.int32)

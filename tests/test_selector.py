@@ -110,8 +110,9 @@ def test_pallas_dual_selector_matches_scan():
     from livekit_server_tpu.ops import selector as sel
 
     rng = np.random.default_rng(3)
-    for _ in range(10):
-        T, K, S = int(rng.choice([4, 16])), int(rng.choice([4, 16])), int(rng.choice([4, 32]))
+    # Fixed shape set — see test_allocation.py: interpret-mode Pallas
+    # retraces per shape; random shapes only multiplied compile time.
+    for T, K, S in ((4, 4, 4), (16, 16, 32), (4, 16, 4)):
         st = sel.SelectorState(
             current_spatial=jnp.asarray(rng.integers(-1, 3, (T, S)), jnp.int32),
             current_temporal=jnp.asarray(rng.integers(-1, 4, (T, S)), jnp.int32),
