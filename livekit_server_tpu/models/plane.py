@@ -103,6 +103,7 @@ class PlaneState(NamedTuple):
     vp8_state: vp8.VP8State              # [R, T, S]
     sel: selector.SelectorState          # [R, T, S]
     bwe_state: bwe.BWEState              # [R, S]
+    delay_bwe: bwe.DelayBWEState         # [R, S] — TWCC send-side estimator
     tracker: streamtracker.TrackerState  # [R, T*L] per (track, layer) stream
     pacer_state: pacer.PacerState        # [R, S] — leaky-bucket egress pacing
     red_state: red.REDState              # [R, T, D] — RED history rings
@@ -147,6 +148,14 @@ class TickInputs(NamedTuple):
     # track→publisher-slot mapping. Feeds the E-model delay term
     # (scorer.go:45-120 includes RTT); 0 where unknown.
     pub_rtt_ms: jax.Array
+    # TWCC feedback reductions, [R, S] (see ops/bwe delay estimator):
+    fb_delay_ms: jax.Array    # float32 — mean delay-variation this tick
+    fb_recv_bps: jax.Array    # float32 — acked receive rate sample
+    fb_valid: jax.Array       # bool — feedback arrived this tick
+    fb_enabled: jax.Array     # bool — sub is on the sealed UDP path
+    sub_reset: jax.Array      # [R, S] bool — slot released this tick:
+                              # reset its per-sub device state (BWE/
+                              # delay/pacer) before this tick's update
     # BWE probe padding (probe_controller → WritePaddingRTP), [R, S]:
     pad_num: jax.Array         # int32 — padding packets to synthesize (≤ PAD_MAX)
     pad_track: jax.Array       # int32 — track whose downtrack carries them (-1 none)
@@ -242,6 +251,7 @@ def init_state(dims: PlaneDims) -> PlaneState:
         vp8_state=jax.tree.map(lambda x: tile(x, R, T), vp8.init_state(S)),
         sel=jax.tree.map(lambda x: tile(x, R, T), selector.init_state(S)),
         bwe_state=jax.tree.map(lambda x: tile(x, R), bwe.init_state(S)),
+        delay_bwe=jax.tree.map(lambda x: tile(x, R), bwe.delay_init_state(S)),
         tracker=jax.tree.map(lambda x: tile(x, R), streamtracker.init_state(T * L)),
         pacer_state=jax.tree.map(lambda x: tile(x, R), pacer.init_state(S)),
         red_state=jax.tree.map(lambda x: tile(x, R), red.init_state(T)),
@@ -398,11 +408,36 @@ def _room_tick(
     pad_valid = t_pad_valid[safe_track, :, sub_ix] & (inp.pad_track >= 0)[:, None]
 
     # ---- BWE per subscriber (uses this tick's actual send counts) ------
+    # Released slots reset their per-sub state first: the next occupant
+    # must not inherit a decayed rate or a sticky feedback latch.
+    def _reset_rows(cur_tree, init_tree, mask):
+        def f(c, i):
+            m = mask.reshape(mask.shape + (1,) * (c.ndim - mask.ndim))
+            return jnp.where(m, i, c)
+        return jax.tree.map(f, cur_tree, init_tree)
+
+    bwe_prev = _reset_rows(state.bwe_state, bwe.init_state(S), inp.sub_reset)
+    delay_prev = _reset_rows(
+        state.delay_bwe, bwe.delay_init_state(S), inp.sub_reset
+    )
+    pacer_prev = _reset_rows(
+        state.pacer_state, pacer.init_state(S), inp.sub_reset
+    )
     pkts_sent = jnp.sum(send, axis=(0, 1)).astype(jnp.float32)  # [S]
     bwe_state, congested, trend, budget = bwe.update_tick(
-        state.bwe_state, bwe_params, inp.estimate, inp.estimate_valid,
+        bwe_prev, bwe_params, inp.estimate, inp.estimate_valid,
         pkts_sent, inp.nacks,
     )
+    # TWCC send-side estimate (transport.go:253-374 seat): where active,
+    # it CAPS the budget — allocation then never exceeds what the sender
+    # itself measured from feedback, however optimistic (or absent) the
+    # client's volunteered estimates are.
+    delay_bwe, delay_rate, delay_over, delay_active = bwe.delay_update_tick(
+        delay_prev, bwe.DelayBWEParams(), inp.fb_delay_ms,
+        inp.fb_recv_bps, inp.fb_valid, inp.fb_enabled, pkts_sent, inp.tick_ms,
+    )
+    budget = jnp.where(delay_active, jnp.minimum(budget, delay_rate), budget)
+    congested = congested | delay_over
 
     # ---- leaky-bucket egress pacing (pacer/leaky_bucket.go:47-200) ------
     # Budgets from the allocator's committed rate gate the HOST egress
@@ -413,7 +448,7 @@ def _room_tick(
         axis=(0, 1),
     ).astype(jnp.float32)                                            # [S]
     pacer_state, pacer_allowed, _pacer_backlog = pacer.update_tick(
-        state.pacer_state, pacer.PacerParams(), sent_bytes, budget, inp.tick_ms
+        pacer_prev, pacer.PacerParams(), sent_bytes, budget, inp.tick_ms
     )
 
     # ---- allocation across tracks per subscriber → targets for next tick
@@ -522,6 +557,7 @@ def _room_tick(
         vp8_state=vp8_state,
         sel=sel_state,
         bwe_state=bwe_state,
+        delay_bwe=delay_bwe,
         tracker=tracker,
         pacer_state=pacer_state,
         red_state=red_state,
@@ -632,7 +668,7 @@ _BOOL_FIELDS = {"keyframe", "layer_sync", "begin_pic", "end_frame", "valid"}
 
 
 def pack_tick_inputs(inp: TickInputs):
-    """Host-side: TickInputs → (pkt [F,R,T,K] i32, fb [5,R,S] f32,
+    """Host-side: TickInputs → (pkt [F,R,T,K] i32, fb [10,R,S] f32,
     tf [1,R,T] f32, tick_ms, roll_quality)."""
     import numpy as np
 
@@ -644,6 +680,11 @@ def pack_tick_inputs(inp: TickInputs):
             np.asarray(inp.nacks, np.float32),
             np.asarray(inp.pad_num, np.float32),
             np.asarray(inp.pad_track, np.float32),
+            np.asarray(inp.fb_delay_ms, np.float32),
+            np.asarray(inp.fb_recv_bps, np.float32),
+            np.asarray(inp.fb_valid).astype(np.float32),
+            np.asarray(inp.fb_enabled).astype(np.float32),
+            np.asarray(inp.sub_reset).astype(np.float32),
         ]
     )
     tf = np.asarray(inp.pub_rtt_ms, np.float32)[None]
@@ -670,6 +711,11 @@ def unpack_tick_inputs(
         pub_rtt_ms=tf[0],
         pad_num=fb[3].astype(jnp.int32),
         pad_track=fb[4].astype(jnp.int32),
+        fb_delay_ms=fb[5],
+        fb_recv_bps=fb[6],
+        fb_valid=fb[7] > 0.5,
+        fb_enabled=fb[8] > 0.5,
+        sub_reset=fb[9] > 0.5,
         tick_ms=tick_ms,
         roll_quality=roll_quality,
     )

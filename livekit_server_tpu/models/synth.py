@@ -216,6 +216,11 @@ def next_tick(
         estimate_valid=np.ones((R, S), bool),
         nacks=np.zeros((R, S), np.float32),
         pub_rtt_ms=np.full((R, T), 50.0, np.float32),
+        fb_delay_ms=np.zeros((R, S), np.float32),
+        fb_recv_bps=np.zeros((R, S), np.float32),
+        fb_valid=np.zeros((R, S), bool),
+        fb_enabled=np.zeros((R, S), bool),
+        sub_reset=np.zeros((R, S), bool),
         pad_num=np.zeros((R, S), np.int32),
         pad_track=np.full((R, S), -1, np.int32),
         tick_ms=np.int32(spec.tick_ms),

@@ -151,3 +151,112 @@ def _scatter_ring(ring: jax.Array, pos: jax.Array, value: jax.Array) -> jax.Arra
     """ring[..., pos] = value without dynamic slicing (one-hot mask)."""
     oh = jax.nn.one_hot(pos, ring.shape[-1], dtype=ring.dtype)
     return ring * (1.0 - oh) + oh * value[..., None]
+
+
+# ---------------------------------------------------------------------------
+# Send-side delay-based estimation (TWCC seat).
+#
+# Reference parity: the reference wires pion's cc.BandwidthEstimator (GCC)
+# fed by transport-wide-cc feedback (pkg/rtc/transport.go:253-374) into the
+# StreamAllocator (streamallocator.go:304-391 OnREMB/onTargetBitrateChange).
+# Here the transport-wide sequence number is the sealed-frame counter the
+# egress already stamps on every datagram (runtime/crypto.py layout); the
+# host matches client feedback (runtime/udp.py TWCC frames) against its
+# send-time ring and reduces each tick's feedback to THREE per-subscriber
+# samples: mean delay-variation, acked receive rate, and validity. The
+# estimator itself — an EMA'd queuing-delay gradient driving an AIMD rate,
+# GCC's shape without the Kalman filter — then updates every subscriber in
+# one elementwise pass per tick.
+#
+# Trust model (the reason this exists): allocation must not depend on
+# client-volunteered REMB estimates. A client that sends no feedback at all
+# while sealed sends are outstanding decays toward the floor (safe), and a
+# client that acks honestly converges the budget to the real channel rate
+# with no estimate samples ever sent.
+# ---------------------------------------------------------------------------
+
+
+class DelayBWEParams(NamedTuple):
+    overuse_ms: float = 1.5        # EMA'd delay-variation above ⇒ overuse
+    underuse_ms: float = -1.5      # below ⇒ draining; hold rate
+    ema_alpha: float = 0.3
+    beta: float = 0.85             # overuse: rate = beta × acked receive rate
+    increase_per_s: float = 0.08   # multiplicative increase while clear
+    min_rate_bps: float = 64_000.0
+    max_rate_bps: float = 50e6
+    fb_timeout_ticks: int = 50     # outstanding sends, no feedback ⇒ decay
+    starve_decay: float = 0.97     # per-tick rate factor once starved
+
+
+class DelayBWEState(NamedTuple):
+    """Per-subscriber delay-estimator state; fields [..., S]."""
+
+    slope_ema: jax.Array     # float32 — EMA of mean delay-variation (ms)
+    rate_bps: jax.Array      # float32 — delay-based target rate
+    ticks_no_fb: jax.Array   # int32 — ticks with sends but no feedback
+    ever_fb: jax.Array       # bool — any feedback seen (activates the cap)
+
+
+def delay_init_state(num_subscribers: int, initial_rate: float = 7_000_000.0) -> DelayBWEState:
+    s = (num_subscribers,)
+    return DelayBWEState(
+        slope_ema=jnp.zeros(s, jnp.float32),
+        rate_bps=jnp.full(s, initial_rate, jnp.float32),
+        ticks_no_fb=jnp.zeros(s, jnp.int32),
+        ever_fb=jnp.zeros(s, jnp.bool_),
+    )
+
+
+def delay_update_tick(
+    state: DelayBWEState,
+    params: DelayBWEParams,
+    fb_delay_ms: jax.Array,   # [S] float32 — mean delay-variation this tick
+    fb_recv_bps: jax.Array,   # [S] float32 — acked receive rate sample
+    fb_valid: jax.Array,      # [S] bool — feedback arrived this tick
+    fb_enabled: jax.Array,    # [S] bool — sub rides the sealed UDP path
+    pkts_sent: jax.Array,     # [S] float32 — sends this tick
+    tick_ms: jax.Array,       # scalar int32
+):
+    """Returns (new_state, rate_bps [S], overuse [S] bool, active [S] bool).
+
+    `active` marks subscribers whose budget the delay rate should cap
+    (sealed-path subscribers that have ever acked). WS-only subscribers
+    never activate and keep the estimate-driven budget path.
+    """
+    ema = jnp.where(
+        fb_valid,
+        (1.0 - params.ema_alpha) * state.slope_ema + params.ema_alpha * fb_delay_ms,
+        state.slope_ema,
+    )
+    overuse = ema > params.overuse_ms
+    underuse = ema < params.underuse_ms
+    tick_s = jnp.maximum(tick_ms.astype(jnp.float32), 1.0) / 1000.0
+    rate_up = state.rate_bps * (1.0 + params.increase_per_s * tick_s)
+    rate_down = params.beta * jnp.maximum(fb_recv_bps, params.min_rate_bps)
+    rate = jnp.where(
+        fb_valid,
+        jnp.where(
+            overuse,
+            jnp.minimum(state.rate_bps, rate_down),
+            jnp.where(underuse, state.rate_bps, rate_up),
+        ),
+        state.rate_bps,
+    )
+    # Silent-client guard: sealed sends outstanding but nothing acked.
+    ticks_no_fb = jnp.where(
+        fb_valid | ~fb_enabled,
+        0,
+        state.ticks_no_fb + (pkts_sent > 0).astype(jnp.int32),
+    )
+    starved = ticks_no_fb > params.fb_timeout_ticks
+    rate = jnp.where(starved, rate * params.starve_decay, rate)
+    rate = jnp.clip(rate, params.min_rate_bps, params.max_rate_bps)
+    ever_fb = state.ever_fb | (fb_valid & fb_enabled)
+    active = fb_enabled & (ever_fb | starved)
+    new_state = DelayBWEState(
+        slope_ema=ema,
+        rate_bps=rate,
+        ticks_no_fb=ticks_no_fb,
+        ever_fb=ever_fb,
+    )
+    return new_state, rate, overuse & fb_enabled, active

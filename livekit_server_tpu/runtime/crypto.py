@@ -53,6 +53,15 @@ def parse_key_id(frame: bytes) -> int | None:
     return int.from_bytes(frame[1:5], "big")
 
 
+def parse_counter(frame: bytes) -> int | None:
+    """Sealed frame → its 64-bit counter (the plaintext header field).
+    Clients use it as the transport-wide sequence number when building
+    TWCC feedback (runtime/udp.py build_twcc_feedback)."""
+    if len(frame) < HEADER_LEN + 16 or frame[0] != MAGIC:
+        return None
+    return int.from_bytes(frame[6:14], "big")
+
+
 class _Replay:
     """Sliding-window anti-replay (RFC 4303 §3.4.3 bitmap)."""
 

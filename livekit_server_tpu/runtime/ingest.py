@@ -146,6 +146,19 @@ class IngestBuffer:
         # lets the tick score each track's MOS with its publisher-path RTT
         # (scorer.go includes RTT in the E-model delay term).
         self.track_pub_sub = np.full((R, T), -1, np.int32)
+        # TWCC feedback accumulators (runtime/udp.py push_twcc_feedback →
+        # ops/bwe delay estimator): per-(room, sub) sums reduced to one
+        # sample per tick at drain.
+        self._fb_delay_sum = np.zeros((R, S), np.float64)
+        self._fb_count = np.zeros((R, S), np.int64)
+        self._fb_bytes = np.zeros((R, S), np.int64)
+        self._fb_span_ms = np.zeros((R, S), np.float64)
+        self.fb_enabled = np.zeros((R, S), bool)  # sealed-UDP-path subs
+        # One-tick reset mask: a released subscriber slot's device-side
+        # per-sub state (BWE/delay/pacer) must not leak to the next
+        # occupant (e.g. a decayed floor rate + sticky ever_fb latch
+        # would cap a fresh subscriber for up to a minute).
+        self.sub_reset = np.zeros((R, S), bool)
         self.nack_overflow = 0   # NACK counts clipped by NACK_COUNT_CAP
         self._nack_seen: set = set()           # per-tick (r, s, sn, track)
         self._nack_tick_cnt = np.zeros((R, S), np.int32)
@@ -308,6 +321,17 @@ class IngestBuffer:
         )
         return int(keep.sum())
 
+    def push_twcc_feedback(
+        self, room: int, sub: int, delay_sum_ms: float, n_deltas: int,
+        acked_bytes: int, span_ms: float,
+    ) -> None:
+        """Accumulate one TWCC feedback frame's reductions (udp.py parses
+        the frame and matches its acks against the send-time ring)."""
+        self._fb_delay_sum[room, sub] += delay_sum_ms
+        self._fb_count[room, sub] += max(n_deltas, 0)
+        self._fb_bytes[room, sub] += acked_bytes
+        self._fb_span_ms[room, sub] += span_ms
+
     def push_feedback(
         self, room: int, sub: int, estimate: float | None = None, nacks: int = 0
     ) -> None:
@@ -427,6 +451,19 @@ class IngestBuffer:
                 ),
                 0,
             ).astype(np.float32),
+            fb_delay_ms=np.where(
+                self._fb_count > 0,
+                self._fb_delay_sum / np.maximum(self._fb_count, 1),
+                0.0,
+            ).astype(np.float32),
+            fb_recv_bps=np.where(
+                self._fb_span_ms > 0,
+                self._fb_bytes * 8000.0 / np.maximum(self._fb_span_ms, 1e-3),
+                0.0,
+            ).astype(np.float32),
+            fb_valid=self._fb_count > 0,
+            fb_enabled=self.fb_enabled.copy(),
+            sub_reset=self.sub_reset.copy(),
             pad_num=np.asarray(pad_num, np.int32),
             pad_track=np.asarray(pad_track, np.int32),
             tick_ms=np.int32(self.tick_ms),
@@ -453,6 +490,11 @@ class IngestBuffer:
         self.audio_level[:] = 127
         self._estimate_valid[:] = False
         self._nacks[:] = 0.0
+        self._fb_delay_sum[:] = 0.0
+        self._fb_count[:] = 0
+        self._fb_bytes[:] = 0
+        self._fb_span_ms[:] = 0.0
+        self.sub_reset[:] = False
         self._nack_seen.clear()
         self._nack_tick_cnt[:] = 0
         return inp, payloads

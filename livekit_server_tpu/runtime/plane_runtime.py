@@ -357,6 +357,8 @@ class PlaneRuntime:
         self.meta.pub_muted[room, :] = False
         self.ctrl.subscribed[room, :, :] = False
         self.ingest.track_pub_sub[room, :] = -1
+        self.ingest.fb_enabled[room, :] = False
+        self.ingest.sub_reset[room, :] = True  # next tenant: fresh BWE state
         # Stale replay-ring entries must not survive row reuse: a new
         # room's NACK aliasing an old slot would retransmit the PREVIOUS
         # room's media bytes (cross-room leak).

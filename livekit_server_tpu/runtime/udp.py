@@ -65,7 +65,11 @@ _NO_LATCH = -(1 << 62)
 # RTCP payload types (rtcp-mux demux range per RFC 5761: byte1 in 192-223).
 RTCP_SR = 200
 RTCP_RR = 201
-RTCP_RTPFB = 205   # FMT 1 = generic NACK
+RTCP_RTPFB = 205   # FMT 1 = generic NACK, FMT 15 = transport-wide feedback
+TWCC_FMT = 15
+# Send-time ring depth per (room, sub): must cover the feedback RTT's worth
+# of outstanding sealed sends (~300 pps × 200 ms ≈ 60; power of two).
+TWCC_RING = 256
 RTCP_PSFB = 206    # FMT 1 = PLI, FMT 15 = REMB (application layer feedback)
 PLI_THROTTLE_MS = 500.0  # min spacing of upstream keyframe requests per
                          # track (pliThrottle — sfu/buffer config default)
@@ -116,6 +120,45 @@ def build_nack(sender_ssrc: int, media_ssrc: int, sns) -> bytes:
         + media_ssrc.to_bytes(4, "big")
         + bytes(fci)
     )
+
+
+def build_twcc_feedback(
+    sender_ssrc: int, media_ssrc: int, entries: list[tuple[int, int]]
+) -> bytes:
+    """Transport-wide feedback (RTPFB fmt 15 seat, own-wire FCI): the
+    client acks sealed-frame counters with its receive timestamps.
+
+        FCI = base_ctr(8) | base_recv_us(8) | n(2) | pad(2)
+              | n × (ctr_off u16 | recv_delta_us i32)
+
+    `entries` = [(counter, recv_time_us), ...]; counters within a frame
+    must span < 65536 and deltas < ±2147 s (split frames otherwise)."""
+    if not entries:
+        return b""
+    base_ctr = min(c for c, _ in entries)
+    base_us = min(u for _, u in entries)
+    fci = bytearray(
+        base_ctr.to_bytes(8, "big")
+        + base_us.to_bytes(8, "big")
+        + len(entries).to_bytes(2, "big")
+        + b"\x00\x00"
+    )
+    for c, u in entries:
+        fci += (c - base_ctr).to_bytes(2, "big")
+        fci += (u - base_us).to_bytes(4, "big", signed=True)
+    if len(fci) % 4:
+        fci += bytes(4 - len(fci) % 4)
+    length_words = 2 + len(fci) // 4
+    return (
+        bytes([0x80 | TWCC_FMT, RTCP_RTPFB])
+        + length_words.to_bytes(2, "big")
+        + sender_ssrc.to_bytes(4, "big")
+        + media_ssrc.to_bytes(4, "big")
+        + bytes(fci)
+    )
+
+
+_TWCC_ENTRY = np.dtype([("off", ">u2"), ("delta", ">i4")])
 
 
 def parse_nack_fci(fci: bytes) -> list[int]:
@@ -353,6 +396,21 @@ class UDPMediaTransport(asyncio.DatagramProtocol):
         self._txsr_oct = np.zeros((R, S, T), np.int64)
         self._txsr_ts = np.zeros((R, S, T), np.uint32)
         self._txsr_ms = np.zeros((R, S, T), np.float64)
+        # TWCC send-time rings (pkg/rtc/transport.go:253-374 seat): the
+        # sealed-frame counter IS the transport-wide sequence number; the
+        # client acks (counter, recv_time) pairs and the host matches them
+        # here to produce the delay/rate samples ops/bwe's send-side
+        # estimator consumes. Sealed-path flows only — cleartext frames
+        # carry no counter (those subs keep the estimate-driven budget).
+        self._twcc_ms = np.zeros((R, S, TWCC_RING), np.float64)
+        self._twcc_ctr = np.full((R, S, TWCC_RING), -1, np.int64)
+        self._twcc_len = np.zeros((R, S, TWCC_RING), np.int32)
+        # Last acked (ctr, send, recv) per sub: delay deltas must span
+        # feedback-frame boundaries or one-ack-per-frame cadences would
+        # never produce a delay-variation sample at all.
+        self._twcc_last_ctr = np.full((R, S), -1, np.int64)
+        self._twcc_last_send = np.zeros((R, S), np.float64)
+        self._twcc_last_recv = np.zeros((R, S), np.float64)
         self.egress_threads = 4
         # RED (RFC 2198) opt-in per subscriber + per-(room, audio track)
         # ring of recent primary payloads (the byte half of the device's
@@ -433,6 +491,20 @@ class UDPMediaTransport(asyncio.DatagramProtocol):
         session.room = room
         session.sub = sub
         self._touch_subs()
+        self._refresh_fb_enabled(room, sub)
+
+    def _refresh_fb_enabled(self, room: int, sub: int) -> None:
+        """TWCC applies to subs whose egress is actually sealed over UDP
+        (counters on the wire): session bound + UDP address + sealing
+        active (require_encryption, or the client spoke sealed first)."""
+        addr = self.sub_addrs.get((room, sub))
+        sess = self.sub_sessions.get((room, sub))
+        self.ingest.fb_enabled[room, sub] = (
+            addr is not None
+            and not (isinstance(addr, tuple) and addr and addr[0] == "tcp")
+            and sess is not None
+            and (self.require_encryption or sess.client_active)
+        )
 
     def _sendto(self, data: bytes, addr, session=None) -> None:
         """Single egress chokepoint: seal under the session, then route to
@@ -504,6 +576,7 @@ class UDPMediaTransport(asyncio.DatagramProtocol):
         it hands out a punch id instead (assign_subscriber_punch)."""
         self.sub_addrs[(room, sub)] = addr
         self._touch_subs()
+        self._refresh_fb_enabled(room, sub)
 
     def assign_subscriber_punch(self, room: int, sub: int, rotate: bool = False) -> int:
         """Mint an unguessable punch id for a subscriber. The client proves
@@ -549,6 +622,10 @@ class UDPMediaTransport(asyncio.DatagramProtocol):
         self._txsr_oct[room, sub, :] = 0
         self.sub_red.discard((room, sub))
         self._touch_subs()
+        self.ingest.fb_enabled[room, sub] = False
+        self.ingest.sub_reset[room, sub] = True  # device per-sub state reset
+        self._twcc_ctr[room, sub, :] = -1
+        self._twcc_last_ctr[room, sub] = -1
         pid = self._punch_by_sub.pop((room, sub), None)
         if pid is not None:
             self.punch_ids.pop(pid, None)
@@ -616,6 +693,11 @@ class UDPMediaTransport(asyncio.DatagramProtocol):
             and self._sessions[j] is session
         ):
             self._sess_active[j] = 1
+        # Sealing just latched for this client: if it's a subscriber, its
+        # egress now carries counters — TWCC becomes applicable.
+        room, sub = getattr(session, "room", -1), getattr(session, "sub", -1)
+        if room >= 0 and sub >= 0:
+            self._refresh_fb_enabled(room, sub)
 
     def _prune_addr_caches(self) -> None:
         """Bound the addr↔code mirrors under a spoofed-source flood while
@@ -821,6 +903,67 @@ class UDPMediaTransport(asyncio.DatagramProtocol):
             self._rx_scheduled = True
             asyncio.get_running_loop().call_soon(self._flush_rx)
 
+    def _handle_twcc(self, room: int, sub: int, fci: bytes) -> None:
+        """Match one transport-wide feedback frame against the send-time
+        ring and accumulate this tick's delay/rate reductions (the host
+        half of the ops/bwe send-side estimator). All array math; acked
+        slots are invalidated so replayed/duplicate feedback is inert."""
+        if len(fci) < 20:
+            return
+        base_ctr = int.from_bytes(fci[0:8], "big")
+        base_us = int.from_bytes(fci[8:16], "big")
+        n = int.from_bytes(fci[16:18], "big")
+        body = fci[20 : 20 + 6 * n]
+        if n == 0 or len(body) < 6 * n:
+            return
+        ent = np.frombuffer(body, _TWCC_ENTRY)
+        ctrs = base_ctr + ent["off"].astype(np.int64)
+        recv_us = base_us + ent["delta"].astype(np.int64)
+        # Dedup within the frame: repeated entries would otherwise all
+        # match before the slot is invalidated, inflating acked_bytes and
+        # diluting the delay mean — exactly the client manipulation this
+        # measurement path exists to resist.
+        ctrs, first = np.unique(ctrs, return_index=True)
+        recv_us = recv_us[first]
+        slots = (ctrs & (TWCC_RING - 1)).astype(np.int64)
+        ok = self._twcc_ctr[room, sub, slots] == ctrs
+        self.stats["twcc_rx"] = self.stats.get("twcc_rx", 0) + int(n)
+        if not ok.any():
+            return
+        ctrs, recv_us, slots = ctrs[ok], recv_us[ok], slots[ok]
+        order = np.argsort(ctrs)
+        ctrs, recv_us, slots = ctrs[order], recv_us[order], slots[order]
+        send_ms = self._twcc_ms[room, sub, slots]
+        acked_bytes = int(self._twcc_len[room, sub, slots].sum())
+        self._twcc_ctr[room, sub, slots] = -1  # spend the acks
+        recv_ms = recv_us.astype(np.float64) / 1000.0
+        # Chain in the previous frame's last ack: deltas must span frame
+        # boundaries, or a one-ack-per-frame cadence never yields a
+        # delay-variation sample.
+        last_c = int(self._twcc_last_ctr[room, sub])
+        if 0 <= last_c < int(ctrs[0]):
+            send_ms = np.r_[self._twcc_last_send[room, sub], send_ms]
+            recv_ms = np.r_[self._twcc_last_recv[room, sub], recv_ms]
+        self._twcc_last_ctr[room, sub] = int(ctrs[-1])
+        self._twcc_last_send[room, sub] = send_ms[-1]
+        self._twcc_last_recv[room, sub] = recv_ms[-1]
+        # Delay-variation samples: how much more the recv gap grew than the
+        # send gap (positive ⇒ queue building).
+        if len(recv_ms) >= 2:
+            dd = np.diff(recv_ms) - np.diff(send_ms)
+            delay_sum, n_d = float(dd.sum()), len(dd)
+            # Measured span, floored only against degenerate timestamps;
+            # flooring to a full tick here would under-report the receive
+            # rate of clients that ack in several sub-tick frames.
+            span = max(float(recv_ms[-1] - recv_ms[0]), 0.1)
+        else:
+            # Single-ack frame: no span — bill one tick's worth.
+            delay_sum, n_d = 0.0, 1
+            span = float(self.ingest.tick_ms)
+        self.ingest.push_twcc_feedback(
+            room, sub, delay_sum, n_d, acked_bytes, span
+        )
+
     def _handle_rtcp(self, data: bytes, addr) -> None:
         """Compound RTCP walk: NACK → sequencer lookup, PLI → keyframe
         request, REMB → BWE estimate sample, RR → loss/RTT bookkeeping
@@ -860,6 +1003,15 @@ class UDPMediaTransport(asyncio.DatagramProtocol):
                     replays = self.nack_resolver(room, sub, track, sns)
                     if replays:
                         self.send_egress(replays, rtx=True)
+            elif pt == RTCP_RTPFB and fmt == TWCC_FMT:
+                dest = self.egress_rev.get(media_ssrc)
+                if dest is None:
+                    continue
+                room, sub, _track = dest
+                if self.sub_addrs.get((room, sub)) != addr:
+                    self.stats["addr_mismatch"] += 1
+                    continue
+                self._handle_twcc(room, sub, chunk[12:])
             elif pt == RTCP_PSFB and fmt == 1:
                 dest = self.egress_rev.get(media_ssrc)
                 if dest is None:
@@ -1047,6 +1199,7 @@ class UDPMediaTransport(asyncio.DatagramProtocol):
         entry[1] = addr
         self.sub_addrs[key] = addr
         self._touch_subs()
+        self._refresh_fb_enabled(*key)
         self._sendto(PUNCH_ACK + data[8:12], addr, session)
 
     def _flush_rx(self) -> None:
@@ -1556,6 +1709,16 @@ class UDPMediaTransport(asyncio.DatagramProtocol):
                 ranks = np.empty(len(es), np.int64)
                 ranks[order] = np.arange(len(es)) - np.repeat(grp_start, sizes)
                 ctr[sealed_pos] = base[es] + ranks.astype(np.uint64)
+                # TWCC send-time ring: every sealed datagram's counter is
+                # its transport-wide sequence number — record send time +
+                # wire size for the feedback matcher (_handle_twcc).
+                sp_r, sp_s = rr_[sealed_pos], ss_[sealed_pos]
+                sp_slot = (ctr[sealed_pos] & np.uint64(TWCC_RING - 1)).astype(np.int64)
+                self._twcc_ms[sp_r, sp_s, sp_slot] = now_ms
+                self._twcc_ctr[sp_r, sp_s, sp_slot] = ctr[sealed_pos].astype(np.int64)
+                self._twcc_len[sp_r, sp_s, sp_slot] = (
+                    pl[idx][sealed_pos] + WIRE_OVERHEAD_BYTES
+                )
             keys = self._sess_keys if n_sess else np.zeros((1, 16), np.uint8)
             key_ids = self._sess_keyids if n_sess else np.zeros(1, np.uint32)
             ext_blob, ext_off, ext_len = b"", None, None

@@ -68,3 +68,75 @@ def test_batched_independent_subscribers():
             jnp.array([100.0, 100.0]), jnp.array([0.0, 0.0]),
         )
     assert bool(congested[0]) and not bool(congested[1])
+
+
+def test_delay_bwe_converges_to_channel_rate():
+    """GCC-lite send-side estimator (TWCC seat): simulate a channel of
+    capacity C — when the rate exceeds C the queue (delay-variation) grows,
+    below C it drains. The estimator must converge near C with NO client
+    estimate samples involved."""
+    P = bwe.DelayBWEParams()
+    C = 2_000_000.0
+    st = bwe.delay_init_state(1, initial_rate=300_000.0)
+    tick = jnp.int32(20)
+    queue_ms = 0.0
+    rate_hist = []
+    # Multiplicative increase is 8 %/s (GCC's ramp): 300 kbps → 2 Mbps
+    # needs ~24 s of simulated time at a 20 ms tick.
+    for i in range(1600):
+        rate = float(st.rate_bps[0])
+        # Channel model: above capacity the queue builds (positive delay
+        # variation); below it the queue drains only while non-empty
+        # (negative variation), then variation is zero.
+        change = (rate - C) / C * 20.0
+        if change < 0:
+            change = -min(queue_ms, -change)
+        queue_ms = max(0.0, queue_ms + change)
+        delay_var = change
+        st, r, over, active = bwe.delay_update_tick(
+            st, P,
+            jnp.array([delay_var], jnp.float32),
+            jnp.array([min(rate, C)], jnp.float32),   # acked recv rate
+            jnp.array([True]),
+            jnp.array([True]),
+            jnp.array([100.0], jnp.float32),
+            tick,
+        )
+        rate_hist.append(float(r[0]))
+    tail = rate_hist[-100:]
+    assert all(active), "feedback-active sub must activate the cap"
+    assert 0.6 * C < sum(tail) / len(tail) < 1.3 * C, sum(tail) / len(tail)
+
+
+def test_delay_bwe_silent_client_decays_lying_client_capped():
+    """A sealed-path client that never acks (silent) decays toward the
+    floor instead of keeping an optimistic budget; a client whose acks
+    reveal a slow channel is capped by measurement even if it volunteers
+    a huge REMB estimate (the cap is min(estimate, delay rate))."""
+    P = bwe.DelayBWEParams()
+    st = bwe.delay_init_state(1, initial_rate=5_000_000.0)
+    tick = jnp.int32(20)
+    # Silent: sends outstanding, no feedback ever.
+    for _ in range(P.fb_timeout_ticks + 200):
+        st, rate, over, active = bwe.delay_update_tick(
+            st, P,
+            jnp.zeros(1, jnp.float32), jnp.zeros(1, jnp.float32),
+            jnp.array([False]), jnp.array([True]),
+            jnp.array([50.0], jnp.float32), tick,
+        )
+    assert bool(active[0])
+    assert float(rate[0]) < 1_000_000.0  # decayed well below initial
+
+    # Lying-but-acking: the channel is 500 kbps; overuse shows in the acks.
+    st2 = bwe.delay_init_state(1, initial_rate=5_000_000.0)
+    for _ in range(200):
+        rate = float(st2.rate_bps[0])
+        delay_var = 5.0 if rate > 500_000.0 else -2.0
+        st2, r2, _, act2 = bwe.delay_update_tick(
+            st2, P,
+            jnp.array([delay_var], jnp.float32),
+            jnp.array([500_000.0], jnp.float32),
+            jnp.array([True]), jnp.array([True]),
+            jnp.array([100.0], jnp.float32), tick,
+        )
+    assert float(r2[0]) < 700_000.0  # converged near the real channel

@@ -51,6 +51,11 @@ def make_inputs(dims: plane.PlaneDims, **over):
         estimate_valid=jnp.zeros((R, S), jnp.bool_),
         nacks=jnp.zeros((R, S), jnp.float32),
         pub_rtt_ms=jnp.zeros((R, T), jnp.float32),
+        fb_delay_ms=jnp.zeros((R, S), jnp.float32),
+        fb_recv_bps=jnp.zeros((R, S), jnp.float32),
+        fb_valid=jnp.zeros((R, S), jnp.bool_),
+        fb_enabled=jnp.zeros((R, S), jnp.bool_),
+        sub_reset=jnp.zeros((R, S), jnp.bool_),
         pad_num=jnp.zeros((R, S), jnp.int32),
         pad_track=jnp.full((R, S), -1, jnp.int32),
         tick_ms=jnp.int32(20),
@@ -415,3 +420,26 @@ def test_multi_room_vmap_isolation():
     st, out = step(st, inp)
     assert int(out.fwd_packets[0]) == 1
     assert int(out.fwd_packets[1]) == 0
+
+
+def test_sub_reset_clears_per_sub_bwe_state():
+    """A released subscriber slot must hand its successor FRESH per-sub
+    state: a decayed delay-BWE floor rate (silent previous occupant) would
+    otherwise cap the new subscriber's budget for up to a minute."""
+    dims, st = two_party_audio_state()
+    step = jax.jit(plane.media_plane_tick)
+    # Starve sub 0: sealed path enabled, sends outstanding, never acks.
+    inp = make_inputs(
+        dims,
+        valid=jnp.ones((1, 2, 1), jnp.bool_),
+        size=jnp.full((1, 2, 1), 120, jnp.int32),
+        fb_enabled=jnp.asarray([[True, False]]),
+    )
+    for _ in range(120):
+        st, out = step(st, inp)
+    decayed = float(st.delay_bwe.rate_bps[0, 0])
+    assert decayed < 2_000_000.0  # well below the 7 Mbps initial
+    # Slot released & reused: one tick with sub_reset set.
+    st, out = step(st, inp._replace(sub_reset=jnp.asarray([[True, False]])))
+    assert float(st.delay_bwe.rate_bps[0, 0]) > 6_000_000.0
+    assert not bool(st.delay_bwe.ever_fb[0, 0])

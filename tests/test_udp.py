@@ -960,3 +960,82 @@ async def test_leaky_bucket_pacer_defers_and_drains_fifo():
     finally:
         transport.transport.close()
         await runtime.stop()
+
+
+async def test_twcc_feedback_caps_allocation_budget():
+    """TWCC end-to-end (transport.go:253-374 seat): sealed egress counters
+    → client feedback frames → host delay/rate reductions → device
+    send-side estimator caps the allocator budget. The client volunteers
+    NO estimate samples — a congested channel is detected purely from the
+    sender's own measurements."""
+    from livekit_server_tpu.runtime.crypto import (
+        MediaCryptoClient,
+        MediaCryptoRegistry,
+        parse_counter,
+    )
+    from livekit_server_tpu.runtime.udp import (
+        UDPMediaTransport,
+        build_twcc_feedback,
+    )
+    from livekit_server_tpu.runtime.ingest import PacketIn
+    from tests.conftest import free_port
+
+    runtime = PlaneRuntime(DIMS, tick_ms=10)
+    reg = MediaCryptoRegistry()
+    port = free_port(socket.SOCK_DGRAM)
+    loop = asyncio.get_running_loop()
+    tr, transport = await loop.create_datagram_endpoint(
+        lambda: UDPMediaTransport(runtime.ingest, crypto=reg, require_encryption=True),
+        local_addr=("127.0.0.1", port),
+    )
+    try:
+        runtime.set_track(0, 0, published=True, is_video=False)
+        runtime.set_subscription(0, 0, 1, subscribed=True)
+        sub_sess = reg.mint()
+        transport.bind_sub_session(0, 1, sub_sess)
+        bob = MediaCryptoClient(sub_sess.key_id, sub_sess.key)
+        sub = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
+        sub.bind(("127.0.0.1", 0))
+        sub.setblocking(False)
+        transport.register_subscriber(0, 1, sub.getsockname())
+        assert bool(runtime.ingest.fb_enabled[0, 1])  # sealed UDP path
+        media_ssrc = transport.subscriber_ssrc(0, 1, 0)
+
+        recv_us = 0
+        for i in range(30):
+            runtime.ingest.push(PacketIn(
+                room=0, track=0, sn=100 + i, ts=960 * i, size=120,
+                payload=b"y" * 120,
+            ))
+            res = await runtime.step_once()
+            transport.send_egress_batch(res.egress_batch)
+            await asyncio.sleep(0.01)
+            ctrs = []
+            while True:
+                try:
+                    f = sub.recvfrom(4096)[0]
+                except BlockingIOError:
+                    break
+                c = parse_counter(f)
+                if c is not None and bob.open(f) is not None:
+                    ctrs.append(c)
+            if ctrs:
+                # Honest but congested receiver: every frame arrives 25 ms
+                # later than the last while the sender paces at 10 ms —
+                # delay variation +15 ms per packet, sustained.
+                entries = []
+                for c in sorted(ctrs):
+                    recv_us += 25_000
+                    entries.append((c, recv_us))
+                fb = build_twcc_feedback(0xB0B, media_ssrc, entries)
+                sub.sendto(bob.seal(fb), ("127.0.0.1", port))
+                await asyncio.sleep(0.005)
+        assert transport.stats.get("twcc_rx", 0) > 0
+        committed = float(runtime._last_committed[0, 1])
+        # Default (no estimate, no feedback) budget is the 7 Mbps initial;
+        # measured congestion must have collapsed it.
+        assert committed < 1_000_000.0, committed
+        sub.close()
+    finally:
+        tr.close()
+        await runtime.stop()
