@@ -37,6 +37,7 @@ PARSED_DTYPE = np.dtype(
         ("tid", np.uint8), ("layer_sync", np.uint8),
         ("picture_id", np.int32), ("tl0picidx", np.int32), ("keyidx", np.int32),
         ("dd_off", np.int32), ("dd_len", np.int32),
+        ("end_frame", np.uint8), ("sid", np.int8),
     ],
     align=True,
 )
@@ -64,6 +65,7 @@ class _NativeRTP:
         self.lib.parse_rtp_batch.argtypes = [
             ctypes.c_void_p, ctypes.c_void_p, ctypes.c_void_p, ctypes.c_int,
             ctypes.c_int, ctypes.c_void_p, ctypes.c_void_p, ctypes.c_int,
+            ctypes.c_void_p, ctypes.c_void_p,
         ]
         self.lib.rewrite_rtp_batch.restype = None
         self.lib.rewrite_rtp_batch.argtypes = [
@@ -102,13 +104,23 @@ class _NativeRTP:
         audio_level_ext: int = 1,
         vp8_pts: set[int] | None = None,
         dd_ext_id: int = 0,
+        vp9_pts: set[int] | None = None,
+        h264_pts: set[int] | None = None,
     ) -> np.ndarray:
         n = len(offsets)
         out = np.zeros(n, PARSED_DTYPE)
         out["dd_off"] = -1
-        mask = np.zeros(16, np.uint8)
-        for pt in vp8_pts or ():
-            mask[pt >> 3] |= 1 << (pt & 7)
+        out["sid"] = -1
+
+        def pt_mask(pts):
+            m = np.zeros(16, np.uint8)
+            for pt in pts or ():
+                m[pt >> 3] |= 1 << (pt & 7)
+            return m
+
+        mask = pt_mask(vp8_pts)
+        mask9 = pt_mask(vp9_pts)
+        mask264 = pt_mask(h264_pts)
         # A contiguous uint8 ndarray passes zero-copy; anything else pays
         # one copy (the hot rx path always hands the former).
         if (
@@ -124,6 +136,7 @@ class _NativeRTP:
         self.lib.parse_rtp_batch(
             b.ctypes.data, offs.ctypes.data, lens.ctypes.data, n,
             audio_level_ext, mask.ctypes.data, out.ctypes.data, dd_ext_id,
+            mask9.ctypes.data, mask264.ctypes.data,
         )
         return out
 
@@ -165,9 +178,11 @@ class _PythonRTP:
     native = False
 
     def parse_batch(self, buf, offsets, lengths, audio_level_ext=1, vp8_pts=None,
-                    dd_ext_id=0):
+                    dd_ext_id=0, vp9_pts=None, h264_pts=None):
         buf = bytes(buf)
         vp8_pts = vp8_pts or set()
+        vp9_pts = vp9_pts or set()
+        h264_pts = h264_pts or set()
         out = np.zeros(len(offsets), PARSED_DTYPE)
         for i, (off, ln) in enumerate(zip(offsets, lengths)):
             o = out[i]
@@ -175,6 +190,7 @@ class _PythonRTP:
             o["picture_id"] = o["tl0picidx"] = o["keyidx"] = -1
             o["payload_len"] = -1
             o["dd_off"] = -1
+            o["sid"] = -1
             p = buf[off : off + ln]
             if len(p) < 12 or p[0] >> 6 != 2:
                 continue
@@ -238,6 +254,69 @@ class _PythonRTP:
                 continue
             o["payload_off"] = q
             o["payload_len"] = plen
+            o["end_frame"] = o["marker"]
+            if int(o["pt"]) in vp9_pts and plen >= 1:
+                d = p[q : q + plen]
+                j = 0
+                b0 = d[j]; j += 1
+                I, P, L, F = b0 & 0x80, b0 & 0x40, b0 & 0x20, b0 & 0x10
+                B, E = b0 & 0x08, b0 & 0x04
+                o["begin_pic"] = 1 if B else 0
+                o["end_frame"] = 1 if E else 0
+                if I:
+                    if j >= plen:
+                        continue
+                    pb = d[j]; j += 1
+                    if pb & 0x80:
+                        if j >= plen:
+                            continue
+                        o["picture_id"] = ((pb & 0x7F) << 8) | d[j]; j += 1
+                    else:
+                        o["picture_id"] = pb & 0x7F
+                have_layer = False
+                if L:
+                    if j >= plen:
+                        continue
+                    lb = d[j]; j += 1
+                    o["tid"] = lb >> 5
+                    o["layer_sync"] = (lb >> 4) & 1
+                    o["sid"] = (lb >> 1) & 0x07
+                    have_layer = True
+                    if not F:
+                        if j >= plen:
+                            continue
+                        o["tl0picidx"] = d[j]; j += 1
+                if not P and B and (not have_layer or int(o["sid"]) == 0):
+                    o["keyframe"] = 1
+                if o["keyframe"]:
+                    o["layer_sync"] = 1
+                continue
+            if int(o["pt"]) in h264_pts and plen >= 1:
+                d = p[q : q + plen]
+                ntype = d[0] & 0x1F
+                if 1 <= ntype <= 23:
+                    o["begin_pic"] = 1
+                    if ntype in (5, 7):
+                        o["keyframe"] = 1
+                elif ntype == 24:
+                    o["begin_pic"] = 1
+                    j = 1
+                    while j + 2 <= plen:
+                        nsz = int.from_bytes(d[j : j + 2], "big")
+                        if j + 2 + nsz > plen or nsz < 1:
+                            break
+                        if d[j + 2] & 0x1F in (5, 7):
+                            o["keyframe"] = 1
+                        j += 2 + nsz
+                elif ntype in (28, 29) and plen >= 2:
+                    fu = d[1]
+                    start = fu & 0x80
+                    o["begin_pic"] = 1 if start else 0
+                    if start and (fu & 0x1F) in (5, 7):
+                        o["keyframe"] = 1
+                if o["keyframe"]:
+                    o["layer_sync"] = 1
+                continue
             if int(o["pt"]) in vp8_pts and plen >= 1:
                 d = p[q : q + plen]
                 o["is_vp8"] = 1

@@ -50,6 +50,7 @@ def handle_participant_signal(room, participant: Participant, req: SignalRequest
                     udp.assign_ssrc(
                         room.slots.row, track.track_col, track.is_video, layer=l,
                         session=participant.crypto_session, svc=is_svc,
+                        mime=track.info.mime_type,
                     )
                     for l in range(n_layers)
                 ]

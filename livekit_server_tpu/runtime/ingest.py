@@ -223,7 +223,7 @@ class IngestBuffer:
         self, room, track, layer, sn, ts, ts_aligned, temporal, keyframe,
         layer_sync, begin_pic, marker, pid, tl0, keyidx, size, frame_ms,
         audio_level, arrival_rtp, pay_start, pay_length, blob,
-        dd_start=None, dd_length=None, dd_version=None,
+        dd_start=None, dd_length=None, dd_version=None, end_frame=None,
     ) -> int:
         """Vectorized push: stage a whole receive batch with numpy group
         math instead of one Python call per packet (the batch half of the
@@ -238,19 +238,21 @@ class IngestBuffer:
             dd_length = np.zeros(n, np.int32)
         if dd_version is None:
             dd_version = np.full(n, -1, np.int32)
+        if end_frame is None:
+            end_frame = marker
         if self.frozen_rows:
             keep0 = ~np.isin(room, list(self.frozen_rows))
             if not keep0.all():
                 (room, track, layer, sn, ts, ts_aligned, temporal, keyframe,
                  layer_sync, begin_pic, marker, pid, tl0, keyidx, size,
                  frame_ms, audio_level, arrival_rtp, pay_start, pay_length,
-                 dd_start, dd_length, dd_version) = (
+                 dd_start, dd_length, dd_version, end_frame) = (
                     a[keep0] for a in (
                         room, track, layer, sn, ts, ts_aligned, temporal,
                         keyframe, layer_sync, begin_pic, marker, pid, tl0,
                         keyidx, size, frame_ms, audio_level, arrival_rtp,
                         pay_start, pay_length, dd_start, dd_length,
-                        dd_version)
+                        dd_version, end_frame)
                 )
                 n = len(room)
                 if n == 0:
@@ -279,7 +281,7 @@ class IngestBuffer:
         self.keyframe[idx] = keyframe[keep]
         self.layer_sync[idx] = layer_sync[keep]
         self.begin_pic[idx] = begin_pic[keep]
-        self.end_frame[idx] = marker[keep]
+        self.end_frame[idx] = end_frame[keep]
         self.pid[idx] = pid[keep]
         self.tl0[idx] = tl0[keep]
         self.keyidx[idx] = keyidx[keep]

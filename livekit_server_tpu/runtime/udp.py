@@ -49,7 +49,11 @@ RED_PT = 63           # RFC 2198 redundancy for Opus (redreceiver.go seat)
 AUDIO_LEVEL_EXT_ID = 1
 PLAYOUT_DELAY_EXT_ID = 6  # one-byte ext id for playout-delay (playoutdelay.go)
 DD_EXT_ID = 8             # dependency-descriptor ext id (sfu/dependencydescriptor)
-SVC_PT = 98               # single-stream SVC video (VP9/AV1) payload type
+SVC_PT = 98               # single-stream SVC VP9 (picture-header parse + DD)
+AV1_PT = 99               # single-stream SVC AV1 (DD only — an AV1 payload
+                          # must never hit the VP9 descriptor branch: its
+                          # aggregation header would misparse as frame bits)
+H264_PT = 100             # H264 (RFC 6184) — keyframes from NALU types
 
 # Subscriber address punch: a client proves it owns the address it wants
 # media sent to by sending this magic + its 32-bit punch id from that
@@ -464,21 +468,34 @@ class UDPMediaTransport(asyncio.DatagramProtocol):
     def assign_ssrc(
         self, room: int, track: int, is_video: bool, layer: int = 0,
         session: MediaCryptoSession | None = None, svc: bool = False,
+        mime: str = "",
     ) -> int:
         """Bind a fresh SSRC to one (track, simulcast layer); sent back in
         signal. Simulcast publishers get one SSRC per layer, matching the
         reference's per-layer SSRCs (mediatrack.go layer SSRC bookkeeping).
         `session` pins the SSRC to its publisher's crypto session: media
-        sealed under any other key is rejected even if the SSRC matches."""
+        sealed under any other key is rejected even if the SSRC matches.
+        `mime` picks the payload type (and thereby the ingest parser's
+        codec branch): h264 → NALU keyframe scan, vp9/av1 → SVC PT (DD
+        when present, VP9 picture headers otherwise), else VP8."""
         ssrc = self._new_ssrc()
         self.bindings[ssrc] = SSRCBinding(room, track, is_video, layer, session, svc)
         self.track_kind[(room, track)] = is_video
         if svc:
             self._svc_tracks.add((room, track))
             self._track_svc[room, track] = True
-        self._track_pt[room, track] = (
-            SVC_PT if svc else VP8_PT if is_video else OPUS_PT
-        )
+        m = (mime or "").lower()
+        if not is_video:
+            pt = OPUS_PT
+        elif "av1" in m:
+            pt = AV1_PT
+        elif svc or "vp9" in m:
+            pt = SVC_PT
+        elif "h264" in m:
+            pt = H264_PT
+        else:
+            pt = VP8_PT
+        self._track_pt[room, track] = pt
         self._track_is_video[room, track] = is_video
         return ssrc
 
@@ -1243,6 +1260,8 @@ class UDPMediaTransport(asyncio.DatagramProtocol):
             blob, offsets, lengths,
             audio_level_ext=AUDIO_LEVEL_EXT_ID, vp8_pts={VP8_PT},
             dd_ext_id=DD_EXT_ID if self._svc_tracks else 0,
+            vp9_pts={SVC_PT}, h264_pts={H264_PT},  # AV1_PT: DD-only, no
+                                                   # payload-descriptor parse
         )
 
         # RED-publishing clients (pt 63): strip to the primary block before
@@ -1411,9 +1430,19 @@ class UDPMediaTransport(asyncio.DatagramProtocol):
             temporal = parsed["tid"][idx].astype(np.int32)
             begin_pic = parsed["begin_pic"][idx].astype(bool)
             layer_sync = parsed["layer_sync"][idx].astype(bool)
+            end_frame = parsed["end_frame"][idx].astype(bool)
             dd_start = np.full(len(idx), -1, np.int64)
             dd_length = np.zeros(len(idx), np.int32)
             dd_ver = np.full(len(idx), -1, np.int32)
+            # Plain-VP9 SVC (no DD extension on the packet): the spatial
+            # layer comes from the VP9 picture header's SID
+            # (buffer.go:599-671 → vp9.go:43) — without this, DD-less VP9
+            # silently loses layer switching.
+            vp9_sid = parsed["sid"][idx].astype(np.int32)
+            use_sid = (
+                u_svc[e_inv] & (parsed["dd_off"][idx] < 0) & (vp9_sid >= 0)
+            )
+            layer = np.where(use_sid, vp9_sid, layer)
             svc_dd = np.nonzero(u_svc[e_inv] & (parsed["dd_off"][idx] >= 0))[0]
             if len(svc_dd):
                 from livekit_server_tpu.runtime import dd as dd_mod
@@ -1460,6 +1489,7 @@ class UDPMediaTransport(asyncio.DatagramProtocol):
                         layer[j] = sp
                         temporal[j] = tp
                     begin_pic[j] = desc.first_packet_in_frame
+                    end_frame[j] = desc.last_packet_in_frame
                     dd_start[j] = int(parsed["dd_off"][i])
                     dd_length[j] = int(parsed["dd_len"][i])
                     dd_ver[j] = ver
@@ -1475,6 +1505,7 @@ class UDPMediaTransport(asyncio.DatagramProtocol):
                 layer_sync=layer_sync | kf,
                 begin_pic=begin_pic,
                 marker=parsed["marker"][idx].astype(bool),
+                end_frame=end_frame,
                 pid=np.maximum(parsed["picture_id"][idx], 0),
                 tl0=np.maximum(parsed["tl0picidx"][idx], 0),
                 keyidx=np.maximum(parsed["keyidx"][idx], 0),
@@ -2062,8 +2093,12 @@ class UDPMediaTransport(asyncio.DatagramProtocol):
             is_svc = bool(self._track_svc[pkt.room, pkt.track])
             header = bytearray(12)
             header[0] = 0x80 | (0x20 if is_padding else 0)  # P bit on padding
-            header[1] = (0x80 if pkt.marker else 0) | (
-                SVC_PT if is_svc else VP8_PT if is_video else OPUS_PT
+            # The hot path stamps _track_pt; the cold path (RTX replays,
+            # TCP fallback, pacer-deferred) must match it exactly or a
+            # retransmitted H264 packet arrives under a different PT than
+            # its stream and is discarded.
+            header[1] = (0x80 if pkt.marker else 0) | int(
+                self._track_pt[pkt.room, pkt.track]
             )
             # Header extensions on this cold path too: DD for SVC packets
             # (unpatched — per-sub mask rewrite is the batch path's job)

@@ -42,6 +42,13 @@ struct ParsedPacket {
   // (runtime/dd.py) — structures arrive only on keyframes.
   int32_t dd_off;
   int32_t dd_len;
+  // Frame-end marker: RTP M bit by default; the VP9 descriptor's E bit
+  // (per-spatial-layer frame end — vp9.go's downswitch boundary) where
+  // parsed.
+  uint8_t end_frame;
+  // Plain-VP9 spatial layer id from the payload descriptor (SVC without
+  // the DD extension — buffer.go:599-671 VP9 parse path); -1 if absent.
+  int8_t sid;
 };
 
 // Parse `n` datagrams packed back-to-back in `buf`; `offsets`/`lengths`
@@ -52,7 +59,8 @@ struct ParsedPacket {
 int parse_rtp_batch(const uint8_t* buf, const int32_t* offsets,
                     const int32_t* lengths, int n, int audio_level_ext,
                     const uint8_t* vp8_pt_mask, ParsedPacket* out,
-                    int dd_ext_id) {
+                    int dd_ext_id, const uint8_t* vp9_pt_mask,
+                    const uint8_t* h264_pt_mask) {
   int ok = 0;
   for (int i = 0; i < n; i++) {
     const uint8_t* p = buf + offsets[i];
@@ -65,6 +73,7 @@ int parse_rtp_batch(const uint8_t* buf, const int32_t* offsets,
     o.keyidx = -1;
     o.payload_len = -1;
     o.dd_off = -1;
+    o.sid = -1;
     if (len < 12) continue;
     uint8_t v = p[0] >> 6;
     if (v != 2) continue;
@@ -139,6 +148,7 @@ int parse_rtp_batch(const uint8_t* buf, const int32_t* offsets,
     if (payload_len < 0) continue;
     o.payload_off = off;
     o.payload_len = payload_len;
+    o.end_frame = o.marker;
 
     // VP8 payload descriptor (RFC 7741; buffer/vp8.go Unmarshal).
     if (vp8_pt_mask[o.pt >> 3] & (1 << (o.pt & 7))) {
@@ -181,6 +191,74 @@ int parse_rtp_batch(const uint8_t* buf, const int32_t* offsets,
       // Keyframe: P bit of the first VP8 payload byte (after descriptor),
       // only meaningful on the first packet of the picture.
       if (o.begin_pic && q < dl) o.keyframe = (d[q] & 0x01) == 0 ? 1 : 0;
+    } else if (vp9_pt_mask[o.pt >> 3] & (1 << (o.pt & 7))) {
+      // VP9 payload descriptor (draft-ietf-payload-vp9; the selection
+      // fields of pkg/sfu/buffer/buffer.go:599-671's VP9 parse feeding
+      // videolayerselector/vp9.go:43).
+      const uint8_t* d = p + off;
+      int dl = payload_len;
+      if (dl < 1) continue;
+      int q = 0;
+      uint8_t b0 = d[q++];
+      bool I = b0 & 0x80, P = b0 & 0x40, L = b0 & 0x20, F = b0 & 0x10;
+      bool B = b0 & 0x08, E = b0 & 0x04;
+      o.begin_pic = B ? 1 : 0;
+      o.end_frame = E ? 1 : 0;
+      if (I) {
+        if (q >= dl) continue;
+        uint8_t pb = d[q++];
+        if (pb & 0x80) {
+          if (q >= dl) continue;
+          o.picture_id = ((pb & 0x7F) << 8) | d[q++];
+        } else {
+          o.picture_id = pb & 0x7F;
+        }
+      }
+      bool have_layer = false;
+      if (L) {
+        if (q >= dl) continue;
+        uint8_t lb = d[q++];
+        o.tid = lb >> 5;
+        o.layer_sync = (lb >> 4) & 1;  // U: switching-up point
+        o.sid = (int8_t)((lb >> 1) & 0x07);
+        have_layer = true;
+        if (!F) {
+          if (q >= dl) continue;
+          o.tl0picidx = d[q++];
+        }
+      }
+      // vp9.go keyframe: !P && B && (SID == 0 || no layer indices).
+      if (!P && B && (!have_layer || o.sid == 0)) o.keyframe = 1;
+      if (o.keyframe) o.layer_sync = 1;
+    } else if (h264_pt_mask[o.pt >> 3] & (1 << (o.pt & 7))) {
+      // H264 (RFC 6184): NALU type drives keyframe detection — IDR (5)
+      // or SPS (7), also inside STAP-A aggregates and at FU-A starts
+      // (the reference's buffer.go:599-671 H264 keyframe scan).
+      const uint8_t* d = p + off;
+      int dl = payload_len;
+      if (dl < 1) continue;
+      uint8_t ntype = d[0] & 0x1F;
+      if (ntype >= 1 && ntype <= 23) {           // single NALU
+        o.begin_pic = 1;
+        if (ntype == 5 || ntype == 7) o.keyframe = 1;
+      } else if (ntype == 24) {                  // STAP-A
+        o.begin_pic = 1;
+        int q = 1;
+        while (q + 2 <= dl) {
+          int nsz = (d[q] << 8) | d[q + 1];
+          if (q + 2 + nsz > dl || nsz < 1) break;
+          uint8_t t = d[q + 2] & 0x1F;
+          if (t == 5 || t == 7) o.keyframe = 1;
+          q += 2 + nsz;
+        }
+      } else if ((ntype == 28 || ntype == 29) && dl >= 2) {  // FU-A/B
+        uint8_t fu = d[1];
+        bool start = fu & 0x80;
+        uint8_t t = fu & 0x1F;
+        o.begin_pic = start ? 1 : 0;
+        if (start && (t == 5 || t == 7)) o.keyframe = 1;
+      }
+      if (o.keyframe) o.layer_sync = 1;
     }
     ok++;
   }

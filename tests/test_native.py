@@ -83,7 +83,7 @@ def parse_both(datagrams, **kw):
 def test_native_library_built():
     # The image ships g++; the native path must actually be in use.
     assert rtp.native, "native librtp_parser.so failed to build"
-    assert PARSED_DTYPE.itemsize == 48  # C struct layout match
+    assert PARSED_DTYPE.itemsize == 52  # C struct layout match
 
 
 def test_parse_basic_and_audio_level():

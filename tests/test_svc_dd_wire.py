@@ -38,7 +38,7 @@ def av1_packet(sn, ts, ssrc, dd_bytes, keyframe=False):
     ext = build_ext_section([(DD_EXT_ID, dd_bytes)])
     hdr = bytearray(12)
     hdr[0] = 0x80 | 0x10
-    hdr[1] = 0x80 | 98          # marker; arbitrary AV1 PT
+    hdr[1] = 0x80 | 99          # marker; AV1_PT (DD-only parse path)
     hdr[2:4] = sn.to_bytes(2, "big")
     hdr[4:8] = ts.to_bytes(4, "big")
     hdr[8:12] = ssrc.to_bytes(4, "big")
@@ -57,7 +57,7 @@ async def test_svc_dd_forwarding_and_mask_rewrite():
         runtime.set_subscription(0, 0, 1, subscribed=True)
         # Subscriber capped to temporal 0 only.
         runtime.set_layer_caps(0, 0, 1, max_spatial=2, max_temporal=0)
-        ssrc = transport.assign_ssrc(0, 0, is_video=True, svc=True)
+        ssrc = transport.assign_ssrc(0, 0, is_video=True, svc=True, mime="video/av1")
         assert (0, 0) in transport._svc_tracks
 
         pub = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
@@ -136,7 +136,7 @@ async def test_cold_cache_custom_dti_dd_forwarded_intact():
     try:
         runtime.set_track(0, 0, published=True, is_video=True, is_svc=True)
         runtime.set_subscription(0, 0, 1, subscribed=True)
-        ssrc = transport.assign_ssrc(0, 0, is_video=True, svc=True)
+        ssrc = transport.assign_ssrc(0, 0, is_video=True, svc=True, mime="video/av1")
         pub = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
         pub.bind(("127.0.0.1", 0))
         sub = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)

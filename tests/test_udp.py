@@ -1039,3 +1039,188 @@ async def test_twcc_feedback_caps_allocation_budget():
     finally:
         tr.close()
         await runtime.stop()
+
+
+def _vp9_payload(sid=0, tid=0, keyframe=False, begin=True, end=True,
+                 pid=77, tl0=3, fill=100):
+    """VP9 payload descriptor (draft-ietf-payload-vp9) + filler bytes."""
+    b0 = 0x80 | 0x20  # I (pid present) | L (layer indices)
+    if not keyframe:
+        b0 |= 0x40    # P: inter-predicted
+    if begin:
+        b0 |= 0x08    # B
+    if end:
+        b0 |= 0x04    # E
+    d = bytearray([b0])
+    d += bytes([0x80 | ((pid >> 8) & 0x7F), pid & 0xFF])  # 15-bit pid
+    d.append((tid << 5) | ((sid & 7) << 1))               # T|U|SID|D
+    d.append(tl0 & 0xFF)                                  # TL0PICIDX (F=0)
+    d += bytes(fill)
+    return bytes(d)
+
+
+def _h264_payload(idr=False, fill=100):
+    """Single-NALU H264 payload: IDR (5) or non-IDR slice (1)."""
+    return bytes([0x65 if idr else 0x41]) + bytes(fill)
+
+
+async def test_h264_simulcast_switch_on_wire():
+    """H264 keyframe detection (NALU types) gates simulcast layer
+    switching end-to-end: the selector locks a new spatial layer only at
+    an IDR of that layer (the reference parses NALUs in buffer.go:599-671
+    for exactly this)."""
+    from livekit_server_tpu.runtime.udp import H264_PT
+    from tests.conftest import free_port
+
+    runtime = PlaneRuntime(DIMS, tick_ms=10)
+    port = free_port(socket.SOCK_DGRAM)
+    transport = await start_udp_transport(runtime.ingest, "127.0.0.1", port)
+    try:
+        runtime.set_track(0, 0, published=True, is_video=True)
+        runtime.set_subscription(0, 0, 1, subscribed=True)
+        runtime.set_layer_caps(0, 0, 1, max_spatial=0)   # start at L0
+        ssrc0 = transport.assign_ssrc(0, 0, True, layer=0, mime="video/h264")
+        ssrc1 = transport.assign_ssrc(0, 0, True, layer=1, mime="video/h264")
+        assert int(transport._track_pt[0, 0]) == H264_PT
+        pub = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
+        pub.bind(("127.0.0.1", 0))
+        sub = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
+        sub.bind(("127.0.0.1", 0))
+        sub.setblocking(False)
+        transport.register_subscriber(0, 1, sub.getsockname())
+
+        L0, L1 = 100, 220  # distinguishable payload sizes on the wire
+
+        def recv_sizes():
+            out = []
+            while True:
+                try:
+                    d = sub.recvfrom(4096)[0]
+                    if not 192 <= d[1] <= 223:
+                        out.append(len(d) - 12)
+                except BlockingIOError:
+                    return out
+
+        async def tick(sn, idr0=False, idr1=False):
+            pub.sendto(rtp_packet(sn=sn, ts=90 * sn, ssrc=ssrc0, pt=H264_PT,
+                                  marker=1,
+                                  payload=_h264_payload(idr0, L0 - 1)),
+                       ("127.0.0.1", port))
+            pub.sendto(rtp_packet(sn=sn, ts=90 * sn, ssrc=ssrc1, pt=H264_PT,
+                                  marker=1,
+                                  payload=_h264_payload(idr1, L1 - 1)),
+                       ("127.0.0.1", port))
+            await asyncio.sleep(0.02)
+            res = await runtime.step_once()
+            transport.send_egress_batch(res.egress_batch)
+            await asyncio.sleep(0.01)
+
+        # Phase 1: periodic IDRs on layer 0 (a real encoder keys on PLI);
+        # the selector locks L0 at the first IDR after the allocator has
+        # measured bitrates. Only L0-sized packets flow.
+        for sn in range(100, 112):
+            await tick(sn, idr0=sn % 4 == 0, idr1=False)
+        sizes = recv_sizes()
+        assert sizes and all(s == L0 for s in sizes), sizes
+
+        # Phase 2: raise the cap; WITHOUT an IDR on layer 1 the selector
+        # must keep forwarding layer 0 (no unlocked switch mid-GOP).
+        runtime.set_layer_caps(0, 0, 1, max_spatial=1)
+        for sn in range(112, 118):
+            await tick(sn, idr0=sn % 4 == 0)
+        sizes = recv_sizes()
+        assert sizes and all(s == L0 for s in sizes), sizes
+
+        # Phase 3: IDR arrives on layer 1 → switch; L1 sizes appear and
+        # L0 stops.
+        await tick(118, idr1=True)
+        for sn in range(119, 126):
+            await tick(sn, idr1=sn % 4 == 0)
+        sizes = recv_sizes()
+        assert L1 in sizes, sizes
+        assert sizes[-3:] == [L1] * 3, sizes
+        pub.close()
+        sub.close()
+    finally:
+        transport.transport.close()
+        await runtime.stop()
+
+
+async def test_vp9_ddless_svc_downswitch_on_wire():
+    """Plain VP9 SVC (no dependency descriptor): spatial layers come from
+    the VP9 picture header's SID (vp9.go:43 seat); capping a subscriber
+    downswitches the onion to layers ≤ cap."""
+    from livekit_server_tpu.runtime.udp import SVC_PT
+    from tests.conftest import free_port
+
+    runtime = PlaneRuntime(DIMS, tick_ms=10)
+    port = free_port(socket.SOCK_DGRAM)
+    transport = await start_udp_transport(runtime.ingest, "127.0.0.1", port)
+    try:
+        runtime.set_track(0, 0, published=True, is_video=True, is_svc=True)
+        runtime.set_subscription(0, 0, 1, subscribed=True)
+        ssrc = transport.assign_ssrc(0, 0, True, svc=True, mime="video/vp9")
+        assert int(transport._track_pt[0, 0]) == SVC_PT
+        pub = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
+        pub.bind(("127.0.0.1", 0))
+        sub = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
+        sub.bind(("127.0.0.1", 0))
+        sub.setblocking(False)
+        transport.register_subscriber(0, 1, sub.getsockname())
+
+        SIZES = {0: 100, 1: 200, 2: 300}  # payload size per spatial layer
+
+        def recv_sizes():
+            out = []
+            while True:
+                try:
+                    d = sub.recvfrom(4096)[0]
+                    if not 192 <= d[1] <= 223:
+                        out.append(len(d) - 12)
+                except BlockingIOError:
+                    return out
+
+        sn = 100
+
+        async def tick(keyframe=False):
+            nonlocal sn
+            ts = 90 * sn
+            for sid in (0, 1, 2):
+                pub.sendto(
+                    rtp_packet(
+                        sn=sn, ts=ts, ssrc=ssrc, pt=SVC_PT,
+                        marker=sid == 2,
+                        payload=_vp9_payload(
+                            sid=sid, keyframe=keyframe and sid == 0,
+                            pid=sn & 0x7FFF, fill=SIZES[sid] - 5,
+                        ),
+                    ),
+                    ("127.0.0.1", port),
+                )
+                sn += 1
+            await asyncio.sleep(0.02)
+            res = await runtime.step_once()
+            transport.send_egress_batch(res.egress_batch)
+            await asyncio.sleep(0.01)
+
+        # Keyframe locks the onion at full height: all three layers flow.
+        await tick(keyframe=True)
+        for _ in range(5):
+            await tick()
+        sizes = recv_sizes()
+        assert len(set(sizes)) == 3, sizes   # every spatial layer present
+
+        # Cap to spatial 0: the onion sheds layers 1-2.
+        runtime.set_layer_caps(0, 0, 1, max_spatial=0)
+        for _ in range(8):
+            await tick()
+        recv_sizes()                  # drain the transition
+        for _ in range(4):
+            await tick()
+        sizes = recv_sizes()
+        assert sizes and len(set(sizes)) == 1, sizes  # only one layer size
+        pub.close()
+        sub.close()
+    finally:
+        transport.transport.close()
+        await runtime.stop()
