@@ -199,6 +199,8 @@ class TickOutputs(NamedTuple):
     sub_quality: jax.Array     # [R, S] int32 — subscriber-side enum
     # Per-(track, layer) stream liveness (streamtracker; dynacast feed):
     layer_live: jax.Array      # [R, T, L] int32 — STOPPED/LIVE
+    layer_fps: jax.Array       # [R, T, L] float32 — measured frame rate
+                               # (fps.go; frame-tracker variant output)
     # Windowed per-track receive stats (telemetry; rolled by roll_quality):
     track_loss_pct: jax.Array  # [R, T] float32
     track_jitter_ms: jax.Array # [R, T] float32
@@ -301,11 +303,28 @@ def _room_tick(
     stats = rtpstats.update_tick(state.stats, st_sn, st_ts, st_size, st_arr, st_valid)
 
     # ---- 2. per-layer liveness + measured [4][4] bitrate matrix ---------
-    # StreamTracker rows (streamtracker.go cycles) per (track, layer):
-    st_pkts = jnp.sum(st_valid, axis=-1).astype(jnp.int32)            # [T*L]
-    st_bytes = jnp.sum(jnp.where(st_valid, st_size, 0), axis=-1)      # [T*L]
-    tracker, layer_status, _status_changed, tracker_bps = streamtracker.update_tick(
-        state.tracker, streamtracker.TrackerParams(), st_pkts, st_bytes, inp.tick_ms
+    # StreamTracker rows per (track, layer). Unlike the stats rows above,
+    # tracker rows route by each packet's TRUE spatial layer — for SVC
+    # tracks that's the DD/VP9-refined layer, which IS the reference's
+    # DD-driven tracker variant (streamtracker_dd.go): an SVC layer's row
+    # goes LIVE/STOPPED as decode targets appear/vanish. Frame starts
+    # feed the frame-rate rule + fps estimation (streamtracker_frame.go,
+    # fps.go).
+    true_layer = jnp.clip(inp.layer, 0, L - 1)
+    t_lane = true_layer[:, :, None] == lanes                        # [T,K,L]
+    def to_tracker(x, pred):
+        routed = jnp.where(t_lane & pred[:, :, None], x[:, :, None], 0)
+        return jnp.sum(routed, axis=1).reshape(T * L)               # [T*L]
+
+    ones_k = jnp.ones((T, K), jnp.int32)
+    st_pkts = to_tracker(ones_k, inp.valid)                           # [T*L]
+    st_bytes = to_tracker(inp.size, inp.valid)
+    st_frames = to_tracker(ones_k, inp.valid & inp.begin_pic)
+    tracker, layer_status, _status_changed, tracker_bps, layer_fps = (
+        streamtracker.update_tick(
+            state.tracker, streamtracker.TrackerParams(), st_pkts, st_bytes,
+            inp.tick_ms, frames=st_frames,
+        )
     )
     # Per-(layer, temporal) byte attribution EMA — the measured version of
     # the reference's Bitrates matrix (streamtrackermanager.go:60).
@@ -319,9 +338,10 @@ def _room_tick(
     tick_s = jnp.maximum(inp.tick_ms.astype(jnp.float32), 1.0) / 1000.0
     # Layer bitrate: tracker cycles once committed; per-tick EMA bootstraps
     # the first cycle so allocation starts on the first packets. SVC tracks
-    # always use the EMA attribution — their tracker rows collapsed to row
-    # 0 (single stream), so per-spatial-layer bps only exists in
-    # temporal_bytes.
+    # keep the EMA attribution even though tracker rows are now per true
+    # spatial layer (the DD-variant liveness feed): their temporal splits
+    # come from temporal_bytes either way, and the faster EMA avoids a
+    # 500 ms tracker-cycle lag on the onion's cumulative costs.
     boot_bps = jnp.sum(temporal_bytes, axis=-1) * 8.0 / tick_s        # [T, L]
     layer_bps = jnp.where(
         ~state.meta.is_svc[:, None] & (tracker_bps.reshape(T, L) > 0),
@@ -596,6 +616,7 @@ def _room_tick(
         track_quality=track_q,
         sub_quality=sub_q,
         layer_live=layer_status.reshape(T, L),
+        layer_fps=layer_fps.reshape(T, L),
         track_loss_pct=loss_pct,
         track_jitter_ms=jitter_ms,
         track_bps=jnp.sum(layer_bps, axis=-1),
@@ -757,6 +778,7 @@ def unpack_tick_outputs(
         "track_quality": (R, T),
         "sub_quality": (R, S),
         "layer_live": (R, T, MAX_LAYERS),
+        "layer_fps": (R, T, MAX_LAYERS),
         "track_loss_pct": (R, T),
         "track_jitter_ms": (R, T),
         "track_bps": (R, T),
@@ -771,7 +793,7 @@ def unpack_tick_outputs(
         "red_ok": (R, T, K if red_enabled else 0, red.RED_DISTANCE),
     }
     floats = {"speaker_levels", "track_mos", "track_loss_pct", "track_jitter_ms",
-              "track_bps", "committed_bps", "pacer_allowed"}
+              "track_bps", "committed_bps", "pacer_allowed", "layer_fps"}
     bools = {"need_keyframe", "congested", "pad_valid", "deficient", "red_ok"}
     buf = np.asarray(buf)
     pieces, off = {}, 0

@@ -1,16 +1,32 @@
-"""Batched per-layer stream liveness + bitrate tracking.
+"""Batched per-layer stream liveness + bitrate + frame-rate tracking.
 
-Reference parity: pkg/sfu/streamtracker (streamtracker.go:57-300 packet-
-count cycles, streamtracker_packet.go) and StreamTrackerManager's available-
-layer + Bitrates reporting (streamtrackermanager.go:60-732). The reference
-runs one tracker goroutine per (track, layer) with sample windows; here one
-row per (track, layer) stream updates every tick with pure elementwise ops.
+Reference parity: pkg/sfu/streamtracker — ALL three variants behind
+`StreamTrackerImpl`: packet-count cycles (streamtracker_packet.go),
+frame-boundary cycles (streamtracker_frame.go — low-fps screenshare
+layers must not flap LIVE/STOPPED just because they send few packets),
+and DD-driven per-layer liveness (streamtracker_dd.go — an SVC stream's
+layer is live when frames targeting that spatial layer keep arriving);
+plus fps estimation (buffer/fps.go) and StreamTrackerManager's
+available-layer + Bitrates reporting (streamtrackermanager.go:60-732).
+
+The reference runs one tracker goroutine per (track, layer) with sample
+windows and picks ONE variant per source kind; here one row per
+(track, layer) stream updates every tick with pure elementwise ops and
+the packet and frame rules are both evaluated — a stream is LIVE if
+either holds, which subsumes the per-kind variant selection (a camera
+layer satisfies the packet rule, a 2 fps screenshare the frame rule).
+The DD variant falls out of the feed: the plane routes tracker counts by
+each packet's TRUE spatial layer (the DD/VP9-refined one for SVC), so an
+SVC track's per-layer rows go LIVE/STOPPED exactly as decode targets
+appear/vanish.
 
 Semantics kept:
-  - a layer goes LIVE after >= `min_pkts` packets within a cycle window
+  - a layer goes LIVE after >= `min_pkts` packets OR >= `min_frames`
+    frame starts within a cycle window
   - a layer goes STOPPED after `stop_ms` without any packet
-  - per-layer bitrate is an EMA over per-tick byte counts, reported as bps
-    (feeds the allocator's [4][4] Bitrates matrix — receiver.go:49)
+  - per-layer bitrate is an EMA over per-cycle byte counts, reported as
+    bps (feeds the allocator's [4][4] Bitrates matrix — receiver.go:49)
+  - per-layer fps is an EMA over per-cycle frame starts (fps.go)
 """
 
 from __future__ import annotations
@@ -29,8 +45,12 @@ class TrackerParams(NamedTuple):
 
     cycle_ms: int = 500        # samplesRequired window (streamtracker.go)
     min_pkts: int = 5          # packets per cycle to declare live
+    min_frames: int = 1        # frame starts per cycle to declare live
+                               # (streamtracker_frame.go: a 2 fps layer
+                               # sends ~1 frame / 500 ms window)
     stop_ms: int = 1000        # silence to declare stopped
     bitrate_alpha: float = 0.3  # per-cycle EMA weight
+    fps_alpha: float = 0.3      # per-cycle fps EMA weight (fps.go)
 
 
 class TrackerState(NamedTuple):
@@ -42,6 +62,8 @@ class TrackerState(NamedTuple):
     silent_ms: jax.Array     # int32 — ms since last packet
     cycle_bytes: jax.Array   # float32 — bytes in current cycle
     bitrate_bps: jax.Array   # float32 — smoothed bitrate
+    cycle_frames: jax.Array  # int32 — frame starts in current cycle
+    fps: jax.Array           # float32 — smoothed frame rate
 
 
 def init_state(num_streams: int) -> TrackerState:
@@ -53,6 +75,8 @@ def init_state(num_streams: int) -> TrackerState:
         silent_ms=z(jnp.int32),
         cycle_bytes=z(jnp.float32),
         bitrate_bps=z(jnp.float32),
+        cycle_frames=z(jnp.int32),
+        fps=z(jnp.float32),
     )
 
 
@@ -62,17 +86,27 @@ def update_tick(
     pkts: jax.Array,      # [..., N] int32 — packets observed this tick
     byts: jax.Array,      # [..., N] int32 — bytes observed this tick
     tick_ms: jax.Array,   # scalar int32
+    frames: jax.Array | None = None,  # [..., N] int32 — frame starts
 ):
-    """Returns (state, status [N], changed [N] bool, bitrate_bps [N])."""
+    """Returns (state, status [N], changed [N] bool, bitrate_bps [N],
+    fps [N])."""
     tick_ms = jnp.asarray(tick_ms, jnp.int32)
+    if frames is None:
+        frames = jnp.zeros_like(pkts)
     got = pkts > 0
     silent_ms = jnp.where(got, 0, state.silent_ms + tick_ms)
     cycle_pkts = state.cycle_pkts + pkts
+    cycle_frames = state.cycle_frames + frames
     cycle_bytes = state.cycle_bytes + byts.astype(jnp.float32)
     cycle_ms = state.cycle_ms + tick_ms
 
     cycle_done = cycle_ms >= params.cycle_ms
-    went_live = cycle_done & (cycle_pkts >= params.min_pkts)
+    # Packet rule OR frame rule: the frame rule keeps a low-fps
+    # screenshare layer LIVE when its packet count never reaches
+    # min_pkts in a cycle (streamtracker_frame.go).
+    went_live = cycle_done & (
+        (cycle_pkts >= params.min_pkts) | (cycle_frames >= params.min_frames)
+    )
     went_dead = silent_ms >= params.stop_ms
 
     status = state.status
@@ -80,7 +114,7 @@ def update_tick(
     status = jnp.where(went_dead, STOPPED, status)
     changed = status != state.status
 
-    # Bitrate: commit the cycle's byte count into the EMA at cycle end.
+    # Bitrate + fps: commit the cycle's counts into EMAs at cycle end.
     cycle_s = jnp.maximum(cycle_ms.astype(jnp.float32), 1.0) / 1000.0
     inst_bps = cycle_bytes * 8.0 / cycle_s
     a = jnp.float32(params.bitrate_alpha)
@@ -92,6 +126,14 @@ def update_tick(
         state.bitrate_bps,
     )
     bitrate = jnp.where(status == STOPPED, 0.0, bitrate)
+    inst_fps = cycle_frames.astype(jnp.float32) / cycle_s
+    fa = jnp.float32(params.fps_alpha)
+    fps = jnp.where(
+        cycle_done,
+        jnp.where(state.fps > 0, state.fps * (1 - fa) + inst_fps * fa, inst_fps),
+        state.fps,
+    )
+    fps = jnp.where(status == STOPPED, 0.0, fps)
 
     new_state = TrackerState(
         status=status,
@@ -100,5 +142,7 @@ def update_tick(
         silent_ms=silent_ms,
         cycle_bytes=jnp.where(cycle_done, 0.0, cycle_bytes),
         bitrate_bps=bitrate,
+        cycle_frames=jnp.where(cycle_done, 0, cycle_frames),
+        fps=fps,
     )
-    return new_state, status, changed, bitrate
+    return new_state, status, changed, bitrate, fps

@@ -159,6 +159,15 @@ class IngestBuffer:
         # occupant (e.g. a decayed floor rate + sticky ever_fb latch
         # would cap a fresh subscriber for up to a minute).
         self.sub_reset = np.zeros((R, S), bool)
+        # Cumulative per-(room, track) receive counters
+        # (participant_traffic_load.go seat: per-participant rates are
+        # window deltas over these, summed across a publisher's tracks).
+        self.rx_pkts = np.zeros((R, T), np.int64)
+        self.rx_bytes = np.zeros((R, T), np.int64)
+        # WS-media egress counters ([..., 0]=pkts, [..., 1]=bytes): the
+        # UDP transport keeps its own; subscribers on the WS media path
+        # must count too or a WS-heavy node reports zero egress.
+        self.ws_tx = np.zeros((R, S, 2), np.int64)
         self.nack_overflow = 0   # NACK counts clipped by NACK_COUNT_CAP
         self._nack_seen: set = set()           # per-tick (r, s, sn, track)
         self._nack_tick_cnt = np.zeros((R, S), np.int32)
@@ -189,6 +198,8 @@ class IngestBuffer:
         """Stage one packet; False (and counted) if the tick is full."""
         if pkt.room in self.frozen_rows:
             return False  # mid-migration: the row's state is already shipped
+        self.rx_pkts[pkt.room, pkt.track] += 1
+        self.rx_bytes[pkt.room, pkt.track] += pkt.size
         k = self._count[pkt.room, pkt.track]
         if k >= self.dims.pkts:
             self.dropped += 1
@@ -259,6 +270,10 @@ class IngestBuffer:
                     return 0
         T, K = self.dims.tracks, self.dims.pkts
         flat_rt = room.astype(np.int64) * T + track
+        # Receive accounting (includes packets a full tick then drops —
+        # they arrived on the wire either way).
+        np.add.at(self.rx_pkts.reshape(-1), flat_rt, 1)
+        np.add.at(self.rx_bytes.reshape(-1), flat_rt, size.astype(np.int64))
         # Arrival-order rank within each (room, track) group.
         order = np.argsort(flat_rt, kind="stable")
         sorted_rt = flat_rt[order]

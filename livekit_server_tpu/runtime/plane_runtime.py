@@ -198,6 +198,7 @@ class TickResult:
     track_mos: Any = None         # [R, T] float32
     sub_quality: Any = None       # [R, S] int32
     layer_live: Any = None        # [R, T, L] int32
+    layer_fps: Any = None         # [R, T, L] float32 (measured fps)
     track_loss_pct: Any = None    # [R, T] float32
     track_jitter_ms: Any = None   # [R, T] float32
     # RED plan (ops/red): per-packet redundancy candidates for the host
@@ -619,6 +620,7 @@ class PlaneRuntime:
             track_mos=out.track_mos,
             sub_quality=out.sub_quality,
             layer_live=out.layer_live,
+            layer_fps=out.layer_fps,
             track_loss_pct=out.track_loss_pct,
             track_jitter_ms=out.track_jitter_ms,
             track_bps=out.track_bps,

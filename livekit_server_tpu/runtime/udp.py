@@ -409,6 +409,12 @@ class UDPMediaTransport(asyncio.DatagramProtocol):
         self._twcc_ms = np.zeros((R, S, TWCC_RING), np.float64)
         self._twcc_ctr = np.full((R, S, TWCC_RING), -1, np.int64)
         self._twcc_len = np.zeros((R, S, TWCC_RING), np.int32)
+        # Cumulative per-(room, sub) send counters (never reset — the SR
+        # accumulators fold away at SR cadence): window deltas over these
+        # are the per-participant egress rates
+        # (participant_traffic_load.go seat).
+        self.tx_pkts = np.zeros((R, S), np.int64)
+        self.tx_bytes = np.zeros((R, S), np.int64)
         # Last acked (ctr, send, recv) per sub: delay deltas must span
         # feedback-frame boundaries or one-ack-per-frame cadences would
         # never produce a delay-variation sample at all.
@@ -666,6 +672,10 @@ class UDPMediaTransport(asyncio.DatagramProtocol):
         self._egress_ssrc_arr[room] = 0
         self._track_pt[room] = OPUS_PT
         self._track_is_video[room] = False
+        self.tx_pkts[room] = 0
+        self.tx_bytes[room] = 0
+        self.ingest.rx_pkts[room] = 0
+        self.ingest.rx_bytes[room] = 0
         self._txsr_pkts[room] = 0
         self._txsr_oct[room] = 0
         self.sub_red = {k for k in self.sub_red if k[0] != room}
@@ -1826,6 +1836,12 @@ class UDPMediaTransport(asyncio.DatagramProtocol):
                 batch.ts[idx].astype(np.int64) & 0xFFFFFFFF
             ).astype(np.uint32)
             self._txsr_ms[rr_, ss_, tt_] = now_ms
+            flat_rs = rr_.astype(np.int64) * S + ss_
+            np.add.at(self.tx_pkts.reshape(-1), flat_rs, 1)
+            np.add.at(
+                self.tx_bytes.reshape(-1), flat_rs,
+                pl[idx].astype(np.int64) + WIRE_OVERHEAD_BYTES,
+            )
         if (e_tcp & (po >= 0)).any():
             # TCP-fallback subscribers: cold path, per-frame sealing.
             self.send_egress(batch.to_packets(e_tcp & (po >= 0)))
@@ -2045,6 +2061,8 @@ class UDPMediaTransport(asyncio.DatagramProtocol):
             self._txsr_oct[rr, ss, tt] += len(payload)
             self._txsr_ts[rr, ss, tt] = int(batch.ts[i]) & 0xFFFFFFFF
             self._txsr_ms[rr, ss, tt] = now_ms
+            self.tx_pkts[rr, ss] += 1
+            self.tx_bytes[rr, ss] += len(payload) + WIRE_OVERHEAD_BYTES
 
     def _fold_txsr(self) -> None:
         """Merge batch-path SR accumulators into the per-SSRC table (runs
@@ -2134,6 +2152,13 @@ class UDPMediaTransport(asyncio.DatagramProtocol):
             vp8_flags.append(1 if has_vp8 else 0)
             addrs.append(addr)
             sessions.append(self.sub_sessions.get((pkt.room, pkt.sub)))
+            self.tx_pkts[pkt.room, pkt.sub] += 1
+            # Actual wire bytes: padding packets carry PAD_RUN, not their
+            # (empty) payload, and extensions count too — probe bursts are
+            # exactly when egress-rate accuracy matters.
+            self.tx_bytes[pkt.room, pkt.sub] += (
+                len(payload) + len(ext) + WIRE_OVERHEAD_BYTES
+            )
         if not offsets:
             return
         rtp.rewrite_vp8_batch(

@@ -13,9 +13,12 @@ from __future__ import annotations
 import asyncio
 from typing import Callable
 
+import numpy as np
+
 from livekit_server_tpu.config.config import Config
 from livekit_server_tpu.models import plane
 from livekit_server_tpu.ops import audio as audio_ops, bwe as bwe_ops
+from livekit_server_tpu.ops.pacer import WIRE_OVERHEAD_BYTES
 from livekit_server_tpu.protocol import models as pm
 from livekit_server_tpu.protocol.signal import (
     SignalResponse,
@@ -403,10 +406,17 @@ class RoomManager:
             ws_pkts = res.egress_batch.to_packets(~handled) if len(handled) else []
         else:
             ws_pkts = res.egress
+        ws_tx = self.runtime.ingest.ws_tx
         for pkt in ws_pkts:
             room = self._row_to_room.get(pkt.room)
             if room is not None:
                 room.deliver_egress(pkt)
+                # WS-media egress accounting (same wire-byte basis as the
+                # UDP counters).
+                ws_tx[pkt.room, pkt.sub, 0] += 1
+                ws_tx[pkt.room, pkt.sub, 1] += (
+                    len(pkt.payload) + WIRE_OVERHEAD_BYTES
+                )
         for row, speakers in res.speakers.items():
             room = self._row_to_room.get(row)
             if room is not None:
@@ -490,8 +500,72 @@ class RoomManager:
         st.num_rooms = len(self.rooms)
         st.num_clients = sum(len(r.participants) for r in self.rooms.values())
         st.num_tracks_in = sum(len(r.tracks) for r in self.rooms.values())
+        st.num_tracks_out = sum(
+            len(p.subscribed_tracks)
+            for r in self.rooms.values()
+            for p in r.participants.values()
+        )
         st.plane_rooms_used = self.runtime.slots.rooms_used
         st.plane_rooms_capacity = self.runtime.slots.capacity
+
+    def sample_traffic(self) -> None:
+        """Window deltas of the cumulative rx/tx counters → node packet/
+        byte rates (participant_traffic_load.go:38-150 seat: per-
+        participant rates feed NodeStats and thereby node selection).
+        Called from the server's 2 s stats loop; per-slot rate arrays are
+        retained for /debug/rooms' per-participant view."""
+        import time as _time
+
+        now = _time.monotonic()
+        ing = self.runtime.ingest
+        prev = getattr(self, "_traffic_prev", None)
+        rx_p = ing.rx_pkts.copy()
+        # Wire-byte basis on BOTH directions (payload + fixed per-packet
+        # overhead), so bytes_in/bytes_out are comparable.
+        rx_b = ing.rx_bytes + ing.rx_pkts * WIRE_OVERHEAD_BYTES
+        tx_p = ing.ws_tx[:, :, 0].copy()
+        tx_b = ing.ws_tx[:, :, 1].copy()
+        if self.udp is not None:
+            tx_p += self.udp.tx_pkts
+            tx_b += self.udp.tx_bytes
+        self._traffic_prev = (now, rx_p, rx_b, tx_p, tx_b)
+        if prev is None:
+            return
+        t0, prx_p, prx_b, ptx_p, ptx_b = prev
+        dt = max(now - t0, 1e-3)
+        # Clamp: slot release resets counters mid-window.
+        self.rx_pps = np.maximum(rx_p - prx_p, 0) / dt      # [R, T]
+        self.rx_bps = np.maximum(rx_b - prx_b, 0) * 8 / dt
+        self.tx_pps = np.maximum(tx_p - ptx_p, 0) / dt      # [R, S]
+        self.tx_bps = np.maximum(tx_b - ptx_b, 0) * 8 / dt
+        st = self.router.local_node.stats
+        st.packets_in_per_sec = float(self.rx_pps.sum())
+        st.bytes_in_per_sec = float(self.rx_bps.sum()) / 8
+        st.packets_out_per_sec = float(self.tx_pps.sum())
+        st.bytes_out_per_sec = float(self.tx_bps.sum()) / 8
+
+    def participant_traffic(self, room: "Room") -> dict:
+        """Per-participant rates from the last sample window: egress from
+        the participant's subscriber slot, ingress summed over the tracks
+        it publishes."""
+        out = {}
+        rx_pps = getattr(self, "rx_pps", None)
+        row = room.slots.row
+        for ident, p in room.participants.items():
+            ent = {"tx_pps": 0.0, "tx_bps": 0.0, "rx_pps": 0.0, "rx_bps": 0.0}
+            if getattr(self, "tx_pps", None) is not None and p.sub_col >= 0:
+                ent["tx_pps"] = round(float(self.tx_pps[row, p.sub_col]), 1)
+                ent["tx_bps"] = round(float(self.tx_bps[row, p.sub_col]), 1)
+            if rx_pps is not None:
+                cols = [
+                    t.track_col for pub, t in room.tracks.values()
+                    if pub.sid == p.sid
+                ]
+                if cols:
+                    ent["rx_pps"] = round(float(rx_pps[row, cols].sum()), 1)
+                    ent["rx_bps"] = round(float(self.rx_bps[row, cols].sum()), 1)
+            out[ident] = ent
+        return out
 
     def _notify(self, event: str, **payload) -> None:
         if self.telemetry is not None:

@@ -94,6 +94,9 @@ class LivekitServer:
         while True:
             self._node_cache = await self.router.list_nodes()
             sample_system_stats(self.router.local_node.stats)
+            # Per-participant traffic rates → NodeStats packet/byte rates
+            # (participant_traffic_load.go cadence).
+            self.room_manager.sample_traffic()
             await asyncio.sleep(2.0)
 
     def room_manager_media_queue(self, room_name: str, identity: str):
@@ -199,6 +202,7 @@ class LivekitServer:
                         "row": r.slots.row,
                         "participants": list(r.participants),
                         "tracks": list(r.tracks),
+                        "traffic": rm.participant_traffic(r),
                     }
                     for name, r in rm.rooms.items()
                 },

@@ -24,13 +24,26 @@ import jax  # noqa: E402
 jax.config.update("jax_platforms", "cpu")
 
 # Persistent compilation cache: the suite compiles the full media-plane
-# tick many times (sharded/unsharded/donated variants, plus the graft
-# dryrun's fresh subprocess); identical computations then hit the disk
-# cache instead of recompiling. Shared location so the dryrun subprocess
-# and repeat suite runs benefit too.
+# tick many times (sharded/unsharded/donated variants); identical
+# computations then hit the disk cache instead of recompiling. The dir is
+# keyed by the process's XLA/JAX environment fingerprint: XLA:CPU AOT
+# artifacts embed target-machine tuning flags, and loading an entry
+# compiled under a different configuration logs a machine-feature
+# mismatch and can abort outright.
+import hashlib  # noqa: E402
+
+_fp = hashlib.md5(
+    (
+        os.environ.get("XLA_FLAGS", "")
+        + "|" + os.environ.get("JAX_PLATFORMS", "")
+        + "|" + jax.__version__
+    ).encode()
+).hexdigest()[:10]
 jax.config.update(
     "jax_compilation_cache_dir",
-    os.environ.get("JAX_COMPILATION_CACHE_DIR", "/tmp/jax_cache_livekit_tpu"),
+    os.environ.get(
+        "JAX_COMPILATION_CACHE_DIR", f"/tmp/jax_cache_livekit_tpu_{_fp}"
+    ),
 )
 jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
 

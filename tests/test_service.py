@@ -222,6 +222,7 @@ async def test_join_publish_subscribe_media():
             assert first["track_sid"] == track_sid
 
             # speakers fire eventually (alice is loud)
+            server.room_manager.sample_traffic()  # open a rate window
             for i in range(5, 40):
                 await alice.send_media(
                     cid="mic", sn=100 + i, ts=960 * i, payload=b"x", audio_level=18,
@@ -230,6 +231,17 @@ async def test_join_publish_subscribe_media():
                 await asyncio.sleep(0.012)
             spk = await bob.wait_for("speakers_changed", timeout=5)
             assert spk["speakers"][0]["sid"] == join_a["participant"]["sid"]
+
+            # Per-participant traffic accounting
+            # (participant_traffic_load.go seat): alice published ~35
+            # packets inside the sample window — her ingress rate is
+            # nonzero and feeds the node packet rate.
+            rm = server.room_manager
+            rm.sample_traffic()
+            traffic = rm.participant_traffic(rm.rooms["lobby"])
+            assert traffic["alice"]["rx_pps"] > 0
+            assert traffic["alice"]["rx_bps"] > 0
+            assert rm.router.local_node.stats.packets_in_per_sec > 0
 
             await alice.close()
             await bob.close()

@@ -18,14 +18,14 @@ def test_tracker_live_and_stop_cycle():
     st = streamtracker.init_state(2)
     # stream 0 gets 2 pkts/tick, stream 1 silent.
     for _ in range(2):
-        st, status, changed, bps = streamtracker.update_tick(
+        st, status, changed, bps, _fps = streamtracker.update_tick(
             st, p, jnp.asarray([2, 0]), jnp.asarray([2400, 0]), 50
         )
     assert status.tolist() == [streamtracker.LIVE, streamtracker.STOPPED]
     assert float(bps[0]) > 0
     # silence stops it after stop_ms
     for _ in range(4):
-        st, status, changed, bps = streamtracker.update_tick(
+        st, status, changed, bps, _fps = streamtracker.update_tick(
             st, p, jnp.asarray([0, 0]), jnp.asarray([0, 0]), 50
         )
     assert status.tolist() == [streamtracker.STOPPED, streamtracker.STOPPED]
@@ -35,7 +35,7 @@ def test_tracker_live_and_stop_cycle():
 def test_tracker_bitrate_tracks_input():
     p = streamtracker.TrackerParams(cycle_ms=100, min_pkts=1, bitrate_alpha=1.0)
     st = streamtracker.init_state(1)
-    st, _, _, bps = streamtracker.update_tick(st, p, jnp.asarray([10]), jnp.asarray([12500]), 100)
+    st, _, _, bps, _fps = streamtracker.update_tick(st, p, jnp.asarray([10]), jnp.asarray([12500]), 100)
     # 12500 B over 100 ms = 1 Mbps
     assert abs(float(bps[0]) - 1_000_000) < 1e-3
 
@@ -160,3 +160,37 @@ def test_pacer_burst_cap():
         st, _, _ = pacer.update_tick(st, p, jnp.asarray([0.0]), rate, 100)
     st, allowed, _ = pacer.update_tick(st, p, jnp.asarray([50_000.0]), rate, 100)
     assert float(allowed[0]) <= 10_000 + 1
+
+def test_low_fps_screenshare_stays_live_via_frame_rule():
+    """streamtracker_frame.go seat: a 2 fps screenshare layer sends ~2
+    packets per 500 ms cycle — below min_pkts — but its frame starts keep
+    it LIVE; the packet rule alone would leave it STOPPED forever."""
+    p = streamtracker.TrackerParams()
+    st = streamtracker.init_state(1)
+    statuses = []
+    fps_vals = []
+    # 10 s at 100 ms ticks: one 2-packet frame every 5th tick (2 fps).
+    for i in range(100):
+        frame = 1 if i % 5 == 0 else 0
+        st, status, _ch, _bps, fps = streamtracker.update_tick(
+            st, p,
+            jnp.asarray([2 * frame]), jnp.asarray([500 * frame]), 100,
+            frames=jnp.asarray([frame]),
+        )
+        statuses.append(int(status[0]))
+        fps_vals.append(float(fps[0]))
+    # Live by the end of the first cycle, and NEVER flaps back.
+    first_live = statuses.index(streamtracker.LIVE)
+    assert first_live <= 6, statuses[:10]
+    assert all(s == streamtracker.LIVE for s in statuses[first_live:]), statuses
+    # Measured fps converges near 2.
+    assert 1.5 < fps_vals[-1] < 2.5, fps_vals[-1]
+    # Control: with NO frame signal the packet rule never fires (2 pkts
+    # < min_pkts=5 per cycle) — the old flap this variant fixes.
+    st2 = streamtracker.init_state(1)
+    for i in range(100):
+        frame = 1 if i % 5 == 0 else 0
+        st2, status2, *_ = streamtracker.update_tick(
+            st2, p, jnp.asarray([2 * frame]), jnp.asarray([500 * frame]), 100,
+        )
+    assert int(status2[0]) == streamtracker.STOPPED
