@@ -66,6 +66,10 @@ class BWEConfig:
     estimate_required_downgrades: int = 3
     min_channel_capacity: float = 100_000.0
     probe_interval_ms: int = 5000
+    # Send-side delay-based estimation over transport-wide feedback (the
+    # TWCC seat; transport.go cc.BandwidthEstimator). Off ⇒ allocation
+    # budgets come only from client-volunteered estimate samples.
+    send_side_bwe: bool = True
 
 
 @dataclass

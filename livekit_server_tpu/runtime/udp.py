@@ -422,6 +422,7 @@ class UDPMediaTransport(asyncio.DatagramProtocol):
         self._twcc_last_send = np.zeros((R, S), np.float64)
         self._twcc_last_recv = np.zeros((R, S), np.float64)
         self.egress_threads = 4
+        self.send_side_bwe = True  # config rtc.congestion_control.send_side_bwe
         # RED (RFC 2198) opt-in per subscriber + per-(room, audio track)
         # ring of recent primary payloads (the byte half of the device's
         # encode plan; redreceiver.go).
@@ -519,11 +520,14 @@ class UDPMediaTransport(asyncio.DatagramProtocol):
     def _refresh_fb_enabled(self, room: int, sub: int) -> None:
         """TWCC applies to subs whose egress is actually sealed over UDP
         (counters on the wire): session bound + UDP address + sealing
-        active (require_encryption, or the client spoke sealed first)."""
+        active (require_encryption, or the client spoke sealed first).
+        `send_side_bwe` is the operator off-switch (config
+        rtc.congestion_control.send_side_bwe)."""
         addr = self.sub_addrs.get((room, sub))
         sess = self.sub_sessions.get((room, sub))
         self.ingest.fb_enabled[room, sub] = (
-            addr is not None
+            self.send_side_bwe
+            and addr is not None
             and not (isinstance(addr, tuple) and addr and addr[0] == "tcp")
             and sess is not None
             and (self.require_encryption or sess.client_active)

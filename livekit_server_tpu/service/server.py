@@ -235,6 +235,9 @@ class LivekitServer:
                 )
                 # Client PLIs over RTCP reach signal-plane publishers too.
                 self.room_manager.udp.on_pli = self.room_manager.handle_pli
+                self.room_manager.udp.send_side_bwe = (
+                    self.config.rtc.congestion_control.send_side_bwe
+                )
                 if self.config.rtc.pacer == "no-queue":
                     self.room_manager.udp.pacer_spread_ms = (
                         self.config.plane.tick_ms / 2.0

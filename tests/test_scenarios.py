@@ -158,3 +158,80 @@ async def test_webhooks_delivered_and_signed():
                 await alice.close()
     finally:
         await runner.cleanup()
+
+
+async def test_churn_under_media_load():
+    """Control-plane churn racing the media plane: participants join,
+    publish, stream, unpublish, and leave across several rooms while
+    other publishers keep streaming. Exercises slot reuse (track cols,
+    sub cols), subscription fan-out during active ticks, and the per-sub
+    device-state reset path — the §5.2 race surface, end-to-end."""
+    from tests.test_service import SignalClient, running_server
+
+    async with running_server() as server:
+        async with aiohttp.ClientSession() as s:
+            # Two long-lived publishers in two rooms stream throughout.
+            stable = []
+            for rname in ("churn-a", "churn-b"):
+                p = SignalClient(s, server.port)
+                await p.connect(rname, f"anchor-{rname}")
+                await p.send_signal(
+                    "add_track", {"cid": "mic", "type": 0, "name": "mic"}
+                )
+                await p.wait_for("track_published")
+                stable.append(p)
+
+            async def stream(p, base):
+                for i in range(40):
+                    await p.send_media(
+                        cid="mic", sn=base + i, ts=960 * i,
+                        payload=b"s" + bytes([i]), audio_level=20, frame_ms=20,
+                    )
+                    await asyncio.sleep(0.008)
+
+            async def churn(room, tag):
+                for j in range(3):
+                    c = SignalClient(s, server.port)
+                    await c.connect(room, f"{tag}-{j}")
+                    await c.send_signal(
+                        "add_track",
+                        {"cid": f"m{j}", "type": 0, "name": "m"},
+                    )
+                    await c.wait_for("track_published")
+                    for i in range(4):
+                        await c.send_media(
+                            cid=f"m{j}", sn=10 + i, ts=960 * i,
+                            payload=b"c", audio_level=30, frame_ms=20,
+                        )
+                        await asyncio.sleep(0.005)
+                    await c.close()
+
+            await asyncio.gather(
+                stream(stable[0], 1000),
+                stream(stable[1], 2000),
+                churn("churn-a", "ca"),
+                churn("churn-b", "cb"),
+                churn("churn-a", "ca2"),
+            )
+            # The plane survived: anchors still present, churners gone,
+            # rooms intact, and slots were actually recycled. Removal is
+            # asynchronous after the WS close (session worker observes the
+            # closed channel on its own loop turns), so poll briefly.
+            rm = server.room_manager
+            assert set(rm.rooms) >= {"churn-a", "churn-b"}
+            deadline = asyncio.get_event_loop().time() + 5.0
+            def churners():
+                return [
+                    i
+                    for rname in ("churn-a", "churn-b")
+                    for i in rm.rooms[rname].participants
+                    if i.startswith(("ca-", "cb-", "ca2-"))
+                ]
+            while churners():
+                assert asyncio.get_event_loop().time() < deadline, churners()
+                await asyncio.sleep(0.05)
+            for rname in ("churn-a", "churn-b"):
+                assert f"anchor-{rname}" in set(rm.rooms[rname].participants)
+            assert rm.runtime.stats["fwd_packets"] > 0
+            for p in stable:
+                await p.close()

@@ -1224,3 +1224,33 @@ async def test_vp9_ddless_svc_downswitch_on_wire():
     finally:
         transport.transport.close()
         await runtime.stop()
+
+
+async def test_send_side_bwe_off_switch():
+    """config rtc.congestion_control.send_side_bwe=false must keep
+    fb_enabled off for an otherwise-eligible sealed-UDP subscriber (the
+    operator opt-out; allocation falls back to client estimates)."""
+    from livekit_server_tpu.runtime.crypto import MediaCryptoRegistry
+    from livekit_server_tpu.runtime.udp import UDPMediaTransport
+    from tests.conftest import free_port
+
+    runtime = PlaneRuntime(DIMS, tick_ms=10)
+    reg = MediaCryptoRegistry()
+    port = free_port(socket.SOCK_DGRAM)
+    loop = asyncio.get_running_loop()
+    tr, transport = await loop.create_datagram_endpoint(
+        lambda: UDPMediaTransport(runtime.ingest, crypto=reg, require_encryption=True),
+        local_addr=("127.0.0.1", port),
+    )
+    try:
+        transport.send_side_bwe = False
+        transport.bind_sub_session(0, 1, reg.mint())
+        transport.register_subscriber(0, 1, ("127.0.0.1", 50001))
+        assert not bool(runtime.ingest.fb_enabled[0, 1])
+        # Flipping it on and re-registering enables the path.
+        transport.send_side_bwe = True
+        transport.register_subscriber(0, 1, ("127.0.0.1", 50001))
+        assert bool(runtime.ingest.fb_enabled[0, 1])
+    finally:
+        tr.close()
+        await runtime.stop()
