@@ -348,6 +348,8 @@ def main() -> None:
     if args.cpu:
         jax.config.update("jax_platforms", "cpu")
 
+    bench_t0 = time.perf_counter()
+
     from livekit_server_tpu.models import plane, synth
 
     dims = plane.PlaneDims(args.rooms, args.tracks, args.pkts, args.subs)
@@ -433,15 +435,20 @@ def main() -> None:
         # North-star tick: the FULL 10k-rooms × 50-subs plane on ONE chip
         # (the BASELINE target shape is 10k×50 on v5e-8; room-sharding
         # divides this by the mesh size, so single-chip-tick/8 estimates
-        # the per-chip cost on the target pod).
-        try:
-            d = plane.PlaneDims(10240, 8, 16, 50)
-            s = synth.TrafficSpec(video_tracks=2, audio_tracks=6, tick_ms=20,
-                                  video_kbps=1500, svc=True)
-            r = device_bench(d, s, ticks=3, warmup=1)
-            result["northstar_10240rooms_50subs_tick_ms"] = r["device_tick_ms"]
-        except Exception as e:  # noqa: BLE001
-            result["northstar_error"] = f"{type(e).__name__}"
+        # the per-chip cost on the target pod). Time-guarded: the driver
+        # runs this under a deadline, and a partial record beats a
+        # timed-out empty one.
+        if time.perf_counter() - bench_t0 < 420:
+            try:
+                d = plane.PlaneDims(10240, 8, 16, 50)
+                s = synth.TrafficSpec(video_tracks=2, audio_tracks=6, tick_ms=20,
+                                      video_kbps=1500, svc=True)
+                r = device_bench(d, s, ticks=3, warmup=1)
+                result["northstar_10240rooms_50subs_tick_ms"] = r["device_tick_ms"]
+            except Exception as e:  # noqa: BLE001
+                result["northstar_error"] = f"{type(e).__name__}"
+        else:
+            result["northstar_skipped"] = "bench deadline guard"
 
     print(json.dumps(result))
 

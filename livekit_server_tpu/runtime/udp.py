@@ -422,7 +422,11 @@ class UDPMediaTransport(asyncio.DatagramProtocol):
         self._twcc_last_send = np.zeros((R, S), np.float64)
         self._twcc_last_recv = np.zeros((R, S), np.float64)
         self.egress_threads = 4
-        self.send_side_bwe = True  # config rtc.congestion_control.send_side_bwe
+        # config rtc.congestion_control.send_side_bwe — set ONCE at
+        # startup (before any subscriber registers): flipping it later
+        # does not refresh already-registered subscribers' fb_enabled
+        # entries (the gate is evaluated on bind/register/punch events).
+        self.send_side_bwe = True
         # RED (RFC 2198) opt-in per subscriber + per-(room, audio track)
         # ring of recent primary payloads (the byte half of the device's
         # encode plan; redreceiver.go).
