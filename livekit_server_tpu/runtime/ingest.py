@@ -287,56 +287,74 @@ class IngestBuffer:
         dropped = n - int(keep.sum())
         if dropped:
             self.dropped += dropped
-        r_, t_, k_ = room[keep], track[keep], k[keep]
-        idx = (r_, t_, k_)
-        self.sn[idx] = sn[keep] & 0xFFFF
-        self.ts[idx] = ts[keep].astype(np.int64).astype(np.int32)
-        self.layer[idx] = layer[keep]
-        self.temporal[idx] = temporal[keep]
-        self.keyframe[idx] = keyframe[keep]
-        self.layer_sync[idx] = layer_sync[keep]
-        self.begin_pic[idx] = begin_pic[keep]
-        self.end_frame[idx] = end_frame[keep]
-        self.pid[idx] = pid[keep]
-        self.tl0[idx] = tl0[keep]
-        self.keyidx[idx] = keyidx[keep]
-        self.size[idx] = size[keep]
-        self.frame_ms[idx] = frame_ms[keep]
-        self.audio_level[idx] = audio_level[keep]
-        self.arrival_rtp[idx] = arrival_rtp[keep].astype(np.int64).astype(np.int32)
-        self.ts_jump[idx] = np.where(ts_aligned[keep], -1, 3000)
-        self.valid[idx] = True
-        # Payload slab: one join in kept order.
-        lens = pay_length[keep].astype(np.int64)
-        starts = pay_start[keep].astype(np.int64)
+            (room, track, k, layer, sn, ts, ts_aligned, temporal, keyframe,
+             layer_sync, begin_pic, end_frame, marker, pid, tl0, keyidx,
+             size, frame_ms, audio_level, arrival_rtp, pay_start,
+             pay_length, dd_start, dd_length, dd_version) = (
+                a[keep] for a in (
+                    room, track, k, layer, sn, ts, ts_aligned, temporal,
+                    keyframe, layer_sync, begin_pic, end_frame, marker, pid,
+                    tl0, keyidx, size, frame_ms, audio_level, arrival_rtp,
+                    pay_start, pay_length, dd_start, dd_length, dd_version)
+            )
+        # else: the common no-overflow tick — no masked copies at all.
+        r_, t_, k_ = room, track, k
+        # One flat index shared by all the field scatters below — the
+        # repeated 3-D index math would otherwise dominate the writes.
+        fi = (r_.astype(np.int64) * T + t_) * K + k_
+
+        def put(arr, vals):
+            arr.reshape(-1)[fi] = vals
+
+        put(self.sn, sn & 0xFFFF)
+        put(self.ts, ts.astype(np.int64).astype(np.int32))
+        put(self.layer, layer)
+        put(self.temporal, temporal)
+        put(self.keyframe, keyframe)
+        put(self.layer_sync, layer_sync)
+        put(self.begin_pic, begin_pic)
+        put(self.end_frame, end_frame)
+        put(self.pid, pid)
+        put(self.tl0, tl0)
+        put(self.keyidx, keyidx)
+        put(self.size, size)
+        put(self.frame_ms, frame_ms)
+        put(self.audio_level, audio_level)
+        put(self.arrival_rtp, arrival_rtp.astype(np.int64).astype(np.int32))
+        put(self.ts_jump, np.where(ts_aligned, -1, 3000))
+        put(self.valid, True)
+        # Payload slab: one join in kept order (arrays already masked
+        # above when the tick overflowed).
+        lens = pay_length.astype(np.int64)
+        starts = pay_start.astype(np.int64)
         offs = len(self._slab) + np.r_[np.int64(0), np.cumsum(lens[:-1])]
         # Header-only packets keep pay_off = -1 (push() semantics): they
         # feed stats but must not emit empty datagrams on egress.
-        self.pay_off[idx] = np.where(lens > 0, offs, -1)
-        self.pay_len[idx] = lens
-        self.marker[idx] = marker[keep]
+        put(self.pay_off, np.where(lens > 0, offs, -1))
+        put(self.pay_len, lens)
+        put(self.marker, marker)
         blob_arr = (
             blob if isinstance(blob, np.ndarray)
             else np.frombuffer(blob, np.uint8)
         )
         self._slab += _gather_ranges(blob_arr, starts, lens)
         # DD extension bytes (SVC): appended after the payload bytes.
-        dmask = dd_start[keep] >= 0
+        dmask = dd_start >= 0
         if dmask.any():
-            dstarts = dd_start[keep][dmask].astype(np.int64)
-            dlens = dd_length[keep][dmask].astype(np.int64)
+            dstarts = dd_start[dmask].astype(np.int64)
+            dlens = dd_length[dmask].astype(np.int64)
             doffs = len(self._slab) + np.r_[np.int64(0), np.cumsum(dlens[:-1])]
             didx = (r_[dmask], t_[dmask], k_[dmask])
             self.dd_off[didx] = doffs
             self.dd_len[didx] = dlens
-            self.dd_ver[didx] = dd_version[keep][dmask]
+            self.dd_ver[didx] = dd_version[dmask]
             self._slab += _gather_ranges(blob_arr, dstarts, dlens)
         # New per-group counts (capped at K).
         uniq_rt = sorted_rt[grp_start]
         self._count.reshape(-1)[uniq_rt] = np.minimum(
             K, base[order][grp_start] + sizes
         )
-        return int(keep.sum())
+        return len(r_)
 
     def push_twcc_feedback(
         self, room: int, sub: int, delay_sum_ms: float, n_deltas: int,

@@ -133,6 +133,7 @@ class HostSequencer:
         R, S = dims.rooms, dims.subs
         self._tk = dims.tracks * dims.pkts
         self._k = dims.pkts
+        self._s = S
         self.budget = np.full((R, S), self.BUDGET_PER_S, np.int32)
         self._budget_refill_ms = np.zeros((R, S), np.int64)
         shape = (R, S, self.RING)
@@ -154,14 +155,19 @@ class HostSequencer:
         slot = batch.sn & (self.RING - 1)
         r, s = batch.rooms, batch.subs
         w = tick_idx % plane.SLAB_WINDOW
-        self.key[r, s, slot] = w * self._tk + batch.tracks * self._k + batch.ks
-        self.sn[r, s, slot] = batch.sn & 0xFFFF
-        self.track[r, s, slot] = batch.tracks
-        self.ts[r, s, slot] = batch.ts.astype(np.int64) & 0xFFFFFFFF
-        self.pid[r, s, slot] = batch.pid
-        self.tl0[r, s, slot] = batch.tl0
-        self.keyidx[r, s, slot] = batch.keyidx
-        self.at_tick[r, s, slot] = tick_idx
+        # One flat index shared by all eight scatters (recomputing the
+        # 3-D index math per field costs more than the writes themselves).
+        flat = (r.astype(np.int64) * self._s + s) * self.RING + slot
+        self.key.reshape(-1)[flat] = (
+            w * self._tk + batch.tracks * self._k + batch.ks
+        )
+        self.sn.reshape(-1)[flat] = batch.sn & 0xFFFF
+        self.track.reshape(-1)[flat] = batch.tracks
+        self.ts.reshape(-1)[flat] = batch.ts.astype(np.int64) & 0xFFFFFFFF
+        self.pid.reshape(-1)[flat] = batch.pid
+        self.tl0.reshape(-1)[flat] = batch.tl0
+        self.keyidx.reshape(-1)[flat] = batch.keyidx
+        self.at_tick.reshape(-1)[flat] = tick_idx
 
     def clear_room(self, room: int) -> None:
         self.sn[room] = -1
@@ -564,8 +570,11 @@ class PlaneRuntime:
         # loop became pure array math).
         K, S = self.dims.pkts, self.dims.subs
         idx = out.egress_idx
+        E = idx.shape[1]
         rr, ee = np.nonzero(idx >= 0)
-        flat = idx[rr, ee]
+        # Shared flat index for the six field gathers.
+        fidx = rr * E + ee
+        flat = idx.reshape(-1)[fidx]
         tt, rem = np.divmod(flat, K * S)
         kk, ss = np.divmod(rem, S)
         batch = EgressBatch(
@@ -573,11 +582,11 @@ class PlaneRuntime:
             tracks=tt.astype(np.int32),
             ks=kk.astype(np.int32),
             subs=ss.astype(np.int32),
-            sn=out.egress_sn[rr, ee],
-            ts=out.egress_ts[rr, ee],
-            pid=out.egress_pid[rr, ee],
-            tl0=out.egress_tl0[rr, ee],
-            keyidx=out.egress_keyidx[rr, ee],
+            sn=out.egress_sn.reshape(-1)[fidx],
+            ts=out.egress_ts.reshape(-1)[fidx],
+            pid=out.egress_pid.reshape(-1)[fidx],
+            tl0=out.egress_tl0.reshape(-1)[fidx],
+            keyidx=out.egress_keyidx.reshape(-1)[fidx],
             payloads=payloads,
         )
         overflow = int(out.egress_overflow.sum())

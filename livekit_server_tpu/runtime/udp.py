@@ -1695,8 +1695,12 @@ class UDPMediaTransport(asyncio.DatagramProtocol):
                 self.send_egress(batch.to_packets(has_dest & pace_ok))
             return has_dest
 
-        po = batch.payloads.off[r, t, k]
-        pl = batch.payloads.length[r, t, k]
+        # Shared flat index for the slab-field gathers (off/length/marker).
+        _T = batch.payloads.off.shape[1]
+        _K = batch.payloads.off.shape[2]
+        flat_rtk = (r.astype(np.int64) * _T + t) * _K + k
+        po = batch.payloads.off.reshape(-1)[flat_rtk]
+        pl = batch.payloads.length.reshape(-1)[flat_rtk]
         # RED-negotiated audio entries leave the fast path: their payloads
         # are re-encapsulated per RFC 2198 from the device's plan.
         now_ms = asyncio.get_event_loop().time() * 1000.0
@@ -1794,7 +1798,9 @@ class UDPMediaTransport(asyncio.DatagramProtocol):
                 fd=fd, n_threads=self.egress_threads,
                 slab=batch.payloads.data,
                 pay_off=po[idx], pay_len=pl[idx],
-                marker=batch.payloads.marker[r, t, k][idx].astype(np.uint8),
+                marker=batch.payloads.marker.reshape(-1)[
+                    flat_rtk[idx]
+                ].astype(np.uint8),
                 pt=self._track_pt[rr_, tt_],
                 vp8=(
                     self._track_is_video[rr_, tt_] & ~self._track_svc[rr_, tt_]

@@ -85,25 +85,19 @@ def test_graft_entry_contract():
     ge.dryrun_multichip(8)
 
 
-def test_sharded_tick_with_pallas_kernels_interpreted(mesh):
-    """The TPU hot path runs the Pallas allocation + selection kernels
-    INSIDE the room-vmapped, mesh-sharded tick (vmap batching rule under
-    pjit). No multi-chip TPU is available here, so validate the
-    composition in interpreter mode on the CPU mesh: kernels forced on,
-    results must match the scan-formulation sharded tick exactly.
-
-    Known environment limit: under EXTREME CPU oversubscription (the
-    suite sharing the box with 4x synthetic load burners) the XLA:CPU
-    runtime has aborted the process inside this test while materializing
-    the interpret-mode result (SIGABRT in native code; Python stack ends
-    in jax Array.__array__). Reproduced only under that load shape,
-    never in a normally-loaded run; no product path executes
-    interpret-mode Pallas. If it fires in CI, suspect the machine, not
-    the kernels."""
+def _interpreted_pallas_body() -> None:
+    """Body of the interpret-mode equivalence check; run in a SUBPROCESS
+    (see the test below) because jax's interpret-mode Pallas execution
+    under pjit has intermittently SIGABRTed inside the XLA:CPU runtime
+    while materializing results (native abort; Python stack ends in
+    Array.__array__ — a jax/XLA runtime issue, no product path runs
+    interpret-mode Pallas). In-process, that abort would kill the whole
+    suite."""
     import functools
 
     from livekit_server_tpu.ops import allocation, selector
 
+    mesh = make_mesh()
     dims = plane.PlaneDims(rooms=8, tracks=4, pkts=4, subs=4)
     spec = synth.TrafficSpec(video_tracks=2, audio_tracks=1)
     state = _setup(dims, spec)
@@ -131,4 +125,53 @@ def test_sharded_tick_with_pallas_kernels_interpreted(mesh):
     jax.tree.map(
         lambda a, b: np.testing.assert_array_equal(np.asarray(a), np.asarray(b)),
         ref_state, p_state,
+    )
+
+
+def test_sharded_tick_with_pallas_kernels_interpreted():
+    """The TPU hot path runs the Pallas allocation + selection kernels
+    INSIDE the room-vmapped, mesh-sharded tick (vmap batching rule under
+    pjit). No multi-chip TPU is available here, so validate the
+    composition in interpreter mode on the CPU mesh: kernels forced on,
+    results must match the scan-formulation sharded tick exactly.
+
+    Runs in a subprocess with one retry: the equivalence assertions run
+    inside the child (a mismatch exits nonzero and fails here), while the
+    XLA:CPU runtime's intermittent interpret-mode SIGABRT (see
+    _interpreted_pallas_body) cannot take the suite down — a genuine
+    kernel-mismatch failure is deterministic and survives the retry."""
+    import os
+    import subprocess
+    import sys
+
+    # sitecustomize forces the ambient platform via jax.config, so the
+    # child must rewrite it before any jax operation (env alone won't).
+    code = (
+        "import jax; jax.config.update('jax_platforms', 'cpu'); "
+        "import tests.test_parallel as tp; tp._interpreted_pallas_body(); "
+        "print('interpret-equivalence ok')"
+    )
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    flags = env.get("XLA_FLAGS", "")
+    if "xla_force_host_platform_device_count" not in flags:
+        env["XLA_FLAGS"] = (
+            flags + " --xla_force_host_platform_device_count=8"
+        ).strip()
+    last = None
+    for _attempt in range(2):
+        last = subprocess.run(
+            [sys.executable, "-c", code],
+            cwd=os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+            env=env, capture_output=True, text=True, timeout=600,
+        )
+        if last.returncode == 0:
+            return
+        # Negative returncode = killed by signal (the known XLA abort):
+        # retry once. An assertion failure (rc=1) is real — fail fast.
+        if last.returncode > 0:
+            break
+    raise AssertionError(
+        f"interpret-mode equivalence subprocess failed rc={last.returncode}\n"
+        f"{last.stdout[-2000:]}\n{last.stderr[-3000:]}"
     )
