@@ -149,15 +149,27 @@ class _Allocation:
         # Continuity pin (v2 BINDs): sha256(next reveal)[:16], or None for
         # v1 clients whose moves are token-gated only.
         self.commit: bytes | None = None
-        # Token nonces already accepted on this allocation (insertion-
-        # ordered; bounded). A BIND reusing a seen nonce is a replay and
-        # can never move the allocation or touch the pin.
-        self.seen_nonces: dict[bytes, None] = {}
+        # Token nonces already accepted on this allocation → token expiry
+        # (ms). A BIND reusing a seen nonce is a replay and can never move
+        # the allocation or touch the pin. Eviction is expiry-aware, not
+        # FIFO: an entry leaves the set only once its token has expired
+        # (at which point verify_relay_token rejects the replay anyway),
+        # so a spent nonce can never be replayed within its token's
+        # lifetime. Over-cap with >MAX_SEEN unexpired tokens (requires the
+        # server to mint >256 live tokens for one session) evicts the
+        # soonest-to-expire entry — the tightest remaining replay window.
+        self.seen_nonces: dict[bytes, int] = {}
 
-    def spend_nonce(self, nonce: bytes) -> None:
-        self.seen_nonces[nonce] = None
-        while len(self.seen_nonces) > self.MAX_SEEN_NONCES:
-            self.seen_nonces.pop(next(iter(self.seen_nonces)))
+    def spend_nonce(self, nonce: bytes, expiry_ms: int) -> None:
+        self.seen_nonces[nonce] = expiry_ms
+        if len(self.seen_nonces) > self.MAX_SEEN_NONCES:
+            now_ms = time.time() * 1000
+            for n, exp in list(self.seen_nonces.items()):
+                if exp < now_ms:
+                    del self.seen_nonces[n]
+            while len(self.seen_nonces) > self.MAX_SEEN_NONCES:
+                del self.seen_nonces[min(self.seen_nonces,
+                                         key=self.seen_nonces.get)]
 
 
 class MediaRelay(asyncio.DatagramProtocol):
@@ -229,6 +241,7 @@ class MediaRelay(asyncio.DatagramProtocol):
             self._reject(addr)
             return
         nonce = token[12:16]  # payload = expiry(8) | key_id(4) | nonce(4)
+        expiry_ms = int.from_bytes(token[:8], "big")
         alloc = self.allocs.get(key_id)
         if alloc is None:
             if key_id in self._pending:
@@ -254,7 +267,7 @@ class MediaRelay(asyncio.DatagramProtocol):
                 self._pending.discard(key_id)
             alloc = _Allocation(key_id, addr, proto)
             alloc.commit = commit  # None for v1 clients
-            alloc.spend_nonce(nonce)
+            alloc.spend_nonce(nonce, expiry_ms)
             self.allocs[key_id] = alloc
         else:
             # Origin authorization (see module docstring): a valid chain
@@ -290,7 +303,7 @@ class MediaRelay(asyncio.DatagramProtocol):
                 # when origin-authorized, so a source-spoofed replay of an
                 # old v2 BIND cannot reset the pin to a spent commitment.
                 alloc.commit = commit
-            alloc.spend_nonce(nonce)
+            alloc.spend_nonce(nonce, expiry_ms)
         alloc.last_active = time.monotonic()
         self.by_client[addr] = alloc
         self.stats["binds"] += 1

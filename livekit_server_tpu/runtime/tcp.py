@@ -88,6 +88,11 @@ class TCPMediaTransport:
                             "tcp", bound_key,
                         )
                         self.udp._touch_subs()
+                        # TCP egress carries no TWCC counters; without this
+                        # refresh a sub that had a UDP address would keep
+                        # fb_enabled=True, never ack, and starve its BWE
+                        # budget to the floor.
+                        self.udp._refresh_fb_enabled(session.room, session.sub)
                 self.udp._dispatch_inner(inner, ("tcp", session.key_id), session)
         except (asyncio.IncompleteReadError, ConnectionResetError):
             pass
@@ -100,6 +105,7 @@ class TCPMediaTransport:
                 for k, v in list(self.udp.sub_addrs.items()):
                     if v == ("tcp", bound_key):
                         del self.udp.sub_addrs[k]
+                        self.udp._refresh_fb_enabled(*k)
                 self.udp._touch_subs()
             writer.close()
 

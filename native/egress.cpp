@@ -308,8 +308,15 @@ int64_t send_gso(const Args& a, int lo, int hi, int* resume) {
       }
       if (errno == EINVAL || errno == EOPNOTSUPP || errno == ENOTSUP ||
           errno == EMSGSIZE || errno == EIO) {
-        *resume = run_first[done];  // caller re-sends plain from here
-        return sent;
+        if (run_cnt[done] > 1) {
+          *resume = run_first[done];  // caller re-sends plain from here
+          return sent;
+        }
+        // Single-datagram message carries no UDP_SEGMENT cmsg, so this
+        // is a per-destination error (e.g. PMTU), not GSO refusal —
+        // skip the entry and keep the GSO fast path alive.
+        done++;
+        continue;
       }
       return sent;  // hard error: drop the remainder
     }

@@ -685,6 +685,45 @@ async def test_tcp_media_fallback():
         tcp.close()
 
 
+async def test_tcp_fallback_disables_twcc_feedback():
+    """A subscriber that falls back from UDP to TCP must have
+    fb_enabled cleared: TCP egress stamps no TWCC counters, so a stale
+    True would starve its BWE budget to the floor (advisor r3 medium)."""
+    from livekit_server_tpu.runtime.crypto import MediaCryptoClient, MediaCryptoRegistry
+    from livekit_server_tpu.runtime.tcp import start_tcp_transport
+    from livekit_server_tpu.runtime.udp import UDPMediaTransport
+    from tests.conftest import free_port
+
+    runtime = PlaneRuntime(DIMS, tick_ms=10)
+    reg = MediaCryptoRegistry()
+    udp = UDPMediaTransport(runtime.ingest, crypto=reg, require_encryption=True)
+    port = free_port(socket.SOCK_STREAM)
+    tcp = await start_tcp_transport(udp, reg, "127.0.0.1", port)
+    try:
+        runtime.set_track(0, 0, published=True, is_video=False)
+        runtime.set_subscription(0, 0, 1, subscribed=True)
+        sub_sess = reg.mint()
+        udp.bind_sub_session(0, 1, sub_sess)
+        udp.register_subscriber(0, 1, ("127.0.0.1", 50000))
+        assert bool(runtime.ingest.fb_enabled[0, 1])  # sealed UDP: TWCC on
+        bob = MediaCryptoClient(sub_sess.key_id, sub_sess.key)
+        r, w = await asyncio.open_connection("127.0.0.1", port)
+        hello = bytes([0x80, 201, 0, 1]) + (0x1234).to_bytes(4, "big")
+        sealed = bob.seal(hello)
+        w.write(len(sealed).to_bytes(2, "big") + sealed)
+        await w.drain()
+        await asyncio.sleep(0.1)
+        assert udp.sub_addrs.get((0, 1)) == ("tcp", sub_sess.key_id)
+        assert not bool(runtime.ingest.fb_enabled[0, 1])  # TCP: TWCC off
+        w.close()
+        await asyncio.sleep(0.1)
+        # Teardown removed the route entirely — still no feedback expected.
+        assert (0, 1) not in udp.sub_addrs
+        assert not bool(runtime.ingest.fb_enabled[0, 1])
+    finally:
+        tcp.close()
+
+
 async def test_udp_unknown_ssrc_dropped():
     runtime = PlaneRuntime(DIMS, tick_ms=10)
     s = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
