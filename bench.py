@@ -110,7 +110,19 @@ def device_bench(dims, spec, ticks: int, warmup: int) -> dict:
     }
 
 
-# -- host-path forward latency ---------------------------------------------
+# -- real-time wire bench ---------------------------------------------------
+#
+# Replaces the r3 composed p99 (VERDICT r3 missing #2 / next #1 and #4):
+# the production serving loop runs at real tick cadence; publishers put
+# raw RTP on the server's actual UDP socket; 1-in-6 subscribers is a
+# sealed "modern" client whose egress carries TWCC counters and whose
+# reader task acks them with RTPFB fmt-15 frames through the server's
+# real RTCP path (_handle_twcc exercised on every feedback); the rest are
+# cleartext "legacy" clients driving the estimate channel with REMB
+# frames — no direct ingest._estimate injection anywhere. Per-packet
+# forward latency comes from the always-on ForwardLatencyProbe
+# (recvmmsg-return → native-send-return), so the reported p50/p99 are
+# wall-clock measurements that INCLUDE tick-queueing wait.
 
 def _vp8_descriptor(pid: int, tl0: int, tid: int, sbit: bool, keyframe: bool) -> bytes:
     """Minimal VP8 payload descriptor (X, I 15-bit pid, L, T) + the first
@@ -122,70 +134,165 @@ def _vp8_descriptor(pid: int, tl0: int, tid: int, sbit: bool, keyframe: bool) ->
     )
 
 
-def _build_tick_datagrams(ssrcs, counts, sn0, tick, spec):
-    """Raw RTP datagrams for one tick (what publishers put on the wire).
-    One frame per track per tick: the first packet starts the picture
-    (S bit), and keyframes arrive on the device bench's cadence (1/100
-    ticks) — not on every packet."""
-    out = []
-    for (r, t, is_video, ssrc), n in zip(ssrcs, counts):
-        for k in range(n):
-            sn = (sn0[(r, t)] + k) & 0xFFFF
-            ts = (tick * (90 * spec.tick_ms if is_video else 48 * spec.tick_ms)) & 0xFFFFFFFF
-            hdr = bytearray(12)
-            hdr[0] = 0x80
-            hdr[1] = (0x80 if k == n - 1 else 0) | (96 if is_video else 111)
-            hdr[2:4] = sn.to_bytes(2, "big")
-            hdr[4:8] = ts.to_bytes(4, "big")
-            hdr[8:12] = ssrc.to_bytes(4, "big")
-            if is_video:
-                # Keyframes every 10 ticks: the cadence PLI-driven recovery
-                # produces (the selector locks only at keyframes and the
-                # bench publisher can't answer live PLIs).
-                payload = _vp8_descriptor(
-                    tick & 0x7FFF, tick & 0xFF, k % 2,
-                    sbit=k == 0, keyframe=tick % 10 == 0 and k == 0,
-                ) + bytes(1100)
-            else:
-                payload = bytes(80)
-            out.append(bytes(hdr) + payload)
-        sn0[(r, t)] = (sn0[(r, t)] + n) & 0xFFFF
-    return out
+def _build_traffic_lib(ssrcs, tick_ms: int, n_ticks: int, video_kbps: float):
+    """A cyclable library of per-tick publisher datagram batches.
 
-
-async def host_path_bench(dims, spec, ticks: int, device_tick_ms: float) -> dict:
-    """End-to-end through the real runtime: datagram dispatch → native
-    parse → ingest → device tick → egress rewrite → UDP socket writes.
-
-    Per-tick host time = wall time minus the (tunnel-inflated) in-loop
-    device step; the chained device_tick_ms is added back for the
-    reported forward latency.
+    Each tick entry: a writable blob + per-datagram (offset, length,
+    stream index, built-in SN/TS). On every reuse cycle the publisher
+    patches SN/TS in place (vectorized big-endian writes) so streams stay
+    continuous forever — SNs advance by each stream's per-cycle packet
+    count, TS by the library's wall span.
     """
-    import jax  # noqa: F401  (backend already selected by main)
+    v_pps = video_kbps * 125.0 / 1200.0          # 1200-byte video packets
+    kf_every = max(1, 200 // tick_ms)            # keyframe each ~200 ms
+    a_every = max(1, 20 // tick_ms)              # Opus: one packet / 20 ms
+    sn_next = {i: 0 for i in range(len(ssrcs))}
+    lib = []
+    for tick in range(n_ticks):
+        dgrams, sidx, sns, tss = [], [], [], []
+        for i, (r, t, is_video, ssrc) in enumerate(ssrcs):
+            if is_video:
+                n = int((tick + 1) * v_pps * tick_ms / 1000.0) - int(
+                    tick * v_pps * tick_ms / 1000.0
+                )
+                ts = (tick * 90 * tick_ms) & 0xFFFFFFFF
+            else:
+                n = 1 if tick % a_every == 0 else 0
+                ts = (tick * 48 * tick_ms) & 0xFFFFFFFF
+            for k in range(n):
+                sn = sn_next[i]
+                sn_next[i] += 1
+                hdr = bytearray(12)
+                hdr[0] = 0x80
+                hdr[1] = (0x80 if k == n - 1 else 0) | (96 if is_video else 111)
+                hdr[2:4] = (sn & 0xFFFF).to_bytes(2, "big")
+                hdr[4:8] = ts.to_bytes(4, "big")
+                hdr[8:12] = ssrc.to_bytes(4, "big")
+                if is_video:
+                    payload = _vp8_descriptor(
+                        tick & 0x7FFF, tick & 0xFF, k % 2, sbit=k == 0,
+                        keyframe=tick % kf_every == 0 and k == 0,
+                    ) + bytes(1100)
+                else:
+                    payload = bytes(80)
+                dgrams.append(bytes(hdr) + payload)
+                sidx.append(i)
+                sns.append(sn)
+                tss.append(ts)
+        blob, offs, lens = _stage_frames(dgrams)
+        lib.append({
+            "blob": blob.copy(),
+            "offs": offs, "lens": lens,
+            "sidx": np.array(sidx, np.int64),
+            "sn0": np.array(sns, np.int64),
+            "ts0": np.array(tss, np.int64),
+        })
+    sn_per_cycle = np.array([sn_next[i] for i in range(len(ssrcs))], np.int64)
+    ts_per_cycle = np.array(
+        [n_ticks * (90 if v else 48) * tick_ms for (_, _, v, _) in ssrcs],
+        np.int64,
+    )
+    return lib, sn_per_cycle, ts_per_cycle
 
-    from livekit_server_tpu.models import plane
-    from livekit_server_tpu.runtime import PlaneRuntime
-    from livekit_server_tpu.runtime.udp import start_udp_transport
 
+def _stage_frames(frames: list) -> tuple:
+    """frames → (blob, offs int64, lens int32) in native send_raw layout."""
+    lens = np.array([len(f) for f in frames], np.int32)
+    offs = np.zeros(len(frames), np.int64)
+    if len(frames) > 1:
+        np.cumsum(lens[:-1].astype(np.int64), out=offs[1:])
+    return np.frombuffer(b"".join(frames), np.uint8), offs, lens
+
+
+def _patch_tick(entry, cycle: int, sn_pc, ts_pc) -> None:
+    """Advance one library tick's SN/TS fields for reuse cycle `cycle`."""
+    if cycle == 0 or not len(entry["offs"]):
+        return
+    blob, offs = entry["blob"], entry["offs"]
+    s = entry["sidx"]
+    sn = (entry["sn0"] + cycle * sn_pc[s]) & 0xFFFF
+    ts = (entry["ts0"] + cycle * ts_pc[s]) & 0xFFFFFFFF
+    blob[offs + 2] = sn >> 8
+    blob[offs + 3] = sn & 0xFF
+    blob[offs + 4] = ts >> 24
+    blob[offs + 5] = (ts >> 16) & 0xFF
+    blob[offs + 6] = (ts >> 8) & 0xFF
+    blob[offs + 7] = ts & 0xFF
+
+
+async def wire_bench(
+    dims,
+    tick_ms: int = 5,
+    duration_s: float = 8.0,
+    warm_ticks: int = 30,
+    video_tracks: int = 4,
+    audio_tracks: int = 4,
+    video_kbps: float = 3000.0,
+    ack_ms: float = 25.0,
+    n_slices: int = 4,
+    warm_timeout_s: float = 120.0,
+) -> dict:
+    """Real-time serving-loop measurement (see module-section comment).
+
+    Everything reported here is measured wall-clock on this process's real
+    sockets — publisher → kernel → recvmmsg → parse/stage → device tick →
+    egress build/seal → kernel send — with tick-queueing wait included via
+    the ForwardLatencyProbe stamps. On a tunneled dev chip the device
+    round trip dominates; `tunnel_rtt_ms` is measured alongside so the
+    floor is visible in the record.
+    """
     import socket as _socket
 
-    runtime = PlaneRuntime(dims, tick_ms=spec.tick_ms)
-    udp = await start_udp_transport(runtime.ingest, host="127.0.0.1", port=0)
+    import jax.numpy as jnp
 
-    # A loopback receiver socket so egress hits the real kernel send path.
-    # Deliberately NEVER read (and not registered with asyncio): a real
-    # subscriber is a remote host — an in-process Python consumer would
-    # bill ~5k asyncio callbacks/tick of its own cost to the SFU's
-    # forward-latency measurement. Packets beyond rcvbuf drop in-kernel.
-    loop = asyncio.get_running_loop()
-    sink_sock = _socket.socket(_socket.AF_INET, _socket.SOCK_DGRAM)
-    sink_sock.bind(("127.0.0.1", 0))
-    sink_sock.setblocking(False)
-    sink_addr = sink_sock.getsockname()
+    from livekit_server_tpu.runtime import PlaneRuntime
+    from livekit_server_tpu.runtime.crypto import (
+        MediaCryptoClient,
+        MediaCryptoRegistry,
+    )
+    from livekit_server_tpu.native import egress as native_egress
+    from livekit_server_tpu.runtime.udp import (
+        build_remb,
+        build_twcc_feedback,
+        start_udp_transport,
+    )
 
-    nv = min(spec.video_tracks, dims.tracks)
-    used = min(nv + spec.audio_tracks, dims.tracks)
+    # Device round-trip floor of this rig (dispatch + fetch of a trivial
+    # computation) — the part of the measured latency no host design can
+    # remove on a tunneled chip. One throwaway call pays the compile.
+    int(jnp.zeros((), jnp.int32) + 1)
+    rtts = []
+    for _ in range(3):
+        t0 = time.perf_counter()
+        int(jnp.zeros((), jnp.int32) + 1)
+        rtts.append(time.perf_counter() - t0)
+    tunnel_rtt_ms = round(float(np.median(rtts)) * 1000.0, 2)
+
+    runtime = PlaneRuntime(dims, tick_ms=tick_ms)
+    reg = MediaCryptoRegistry()
+    udp = await start_udp_transport(
+        runtime.ingest, host="127.0.0.1", port=0, crypto=reg
+    )
+    srv_addr = udp.transport.get_extra_info("sockname")
+    srv_ip, srv_port = 0x7F000001, srv_addr[1]
+
+    def mk_sock():
+        s = _socket.socket(_socket.AF_INET, _socket.SOCK_DGRAM)
+        s.bind(("127.0.0.1", 0))
+        s.setblocking(False)
+        s.setsockopt(_socket.SOL_SOCKET, _socket.SO_RCVBUF, 4 << 20)
+        s.setsockopt(_socket.SOL_SOCKET, _socket.SO_SNDBUF, 4 << 20)
+        return s
+
+    pub_sock = mk_sock()    # all publisher streams
+    ack_sock = mk_sock()    # sealed cohort sink + TWCC feedback source
+    sink_sock = mk_sock()   # legacy cohort sink (never read) + REMB source
+
+    nv = min(video_tracks, dims.tracks)
+    used = min(nv + audio_tracks, dims.tracks)
     ssrcs = []
+    acked = []   # (room, sub, session, client, media_ssrc)
+    remb_subs = []
     for r in range(dims.rooms):
         for t in range(used):
             is_video = t < nv
@@ -193,137 +300,270 @@ async def host_path_bench(dims, spec, ticks: int, device_tick_ms: float) -> dict
             runtime.set_track(r, t, published=True, is_video=is_video)
             ssrcs.append((r, t, is_video, ssrc))
         for s in range(dims.subs):
-            udp.register_subscriber(r, s, sink_addr)
             for t in range(used):
                 runtime.set_subscription(r, t, s, subscribed=True)
+            if s == 0:
+                # Modern client: sealed egress (TWCC counters on the wire).
+                sess = reg.mint()
+                udp.bind_sub_session(r, s, sess)
+                udp.register_subscriber(r, s, ack_sock.getsockname())
+                client = MediaCryptoClient(sess.key_id, sess.key)
+                acked.append([r, s, sess, client, 0])
+            else:
+                udp.register_subscriber(r, s, sink_sock.getsockname())
+                remb_subs.append((r, s))
+    # The sealed cohort announces itself (client_active latch → fb_enabled);
+    # a tiny sealed RTCP RR is the hello real SDK clients send first.
+    hello = bytes([0x80, 201, 0, 1]) + (0x1234).to_bytes(4, "big")
+    for ent in acked:
+        ack_sock.sendto(ent[3].seal(hello), ("127.0.0.1", srv_port))
+    await asyncio.sleep(0.1)
+    for ent in acked:
+        ent[4] = udp.subscriber_ssrc(ent[0], ent[1], 0)
+    kid_to_ent = {ent[2].key_id: ent for ent in acked}
 
-    # Instrument the device step so the in-loop (tunnel-priced) device time
-    # can be subtracted from each tick's wall time.
-    dev_times = []
+    # Publisher library: 1 s of traffic, cycled with in-place SN/TS patch.
+    lib, sn_pc, ts_pc = _build_traffic_lib(
+        ssrcs, tick_ms, max(1, 1000 // tick_ms), video_kbps
+    )
+    for e in lib:
+        n = len(e["offs"])
+        e["ips"] = np.full(n, srv_ip, np.uint32)
+        e["ports"] = np.full(n, srv_port, np.uint16)
+        # Slice bounds for sub-tick arrival spreading.
+        e["cuts"] = np.linspace(0, n, n_slices + 1).astype(np.int64)
+
+    # REMB blob (legacy cohort estimate channel): rebuilt never — the
+    # frames are stateless; one send_raw per interval from the sink sock.
+    est_bps = 1.25 * 1000.0 * (video_tracks * video_kbps + audio_tracks * 64.0)
+    remb_frames = [
+        build_remb(0x42, est_bps, [udp.subscriber_ssrc(r, s, 0)])
+        for (r, s) in remb_subs
+    ]
+    remb_blob, remb_offs, remb_lens = _stage_frames(remb_frames)
+    remb_ips = np.full(len(remb_frames), srv_ip, np.uint32)
+    remb_ports = np.full(len(remb_frames), srv_port, np.uint16)
+
+    # Instrument device wall time (per in-loop call) + per-tick host work.
+    dev_s = [0.0]
     orig_step = runtime._device_step
 
     def timed_step(inp):
         t0 = time.perf_counter()
         out = orig_step(inp)
-        dev_times.append(time.perf_counter() - t0)
+        dev_s[0] += time.perf_counter() - t0
         return out
 
     runtime._device_step = timed_step
-    runtime.on_tick(lambda res: udp.send_egress_batch(res.egress_batch))
+    tick_acc = [0, 0.0]  # ticks seen, Σ tick_s
 
-    rng = np.random.default_rng(0)
-    sn0 = {(r, t): int(rng.integers(0, 1 << 16)) for (r, t, _v, _s) in ssrcs}
-    v_ppt = max(1, round(spec.video_kbps * 125 / 1200 / 1000 * spec.tick_ms))
-    counts = [v_ppt if is_video else 1 for (_, _, is_video, _) in ssrcs]
-    def stage(dgrams):
-        """Pre-pack one tick's datagrams in the batch-receive layout
-        (blob + offsets/lengths/src arrays — what rx_batch produces)."""
-        blob = np.frombuffer(b"".join(dgrams), np.uint8)
-        lens = np.array([len(d) for d in dgrams], np.int32)
-        offs = np.zeros(len(dgrams), np.int32)
-        np.cumsum(lens[:-1], out=offs[1:])
-        ips = np.full(len(dgrams), 0x7F000001, np.uint32)
-        ports = np.full(len(dgrams), 50000, np.uint16)
-        return blob, offs, lens, ips, ports
+    def on_tick(res):
+        udp.send_egress_batch(res.egress_batch, pacer_allowed=res.pacer_allowed)
+        tick_acc[0] += 1
+        tick_acc[1] += res.tick_s
 
-    pre = [
-        stage(_build_tick_datagrams(ssrcs, counts, sn0, i, spec))
-        for i in range(ticks + 2)
+    runtime.on_tick(on_tick)
+
+    stop = asyncio.Event()
+    import threading
+
+    stop_thr = threading.Event()
+    pub_stats = {"sent": 0, "skipped_ticks": 0}
+
+    def publisher_thread():
+        """Real-time load generator in its own OS thread: the asyncio
+        loop's long synchronous spans (rx callbacks, staging, fan-out)
+        would starve a task-based pacer. Behind-schedule slices are sent
+        in a burst; if the generator falls >0.5 s behind (overloaded
+        rig), whole ticks are skipped and counted rather than building an
+        unbounded backlog."""
+        period = tick_ms / 1000.0
+        slice_p = period / n_slices
+        i, cycle = 0, 0
+        next_at = time.perf_counter() + slice_p
+        pf = pub_sock.fileno()
+        while not stop_thr.is_set():
+            behind = time.perf_counter() - next_at
+            if behind > 0.5:
+                n_skip = int(behind / period)
+                pub_stats["skipped_ticks"] += n_skip
+                for _ in range(n_skip):
+                    next_at += period
+                    i += 1
+                    if i == len(lib):
+                        i, cycle = 0, cycle + 1
+                continue
+            e = lib[i]
+            _patch_tick(e, cycle, sn_pc, ts_pc)
+            cuts = e["cuts"]
+            for sl in range(n_slices):
+                lag = next_at - time.perf_counter()
+                if lag > 0:
+                    time.sleep(lag)
+                lo, hi = int(cuts[sl]), int(cuts[sl + 1])
+                if hi > lo:
+                    pub_stats["sent"] += native_egress.send_raw(
+                        pf, e["blob"], e["offs"][lo:hi], e["lens"][lo:hi],
+                        e["ips"][lo:hi], e["ports"][lo:hi],
+                    )
+                next_at += slice_p
+            i += 1
+            if i == len(lib):
+                i, cycle = 0, cycle + 1
+
+    async def acker():
+        """Sealed-cohort reader: drain egress, ack counters as RTPFB
+        fmt-15 through the server's real RTCP path."""
+        MAXN, MAXD = 2048, 2048
+        scratch = np.zeros(MAXN * MAXD, np.uint8)
+        offs = np.zeros(MAXN, np.int32)
+        lens = np.zeros(MAXN, np.int32)
+        ips = np.zeros(MAXN, np.uint32)
+        ports = np.zeros(MAXN, np.uint16)
+        af = ack_sock.fileno()
+        while not stop.is_set():
+            await asyncio.sleep(ack_ms / 1000.0)
+            frames = []
+            while True:
+                nn = native_egress.rx_batch(af, scratch, offs, lens, ips, ports, MAXD)
+                if nn <= 0:
+                    break
+                now_us = int(time.perf_counter() * 1e6)
+                o = offs[:nn].astype(np.int64)
+                sealed = scratch[o] == 0x01
+                if sealed.any():
+                    so = o[sealed]
+                    kid = (
+                        (scratch[so + 1].astype(np.int64) << 24)
+                        | (scratch[so + 2].astype(np.int64) << 16)
+                        | (scratch[so + 3].astype(np.int64) << 8)
+                        | scratch[so + 4]
+                    )
+                    ctr = np.zeros(len(so), np.int64)
+                    for b in range(8):
+                        ctr = (ctr << 8) | scratch[so + 6 + b].astype(np.int64)
+                    for k in np.unique(kid):
+                        ent = kid_to_ent.get(int(k))
+                        if ent is None:
+                            continue
+                        sel = np.sort(ctr[kid == k])
+                        # Counters in one feedback frame must span < 2^16
+                        # (ctr_off is u16): a kernel-drop gap can exceed
+                        # that — split at the discontinuity.
+                        lo = 0
+                        while lo < len(sel):
+                            hi = int(np.searchsorted(sel, sel[lo] + 0xFFFF))
+                            frames.append(build_twcc_feedback(
+                                0x42, ent[4],
+                                [(int(c), now_us) for c in sel[lo:hi]],
+                            ))
+                            lo = hi
+                if nn < MAXN:
+                    break
+            if frames:
+                fb_blob, fb_offs, fb_lens = _stage_frames(frames)
+                native_egress.send_raw(
+                    af, fb_blob, fb_offs, fb_lens,
+                    np.full(len(frames), srv_ip, np.uint32),
+                    np.full(len(frames), srv_port, np.uint16),
+                )
+
+    async def remb_pump():
+        while not stop.is_set():
+            native_egress.send_raw(
+                sink_sock.fileno(), remb_blob, remb_offs, remb_lens,
+                remb_ips, remb_ports,
+            )
+            await asyncio.sleep(0.2)
+
+    task_errors: list[str] = []
+
+    async def guarded(coro, name):
+        """A helper task dying mid-window must surface in the record, not
+        silently degrade the measurement."""
+        try:
+            await coro
+        except asyncio.CancelledError:
+            raise
+        except Exception as e:  # noqa: BLE001
+            task_errors.append(f"{name}: {type(e).__name__}: {e}")
+
+    tasks = [
+        asyncio.ensure_future(guarded(acker(), "acker")),
+        asyncio.ensure_future(guarded(remb_pump(), "remb")),
     ]
-    pre_pipe = [
-        stage(_build_tick_datagrams(ssrcs, counts, sn0, ticks + 2 + i, spec))
-        for i in range(max(10, ticks // 2))
-    ]
+    pub_thr = threading.Thread(target=publisher_thread, daemon=True)
+    pub_thr.start()
+    try:
+        runtime.start()
 
-    # Per-subscriber channel estimates (the REMB/TWCC samples real clients
-    # send): without them the allocator has no budget and pauses video.
-    est = spec.estimate_bps or 1.25 * 1000.0 * (
-        spec.video_tracks * spec.video_kbps + spec.audio_tracks * spec.audio_kbps
-    )
-
-    # Host time is the SUM of the directly-timed host segments (rx/stage
-    # before the device step, fan-out/egress after) rather than wall time
-    # minus the in-loop device call: through a tunneled dev chip the
-    # in-loop dispatch takes ~100 ms and its client-side marshaling
-    # contends with the measuring thread, inflating wall-minus-device by
-    # GIL-scheduling artifacts a locally-attached chip does not have. The
-    # segments below are the actual serialized per-tick host work.
-    host_ms = []
-    sent0 = 0
-    seq_t0 = time.perf_counter()
-    loop = asyncio.get_running_loop()
-    for i in range(ticks + 2):
-        if i == 2:  # first ticks pay jit compile; time/count from here
-            sent0 = udp.stats["tx"]
-            seq_t0 = time.perf_counter()
+        # Warm-up: first ticks pay jit compile; wait for steady state.
         t0 = time.perf_counter()
-        blob, offs, lens, ips, ports_a = pre[i]
-        udp.feed_batch(blob, offs, lens, ips, ports_a, len(offs))
-        udp._flush_rx()  # asyncio-path drain (no-op after feed_batch)
-        runtime.ingest._estimate[:] = est
-        runtime.ingest._estimate_valid[:] = True
-        staged = runtime._stage()
-        pre_dev = time.perf_counter() - t0
-        out = await loop.run_in_executor(
-            runtime._executor, runtime._device_step, staged[0]
-        )
-        t1 = time.perf_counter()
-        runtime._mirror_probe_inputs(out)
-        await runtime._complete(out, *staged)  # on_tick → send_egress inside
-        post_dev = time.perf_counter() - t1
-        if i >= 2:
-            host_ms.append((pre_dev + post_dev) * 1000.0)
-    seq_wall = time.perf_counter() - seq_t0
-    sent = udp.stats["tx"] - sent0
+        while (
+            runtime.stats["ticks"] < warm_ticks
+            and time.perf_counter() - t0 < warm_timeout_s
+        ):
+            await asyncio.sleep(0.05)
 
-    # Pipelined serving-loop capacity: same per-tick work through the
-    # stage/dispatch/complete overlap the production _run loop uses —
-    # tick budget becomes max(device, host egress) + staging.
-    P = len(pre_pipe)
-    pending = None
-    pipe_t0 = time.perf_counter()
-    for i in range(P):
-        blob, offs, lens, ips, ports_a = pre_pipe[i]
-        udp.feed_batch(blob, offs, lens, ips, ports_a, len(offs))
-        udp._flush_rx()
-        runtime.ingest._estimate[:] = est
-        runtime.ingest._estimate_valid[:] = True
-        staged = runtime._stage()
-        fut = loop.run_in_executor(
-            runtime._executor, runtime._device_step, staged[0]
-        )
-        if pending is not None:
-            await runtime._complete(pending[0], *pending[1])
-        out = await fut
-        runtime._mirror_probe_inputs(out)
-        pending = (out, staged)
-    if pending is not None:
-        await runtime._complete(pending[0], *pending[1])
-    pipe_wall = time.perf_counter() - pipe_t0
+        # Measurement window: reset every counter the report reads.
+        udp.fwd_latency.reset()
+        dev_s[0] = 0.0
+        tick_acc[0], tick_acc[1] = 0, 0.0
+        base = {
+            "ticks": runtime.stats["ticks"],
+            "late": runtime.stats["late_ticks"],
+            "rx": udp.stats["rx"],
+            "tx": udp.stats["tx"],
+            "twcc": udp.stats.get("twcc_rx", 0),
+            "dropped": runtime.ingest.dropped,
+            "fwd": runtime.stats["fwd_packets"],
+        }
+        t_meas = time.perf_counter()
+        await asyncio.sleep(duration_s)
+        wall = time.perf_counter() - t_meas
+        probe = udp.fwd_latency.summary()
+        ticks = runtime.stats["ticks"] - base["ticks"]
+        tx = udp.stats["tx"] - base["tx"]
+        host_busy_s = max(tick_acc[1] - dev_s[0], 1e-9)
+    finally:
+        # The publisher floods ~280k pps: it MUST die even when the
+        # measurement throws, or every later bench section is corrupted.
+        stop.set()
+        stop_thr.set()
+        for t in tasks:
+            t.cancel()
+        await asyncio.gather(*tasks, return_exceptions=True)
+        pub_thr.join(timeout=2.0)
+        await runtime.stop()
+        runtime._device_step = orig_step
+        udp.transport.close()
+        pub_sock.close()
+        ack_sock.close()
+        sink_sock.close()
 
-    runtime._device_step = orig_step
-    udp.transport.close()
-    sink_sock.close()
-    await runtime.stop()
-
-    fwd = np.asarray(host_ms) + device_tick_ms
-    host_p50 = float(np.percentile(host_ms, 50)) if host_ms else 0.0
+    rx = udp.stats["rx"] - base["rx"]
+    dropped = runtime.ingest.dropped - base["dropped"]
     return {
-        "p50_forward_ms": round(float(np.percentile(fwd, 50)), 3),
-        "p99_forward_ms": round(float(np.percentile(fwd, 99)), 3),
-        "host_ms_p50": round(host_p50, 3),
-        "host_egress_pps": round(sent / (np.sum(host_ms) / 1000.0), 1)
-        if host_ms and sent else 0.0,
-        "wire_packets": int(sent),
-        # Wall-clock rates below include the dev tunnel's ~100 ms dispatch
-        # RTT per tick and are therefore tunnel-bound on this rig;
-        # tick_hz_local_estimate is what a locally-attached chip sustains
-        # (pipelined loop: host and device overlap, budget = max of both).
-        "tick_hz_sequential": round(ticks / seq_wall, 1) if seq_wall else 0.0,
-        "tick_hz_pipelined": round(P / pipe_wall, 1) if pipe_wall else 0.0,
-        "tick_hz_local_estimate": round(
-            1000.0 / max(host_p50, device_tick_ms, 1e-6), 1
-        ),
+        "tick_ms": tick_ms,
+        "p50_wire_ms": probe["p50_ms"],
+        "p99_wire_ms": probe["p99_ms"],
+        "mean_wire_ms": probe["mean_ms"],
+        "max_wire_ms": probe["max_ms"],
+        "lat_samples": probe["n"],
+        "tunnel_rtt_ms": tunnel_rtt_ms,
+        "ticks": ticks,
+        "achieved_tick_hz": round(ticks / wall, 1) if wall else 0.0,
+        "late_ticks": runtime.stats["late_ticks"] - base["late"],
+        "wire_in_pps": round(rx / wall, 1),
+        "wire_out_pps": round(tx / wall, 1),
+        "host_ms_per_tick": round(host_busy_s / max(ticks, 1) * 1000.0, 3),
+        "dev_ms_per_tick": round(dev_s[0] / max(ticks, 1) * 1000.0, 3),
+        "host_egress_pps": round(tx / host_busy_s, 1) if tx else 0.0,
+        "twcc_acks": udp.stats.get("twcc_rx", 0) - base["twcc"],
+        "ingest_dropped_pct": round(100.0 * dropped / max(rx, 1), 2),
+        "fwd_packets": runtime.stats["fwd_packets"] - base["fwd"],
+        "pub_skipped_ticks": pub_stats["skipped_ticks"],
+        **({"task_errors": task_errors} if task_errors else {}),
     }
 
 
@@ -341,6 +581,10 @@ def main() -> None:
     ap.add_argument("--cpu", action="store_true", help="force CPU backend")
     ap.add_argument("--quick", action="store_true",
                     help="primary metric only (skip ladder/host/mem)")
+    ap.add_argument("--wire-only", action="store_true",
+                    help="run only the real-time wire bench; print its JSON")
+    ap.add_argument("--wire-seconds", type=float, default=8.0)
+    ap.add_argument("--wire-tick-ms", type=int, default=5)
     args = ap.parse_args()
 
     import jax
@@ -351,6 +595,15 @@ def main() -> None:
     bench_t0 = time.perf_counter()
 
     from livekit_server_tpu.models import plane, synth
+
+    if args.wire_only:
+        wire = asyncio.run(wire_bench(
+            plane.PlaneDims(32, 8, 8, 6),
+            tick_ms=args.wire_tick_ms,
+            duration_s=args.wire_seconds,
+        ))
+        print(json.dumps(wire))
+        return
 
     dims = plane.PlaneDims(args.rooms, args.tracks, args.pkts, args.subs)
     # Dense, realistic load: 4×3 Mbps simulcast video + 4 Opus tracks per
@@ -370,25 +623,47 @@ def main() -> None:
     }
 
     if not args.quick:
-        # Host-path forward latency (BASELINE metric) at a shape within the
-        # kernel UDP path's capacity: 32 rooms × 6 subs ≈ 270k wire pps.
-        # The dense primary shape over-subscribes loopback by ~10× and
-        # would measure socket queueing, not forwarding.
+        # Real-time wire bench (BASELINE metric, measured not composed) at
+        # a shape within the kernel UDP path's capacity: 32 rooms × 6 subs
+        # ≈ 280k wire pps. The dense primary shape over-subscribes
+        # loopback by ~10× and would measure socket queueing.
         try:
-            host_dims = plane.PlaneDims(32, 8, 16, 6)
-            # Enough ticks that the slope beats the fixed tunnel cost even
-            # at this small shape (otherwise the fallback would fold the
-            # tunnel round trip into the p99 composition).
-            host_dev = device_bench(host_dims, spec, ticks=60, warmup=3)
-            host = asyncio.run(
-                host_path_bench(host_dims, spec, args.host_ticks,
-                                host_dev["device_tick_ms"])
-            )
-            result.update(host)
-            result["host_device_tick_ms"] = host_dev["device_tick_ms"]
-        except Exception as e:  # noqa: BLE001 — a host-path failure must
-            # not take down the primary metric the driver records.
-            result["host_path_error"] = f"{type(e).__name__}: {e}"
+            wire = asyncio.run(wire_bench(
+                plane.PlaneDims(32, 8, 8, 6),
+                tick_ms=args.wire_tick_ms,
+                duration_s=args.wire_seconds,
+            ))
+            result["wire"] = wire
+            # Headline latency: the measured packet-in→wire-out numbers.
+            result["p50_wire_ms"] = wire["p50_wire_ms"]
+            result["p99_wire_ms"] = wire["p99_wire_ms"]
+            result["host_egress_pps"] = wire["host_egress_pps"]
+        except Exception as e:  # noqa: BLE001 — a wire failure must not
+            # take down the primary metric the driver records.
+            result["wire_error"] = f"{type(e).__name__}: {e}"
+
+        # The same loop with a LOCALLY-ATTACHED backend (XLA:CPU in a
+        # subprocess): on this rig the TPU is behind a ~100 ms tunnel, so
+        # the wire numbers above are tunnel-floor-bound; this run shows
+        # what the identical host path + a local device does. The TPU
+        # device tick (slope-measured below) is faster than CPU's, so
+        # this is an upper bound for a locally-attached TPU.
+        if not args.cpu:
+            import subprocess
+            import sys
+
+            try:
+                cp = subprocess.run(
+                    [sys.executable, __file__, "--wire-only", "--cpu",
+                     "--wire-seconds", str(args.wire_seconds),
+                     "--wire-tick-ms", str(args.wire_tick_ms)],
+                    capture_output=True, text=True, timeout=300,
+                )
+                line = cp.stdout.strip().splitlines()[-1]
+                result["wire_local"] = json.loads(line)
+                result["p99_wire_local_ms"] = result["wire_local"]["p99_wire_ms"]
+            except Exception as e:  # noqa: BLE001
+                result["wire_local_error"] = f"{type(e).__name__}: {e}"
 
         # BASELINE.md ladder configs 1-4 (device throughput, small windows).
         ladder = {

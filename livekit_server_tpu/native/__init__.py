@@ -457,6 +457,11 @@ class NativeEgress:
             ctypes.c_void_p, ctypes.c_void_p, ctypes.c_void_p,
             ctypes.c_int32, ctypes.c_int32,
         ]
+        self.lib.send_raw.restype = ctypes.c_int64
+        self.lib.send_raw.argtypes = [
+            ctypes.c_int, ctypes.c_void_p, ctypes.c_int32,
+            ctypes.c_void_p, ctypes.c_void_p, ctypes.c_void_p, ctypes.c_void_p,
+        ]
         self.lib.open_batch.restype = None
         self.lib.open_batch.argtypes = [
             ctypes.c_void_p, ctypes.c_void_p, ctypes.c_void_p, ctypes.c_int32,
@@ -589,6 +594,24 @@ class NativeEgress:
             int(pace_window_us),
         )
         return out, out_off, out_len, int(sent)
+
+    def send_raw(self, fd, blob, offs, lens, ips, ports) -> int:
+        """GSO/sendmmsg pre-built datagrams (blob + per-entry offset/
+        length/destination arrays). Load generators and relays use this to
+        put wire-ready bytes on the network in a handful of syscalls."""
+        blob_arr = (
+            blob if isinstance(blob, np.ndarray)
+            else np.frombuffer(blob, np.uint8)
+        )
+        offs_c = np.ascontiguousarray(offs, np.int64)
+        lens_c = np.ascontiguousarray(lens, np.int32)
+        ips_c = np.ascontiguousarray(ips, np.uint32)
+        ports_c = np.ascontiguousarray(ports, np.uint16)
+        return int(self.lib.send_raw(
+            int(fd), blob_arr.ctypes.data, len(offs_c),
+            offs_c.ctypes.data, lens_c.ctypes.data,
+            ips_c.ctypes.data, ports_c.ctypes.data,
+        ))
 
 
 def _load():

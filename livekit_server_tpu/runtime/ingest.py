@@ -63,6 +63,10 @@ class PayloadSlab:
     dd_off: np.ndarray | None = None   # int64
     dd_len: np.ndarray | None = None   # int32
     dd_ver: np.ndarray | None = None   # int32 — structure version stamp
+    # Arrival stamps (time.perf_counter seconds at batch-receive return;
+    # 0 = not stamped): the rx half of the wall-clock packet-in→wire-out
+    # forward-latency probe (udp.py observes at sendmmsg-return).
+    t_arr: np.ndarray | None = None    # float64
 
     def get(self, r: int, t: int, k: int) -> tuple[bytes, bool]:
         o = int(self.off[r, t, k])
@@ -132,6 +136,7 @@ class IngestBuffer:
         self.pay_off = np.full((R, T, K), -1, np.int64)
         self.pay_len = np.zeros((R, T, K), np.int32)
         self.marker = np.zeros((R, T, K), bool)
+        self.t_arr = np.zeros((R, T, K), np.float64)
         self.dd_off = np.full((R, T, K), -1, np.int64)
         self.dd_len = np.zeros((R, T, K), np.int32)
         self.dd_ver = np.full((R, T, K), -1, np.int32)
@@ -194,7 +199,7 @@ class IngestBuffer:
         self.ts_jump = np.full(self.sn.shape, 3000, np.int32)
         self.valid = self._bool()
 
-    def push(self, pkt: PacketIn) -> bool:
+    def push(self, pkt: PacketIn, t_rx: float = 0.0) -> bool:
         """Stage one packet; False (and counted) if the tick is full."""
         if pkt.room in self.frozen_rows:
             return False  # mid-migration: the row's state is already shipped
@@ -228,6 +233,7 @@ class IngestBuffer:
             self.pay_len[r, t, k] = len(pkt.payload)
             self.marker[r, t, k] = pkt.marker
             self._slab += pkt.payload
+        self.t_arr[r, t, k] = t_rx
         return True
 
     def push_batch(
@@ -235,6 +241,7 @@ class IngestBuffer:
         layer_sync, begin_pic, marker, pid, tl0, keyidx, size, frame_ms,
         audio_level, arrival_rtp, pay_start, pay_length, blob,
         dd_start=None, dd_length=None, dd_version=None, end_frame=None,
+        t_rx: float = 0.0,
     ) -> int:
         """Vectorized push: stage a whole receive batch with numpy group
         math instead of one Python call per packet (the batch half of the
@@ -333,6 +340,7 @@ class IngestBuffer:
         put(self.pay_off, np.where(lens > 0, offs, -1))
         put(self.pay_len, lens)
         put(self.marker, marker)
+        put(self.t_arr, t_rx)
         blob_arr = (
             blob if isinstance(blob, np.ndarray)
             else np.frombuffer(blob, np.uint8)
@@ -512,11 +520,13 @@ class IngestBuffer:
             dd_off=self.dd_off.copy(),
             dd_len=self.dd_len.copy(),
             dd_ver=self.dd_ver.copy(),
+            t_arr=self.t_arr.copy(),
         )
         self._slab.clear()
         self.pay_off[:] = -1
         self.pay_len[:] = 0
         self.marker[:] = False
+        self.t_arr[:] = 0.0
         self.dd_off[:] = -1
         self.dd_len[:] = 0
         self.dd_ver[:] = -1

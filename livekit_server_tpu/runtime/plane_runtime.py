@@ -56,6 +56,7 @@ class EgressPacket:
     marker: bool = False
     padding: bool = False  # probe padding (RTP P-bit; no media payload)
     dd: bytes = b""       # dependency-descriptor ext bytes (SVC tracks)
+    t_arr: float = 0.0    # rx stamp (forward-latency probe; 0 = unstamped)
 
 
 @dataclass
@@ -84,6 +85,7 @@ class EgressBatch:
         selects a subset of entries."""
         idx = np.nonzero(mask)[0] if mask is not None else range(len(self.rooms))
         out = []
+        ta = self.payloads.t_arr
         for i in idx:
             r, t, k = int(self.rooms[i]), int(self.tracks[i]), int(self.ks[i])
             payload, marker = self.payloads.get(r, t, k)
@@ -99,6 +101,7 @@ class EgressBatch:
                     payload=payload,
                     marker=marker,
                     dd=self.payloads.get_dd(r, t, k),
+                    t_arr=float(ta[r, t, k]) if ta is not None else 0.0,
                 )
             )
         return out

@@ -18,6 +18,7 @@ from __future__ import annotations
 
 import asyncio
 import secrets
+import time
 from dataclasses import dataclass
 
 import numpy as np
@@ -80,6 +81,76 @@ PLI_THROTTLE_MS = 500.0  # min spacing of upstream keyframe requests per
 # Probe padding payload: a maximal RTP pad run — 254 zeros + the count
 # byte (255) that RFC 3550 §5.1 puts last when the P bit is set.
 PAD_RUN = bytes(254) + b"\xff"
+
+
+class ForwardLatencyProbe:
+    """Wall-clock packet-in → wire-out latency histogram.
+
+    The reference's implicit forwarding-latency spec is per-packet and
+    measured on the wire (a packet enters `buffer.Buffer.Write` and leaves
+    at the pacer's socket write). Here every media datagram is stamped
+    when its receive batch returns from recvmmsg (rx_batch →
+    IngestBuffer.t_arr) and observed when the native egress send returns —
+    so the recorded latency INCLUDES tick-queueing wait, staging, the
+    device step, and the kernel send, with no composed/estimated terms.
+
+    Log-spaced bins, vectorized updates (one searchsorted+bincount per
+    tick); cheap enough to stay always-on and feed /debug."""
+
+    N_BINS = 96
+
+    def __init__(self, lo_s: float = 5e-5, hi_s: float = 60.0):
+        import threading
+
+        self.edges = np.logspace(np.log10(lo_s), np.log10(hi_s), self.N_BINS)
+        self.counts = np.zeros(self.N_BINS + 1, np.int64)
+        self.n = 0
+        self.sum_s = 0.0
+        self.max_s = 0.0
+        # Observations can come from the event loop AND the pacer worker
+        # thread (paced sends run do_send off-loop); numpy += is not
+        # atomic, so histogram updates serialize here. One uncontended
+        # acquire per tick — noise next to the send itself.
+        self._lock = threading.Lock()
+
+    def observe(self, lat_s: np.ndarray) -> None:
+        if lat_s.size == 0:
+            return
+        binned = np.bincount(
+            np.searchsorted(self.edges, lat_s), minlength=self.N_BINS + 1
+        )
+        with self._lock:
+            self.counts += binned
+            self.n += int(lat_s.size)
+            self.sum_s += float(lat_s.sum())
+            m = float(lat_s.max())
+            if m > self.max_s:
+                self.max_s = m
+
+    def quantile(self, q: float) -> float:
+        """Approximate quantile in seconds (upper edge of the q-bin)."""
+        if self.n == 0:
+            return 0.0
+        cum = np.cumsum(self.counts)
+        b = int(np.searchsorted(cum, q * self.n))
+        return float(self.edges[min(b, self.N_BINS - 1)])
+
+    def reset(self) -> None:
+        with self._lock:
+            self.counts[:] = 0
+            self.n = 0
+            self.sum_s = 0.0
+            self.max_s = 0.0
+
+    def summary(self) -> dict:
+        return {
+            "n": self.n,
+            "mean_ms": round(self.sum_s / self.n * 1000.0, 3) if self.n else 0.0,
+            "p50_ms": round(self.quantile(0.50) * 1000.0, 3),
+            "p90_ms": round(self.quantile(0.90) * 1000.0, 3),
+            "p99_ms": round(self.quantile(0.99) * 1000.0, 3),
+            "max_ms": round(self.max_s * 1000.0, 3),
+        }
 
 
 def _red_primary(blob: bytes, start: int, length: int) -> tuple[int, int]:
@@ -179,8 +250,6 @@ def parse_nack_fci(fci: bytes) -> list[int]:
 
 def ntp_now() -> int:
     """64-bit NTP timestamp (RFC 3550 SR wallclock)."""
-    import time
-
     t = time.time() + 2208988800.0  # Unix → NTP epoch (1900)
     sec = int(t)
     frac = int((t - sec) * (1 << 32)) & 0xFFFFFFFF
@@ -422,6 +491,9 @@ class UDPMediaTransport(asyncio.DatagramProtocol):
         self._twcc_last_send = np.zeros((R, S), np.float64)
         self._twcc_last_recv = np.zeros((R, S), np.float64)
         self.egress_threads = 4
+        # Always-on packet-in→wire-out latency histogram (stamps: rx_batch
+        # return → native egress send return; includes tick-queue wait).
+        self.fwd_latency = ForwardLatencyProbe()
         # config rtc.congestion_control.send_side_bwe — set ONCE at
         # startup (before any subscriber registers): flipping it later
         # does not refresh already-registered subscribers' fb_enabled
@@ -782,7 +854,8 @@ class UDPMediaTransport(asyncio.DatagramProtocol):
             self._tuple_code[t] = code
         return t
 
-    def feed_batch(self, blob, offs, lens, ips, ports, n) -> None:
+    def feed_batch(self, blob, offs, lens, ips, ports, n,
+                   t_rx: float = 0.0) -> None:
         """Batch ingress from the native recvmmsg reader: sealed frames are
         opened with ONE native AES-GCM batch call (replay windows and the
         client-active latch stay host-side), datagrams are classified
@@ -802,6 +875,8 @@ class UDPMediaTransport(asyncio.DatagramProtocol):
         )
         addr_code = (ips.astype(np.int64) << 16) | ports.astype(np.int64)
         now_ms = asyncio.get_event_loop().time() * 1000.0
+        if t_rx == 0.0:
+            t_rx = time.perf_counter()
 
         if sealed.any():
             si = np.nonzero(sealed)[0]
@@ -850,6 +925,7 @@ class UDPMediaTransport(asyncio.DatagramProtocol):
                 self._classify_and_process(
                     out, ooff[gi].astype(np.int32), olen[gi],
                     addr_code[si[gi]], scodes[gi], sessions, kid[gi], now_ms,
+                    t_rx,
                 )
 
         clear = valid & ~sealed
@@ -864,11 +940,11 @@ class UDPMediaTransport(asyncio.DatagramProtocol):
                 ci = np.nonzero(clear)[0]
                 self._classify_and_process(
                     blob, offs[ci], lens[ci], addr_code[ci],
-                    np.zeros(len(ci), np.int64), None, None, now_ms,
+                    np.zeros(len(ci), np.int64), None, None, now_ms, t_rx,
                 )
 
     def _classify_and_process(self, blob, offs, lens, addr_code, sess_code,
-                              sessions, kid, now_ms) -> None:
+                              sessions, kid, now_ms, t_rx: float = 0.0) -> None:
         """Split one (possibly decrypted) datagram batch into punch / RTCP
         (cold, per-packet) and RTP media (hot, one vectorized pass)."""
         b0 = blob[np.minimum(offs.astype(np.int64), len(blob) - 1)]
@@ -893,7 +969,8 @@ class UDPMediaTransport(asyncio.DatagramProtocol):
         mi = np.nonzero(media)[0]
         if len(mi):
             self._process_media_arrays(
-                blob, offs[mi], lens[mi], addr_code[mi], sess_code[mi], now_ms
+                blob, offs[mi], lens[mi], addr_code[mi], sess_code[mi], now_ms,
+                t_rx,
             )
 
     def datagram_received(self, data: bytes, addr) -> None:
@@ -1264,7 +1341,8 @@ class UDPMediaTransport(asyncio.DatagramProtocol):
         )
 
     def _process_media_arrays(
-        self, blob, offsets, lengths, addr_code, sess_code, now_ms
+        self, blob, offsets, lengths, addr_code, sess_code, now_ms,
+        t_rx: float = 0.0,
     ) -> None:
         """One native parse + one vectorized ingest stage per receive
         batch. Per-PACKET Python is limited to rare paths (RED decap, DD
@@ -1538,6 +1616,7 @@ class UDPMediaTransport(asyncio.DatagramProtocol):
                 dd_start=dd_start,
                 dd_length=dd_length,
                 dd_version=dd_ver,
+                t_rx=t_rx if t_rx else time.perf_counter(),
             )
         self._send_upstream_nacks(now_ms)
 
@@ -1816,14 +1895,23 @@ class UDPMediaTransport(asyncio.DatagramProtocol):
                 pace_window_us=pace_us,
             )
             n_entries = len(idx)
+            t_arr = (
+                batch.payloads.t_arr.reshape(-1)[flat_rtk[idx]]
+                if batch.payloads.t_arr is not None else None
+            )
 
-            def do_send(args=send_args, n_entries=n_entries):
+            def do_send(args=send_args, n_entries=n_entries, t_arr=t_arr):
                 _, _, _, sent = native_egress.send(**args)
                 self.stats["tx"] += sent
                 if sent < n_entries:
                     self.stats["tx_drop"] = (
                         self.stats.get("tx_drop", 0) + n_entries - sent
                     )
+                if t_arr is not None:
+                    # Wire-out stamp: the kernel has every datagram now.
+                    stamped = t_arr[t_arr > 0.0]
+                    if stamped.size:
+                        self.fwd_latency.observe(time.perf_counter() - stamped)
 
             if pace_us > 0:
                 self._pace_pending = self._pace_pool.submit(do_send)
@@ -2115,6 +2203,7 @@ class UDPMediaTransport(asyncio.DatagramProtocol):
         vp8_flags: list[int] = []
         addrs: list[tuple] = []
         sessions: list = []
+        stamps: list[float] = []
         n_pad_sent = 0
         for pkt in packets:
             addr = self.sub_addrs.get((pkt.room, pkt.sub))
@@ -2166,6 +2255,8 @@ class UDPMediaTransport(asyncio.DatagramProtocol):
             vp8_flags.append(1 if has_vp8 else 0)
             addrs.append(addr)
             sessions.append(self.sub_sessions.get((pkt.room, pkt.sub)))
+            if getattr(pkt, "t_arr", 0.0) > 0.0:
+                stamps.append(pkt.t_arr)
             self.tx_pkts[pkt.room, pkt.sub] += 1
             # Actual wire bytes: padding packets carry PAD_RUN, not their
             # (empty) payload, and extensions count too — probe bursts are
@@ -2191,6 +2282,11 @@ class UDPMediaTransport(asyncio.DatagramProtocol):
         for off, ln, addr, sess in zip(offsets, lengths, addrs, sessions):
             self._sendto(bytes(view[off : off + ln]), addr, sess)
             self.stats["tx"] += 1
+        # Latency probe: this cold path carries pacer-deferred and
+        # TCP-fallback media whose delay is exactly the tail the histogram
+        # must not lose (deferral adds whole ticks).
+        if stamps:
+            self.fwd_latency.observe(time.perf_counter() - np.array(stamps))
         if rtx:
             if n_pad_sent:
                 self.stats["pad_tx"] = self.stats.get("pad_tx", 0) + n_pad_sent
@@ -2283,7 +2379,10 @@ async def start_udp_transport(
             # of being starved by a sustained flood.
             nn = native_egress.rx_batch(fd, scratch, offs, lens, ips, ports_a, MAXD)
             if nn > 0:
-                protocol.feed_batch(scratch, offs, lens, ips, ports_a, nn)
+                protocol.feed_batch(
+                    scratch, offs, lens, ips, ports_a, nn,
+                    t_rx=time.perf_counter(),
+                )
 
         loop.add_reader(fd, on_readable)
         return protocol

@@ -170,11 +170,17 @@ class LivekitServer:
     async def debug_ticks(self, request: web.Request) -> web.Response:
         """Recent tick timing breakdown (§5.1 profiling surface)."""
         rt = self.room_manager.runtime
-        return web.json_response({
+        body = {
             "tick_ms": rt.tick_ms,
             "stats": rt.stats,
             "recent_tick_s": list(getattr(rt, "recent_tick_s", [])),
-        })
+        }
+        udp = getattr(self.room_manager, "udp", None)
+        if udp is not None and getattr(udp, "fwd_latency", None) is not None:
+            # Measured wall-clock packet-in→wire-out latency (includes
+            # tick-queueing wait) — the probe in runtime/udp.py.
+            body["forward_latency"] = udp.fwd_latency.summary()
+        return web.json_response(body)
 
     async def metrics(self, request: web.Request) -> web.Response:
         return web.Response(

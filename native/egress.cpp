@@ -450,6 +450,29 @@ int64_t egress_batch_send(
   return built;
 }
 
+// Send pre-built datagrams (contiguous blob + per-entry offset/length/
+// destination) with the same GSO/sendmmsg machinery as the egress path.
+// Used by load generators and relays that already hold wire-ready bytes —
+// no RTP assembly, no sealing. Returns datagrams handed to the kernel.
+int64_t send_raw(int fd, const uint8_t* blob, int32_t n,
+                 const int64_t* offs, const int32_t* lens,
+                 const uint32_t* ip, const uint16_t* port) {
+  if (n <= 0 || fd < 0) return 0;
+  std::vector<uint8_t> skip(n, 0);
+  Args a{skip.data(), nullptr, nullptr, nullptr, nullptr, nullptr, nullptr,
+         nullptr, nullptr, nullptr, nullptr, nullptr, nullptr, nullptr,
+         nullptr, nullptr, ip,      port,    nullptr, nullptr, nullptr,
+         nullptr, nullptr, const_cast<uint8_t*>(blob), offs, lens, fd, 0};
+  if (!g_gso_ok.load(std::memory_order_relaxed)) return send_plain(a, 0, n);
+  int resume = -1;
+  int64_t sent = send_gso(a, 0, n, &resume);
+  if (resume >= 0) {
+    g_gso_ok.store(false, std::memory_order_relaxed);
+    sent += send_plain(a, resume, n);
+  }
+  return sent;
+}
+
 }  // extern "C"
 
 extern "C" {

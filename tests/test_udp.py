@@ -724,6 +724,57 @@ async def test_tcp_fallback_disables_twcc_feedback():
         tcp.close()
 
 
+async def test_forward_latency_probe_measures_rx_to_wire():
+    """The always-on latency probe: packets fed with an rx stamp must
+    yield wire-out observations covering queueing + staging + device +
+    send (VERDICT r3 missing #2 — a measured, not composed, latency)."""
+    import time
+
+    runtime = PlaneRuntime(DIMS, tick_ms=10)
+    s = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    transport = await start_udp_transport(runtime.ingest, "127.0.0.1", port)
+    try:
+        runtime.set_track(0, 0, published=True, is_video=False)
+        runtime.set_subscription(0, 0, 1, subscribed=True)
+        ssrc = transport.assign_ssrc(room=0, track=0, is_video=False)
+        sub = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
+        sub.bind(("127.0.0.1", 0))
+        transport.register_subscriber(0, 1, sub.getsockname())
+
+        dgrams = [
+            rtp_packet(sn=100 + i, ts=960 * i, ssrc=ssrc, payload=b"x" * 40)
+            for i in range(4)
+        ]
+        blob = np.frombuffer(b"".join(dgrams), np.uint8)
+        lens = np.array([len(d) for d in dgrams], np.int32)
+        offs = np.zeros(4, np.int32)
+        np.cumsum(lens[:-1], out=offs[1:])
+        t0 = time.perf_counter()
+        transport.feed_batch(
+            blob, offs, lens,
+            np.full(4, 0x7F000001, np.uint32), np.full(4, 40000, np.uint16),
+            4, t_rx=t0,
+        )
+        await asyncio.sleep(0.015)  # queueing the probe must account for
+        res = await runtime.step_once()
+        transport.send_egress_batch(res.egress_batch)
+        probe = transport.fwd_latency
+        assert probe.n == 4
+        lo, hi = probe.quantile(0.0), probe.max_s
+        # Latency must cover the deliberate 15 ms queueing wait and be
+        # bounded by the whole test's elapsed time.
+        assert hi >= 0.015
+        assert hi <= time.perf_counter() - t0
+        assert probe.summary()["p99_ms"] >= 15.0
+        sub.close()
+    finally:
+        transport.transport.close()
+        await runtime.stop()
+
+
 async def test_udp_unknown_ssrc_dropped():
     runtime = PlaneRuntime(DIMS, tick_ms=10)
     s = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
