@@ -1,0 +1,127 @@
+"""Batched server-side audio mixing (the MCU seat, BASELINE config 2).
+
+Reference parity: the reference SFU does NOT decode or mix audio —
+pkg/sfu/audio/audiolevel.go is level detection only, and audio packets
+forward opaque (an SFU stance; PARITY.md argues the same). This module
+ships the capability anyway, TPU-first, for deployments that want a
+mix bus (telephony bridges, recording, large rooms where N×M audio
+fan-out exceeds the client budget):
+
+  * decode: G.711 µ-law/A-law → linear PCM as a 256-entry table gather
+    (fully vectorized — one lookup per sample across [R, T, N] at once),
+    L16 passthrough. Opus decode needs libopus (not in this image and
+    not reimplementable as tensor ops); the codec seam is explicit so an
+    XLA custom-call wrapping libopus drops in without touching the mix.
+  * mix: one einsum over [R, S, T] include-weights × [R, T, N] PCM —
+    a batched matmul the MXU executes directly. Weights fold together
+    active-speaker gating (top-K by level), per-subscriber self-
+    exclusion (you never hear yourself), and per-track gain.
+  * encode: linear → µ-law/A-law vectorized (searchsorted-free bit math).
+
+Shapes: R rooms × T publisher tracks × S subscribers × N samples/tick
+(48 kHz × tick_ms; 240 @ 5 ms). All static; vmap/shard over rooms like
+the media plane.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+MIX_TOP_K = 3  # speakers mixed per subscriber (reference fan-out policy
+               # for active speakers — room.go speaker updates top-3)
+
+
+def _ulaw_table() -> np.ndarray:
+    """G.711 µ-law byte → linear sample (float32 in [-1, 1))."""
+    u = np.arange(256, dtype=np.uint8) ^ 0xFF
+    sign = np.where(u & 0x80, -1.0, 1.0)
+    exp = (u >> 4) & 0x07
+    mant = u & 0x0F
+    mag = ((mant.astype(np.int32) << 3) + 0x84) << exp
+    return (sign * (mag - 0x84) / 32768.0).astype(np.float32)
+
+
+def _alaw_table() -> np.ndarray:
+    a = np.arange(256, dtype=np.uint8) ^ 0x55
+    sign = np.where(a & 0x80, -1.0, 1.0)
+    exp = (a >> 4) & 0x07
+    mant = (a & 0x0F).astype(np.int32)
+    mag = np.where(exp == 0, (mant << 4) + 8, ((mant << 4) + 0x108) << (exp - 1))
+    return (sign * mag / 32768.0).astype(np.float32)
+
+
+ULAW_TABLE = _ulaw_table()
+ALAW_TABLE = _alaw_table()
+
+CODEC_PCM16 = 0
+CODEC_PCMU = 1
+CODEC_PCMA = 2
+
+
+def decode_tick(payload_u8: jax.Array, codec: jax.Array) -> jax.Array:
+    """[R, T, N] raw bytes (+[R, T] codec ids) → [R, T, N] float PCM.
+
+    PCMU/PCMA: one table gather per sample (the whole room batch decodes
+    in one op). PCM16: bytes are little-endian sample pairs packed as
+    [R, T, N] uint8 pairs → callers pass N = 2×samples and get N/2 out;
+    for uniformity this path expects pre-unpacked int16 via decode_pcm16.
+    """
+    ul = jnp.asarray(ULAW_TABLE)[payload_u8.astype(jnp.int32)]
+    al = jnp.asarray(ALAW_TABLE)[payload_u8.astype(jnp.int32)]
+    c = codec[:, :, None]
+    return jnp.where(c == CODEC_PCMA, al, ul)
+
+
+def decode_pcm16(samples_i16: jax.Array) -> jax.Array:
+    return samples_i16.astype(jnp.float32) / 32768.0
+
+
+def encode_ulaw(pcm: jax.Array) -> jax.Array:
+    """float PCM [-1, 1) → µ-law bytes, vectorized bit math (RFC G.711)."""
+    x = jnp.clip(pcm, -1.0, 1.0 - 1.0 / 32768.0)
+    sign = jnp.where(x < 0, 0x80, 0).astype(jnp.int32)
+    mag = jnp.minimum((jnp.abs(x) * 32768.0).astype(jnp.int32) + 0x84, 0x7FFF)
+    # Exponent = MSB position − 7 (mag ≥ 0x84 ⇒ MSB ∈ [7, 14]); bit math,
+    # not float log, so segment boundaries are exact.
+    exp = jnp.zeros_like(mag)
+    for b in range(8, 15):
+        exp = jnp.where(mag >= (1 << b), b - 7, exp)
+    mant = (mag >> (exp + 3)) & 0x0F
+    return ((sign | (exp << 4) | mant) ^ 0xFF).astype(jnp.uint8)
+
+
+@functools.partial(jax.jit, static_argnames=("top_k",))
+def mix_tick(
+    pcm: jax.Array,        # [R, T, N] float PCM (decoded)
+    level: jax.Array,      # [R, T] linear levels (ops/audio observe_tick)
+    active: jax.Array,     # [R, T] bool — audio present this tick
+    sub_track: jax.Array,  # [R, S] — each subscriber's own track (-1 none)
+    gain: jax.Array,       # [R, T] per-track gain
+    top_k: int = MIX_TOP_K,
+):
+    """Per-subscriber active-speaker mix: [R, S, N] output PCM.
+
+    The include weight folds speaker selection, self-exclusion, and gain
+    into one [R, S, T] matrix; the mix itself is a single einsum
+    "rst,rtn->rsn" — a batched matmul that lands on the MXU with N on
+    the lane axis. No per-subscriber loop anywhere.
+    """
+    R, T, N = pcm.shape
+    S = sub_track.shape[1]
+    k = min(top_k, T)
+    # Top-K speaker gate per room (shared across subscribers, like the
+    # reference's room-level active-speaker list).
+    lv = jnp.where(active, level, -1.0)
+    kth = jnp.sort(lv, axis=-1)[:, T - k][:, None]               # [R, 1]
+    speak = active & (lv >= jnp.maximum(kth, 0.0))               # [R, T]
+    w = speak[:, None, :] & (
+        jnp.arange(T, dtype=jnp.int32)[None, None, :] != sub_track[:, :, None]
+    )                                                            # [R, S, T]
+    weights = w.astype(jnp.float32) * gain[:, None, :]
+    mixed = jnp.einsum("rst,rtn->rsn", weights, pcm)
+    # Soft clip: a 3-speaker sum can exceed full scale.
+    return jnp.tanh(mixed)

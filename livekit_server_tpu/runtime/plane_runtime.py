@@ -264,6 +264,7 @@ class PlaneRuntime:
         self.dims = dims
         self.tick_ms = tick_ms
         self.egress_cap = egress_cap or plane.default_egress_cap(dims)
+        self._want_cap = self.egress_cap  # grows on overflow (auto-widen)
         self.red_enabled = red_enabled
         self.slots = SlotAllocator(dims.rooms, dims.tracks, dims.subs)
         self.ingest = IngestBuffer(dims, tick_ms)
@@ -378,6 +379,26 @@ class PlaneRuntime:
     def on_tick(self, cb: Callable[[TickResult], Awaitable[None] | None]) -> None:
         self._on_tick.append(cb)
 
+    def _widen_egress_cap(self, new_cap: int) -> None:
+        """Swap in a step compiled with a larger egress cap (a static
+        compile arg) at a tick boundary. Pays one recompile — caps double,
+        so a room-burst costs at most log2(grid/cap) recompiles ever."""
+        self.egress_cap = new_cap
+        if self._mesh is not None:
+            from livekit_server_tpu.parallel import make_sharded_tick
+
+            self._step = make_sharded_tick(
+                self._mesh, self._ap, self._bp, donate=True,
+                egress_cap=new_cap, red_enabled=self.red_enabled,
+            )
+        else:
+            self._step = _build_step(
+                self._ap, self._bp, new_cap, self.red_enabled
+            )
+        self.stats["egress_cap_widened"] = (
+            self.stats.get("egress_cap_widened", 0) + 1
+        )
+
     # -- tick ------------------------------------------------------------
     def _upload_ctrl(self) -> None:
         import jax.numpy as jnp
@@ -410,6 +431,8 @@ class PlaneRuntime:
         """Host pre-step: ctrl upload, probe scheduling, ingest drain.
         Claims this tick's index; returns (inp, payloads, idx, roll, t0)."""
         t0 = time.perf_counter()
+        if self._want_cap > self.egress_cap:
+            self._widen_egress_cap(self._want_cap)
         if self._ctrl_dirty:
             self._upload_ctrl()
         idx = self.tick_index
@@ -595,6 +618,24 @@ class PlaneRuntime:
         overflow = int(out.egress_overflow.sum())
         if overflow:
             self.stats["egress_overflow"] = self.stats.get("egress_overflow", 0) + overflow
+            # Honor plane.py's contract: widen the cap instead of silently
+            # dropping every burst tick until a human reads /debug. The
+            # recompile lands at the next stage() boundary (reference
+            # analog: pacer queues are bounded but DRAIN —
+            # pacer/leaky_bucket.go:47-200; sustained overflow there is
+            # backpressure, not permanent loss). The cap is PER ROOM, so
+            # size from the worst single room's overflow — summing across
+            # rooms would overshoot a multi-room burst straight to the
+            # full grid.
+            worst = int(out.egress_overflow.max())
+            self._want_cap = max(
+                self._want_cap,
+                min(
+                    self.dims.tracks * self.dims.pkts * self.dims.subs,
+                    max(2 * self.egress_cap,
+                        -(-(self.egress_cap + worst) // 128) * 128),
+                ),
+            )
         speakers: dict[int, list[tuple[int, float]]] = {}
         lv, tr = out.speaker_levels, out.speaker_tracks
         for r in range(lv.shape[0]):

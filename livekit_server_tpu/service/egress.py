@@ -136,6 +136,7 @@ class EgressService:
             request=body,
         )
         self.egresses[info.egress_id] = info
+        self.server.ioinfo.stamp(info.egress_id)
         dispatched = await self._publish_job({"kind": "start", "egress": info.to_dict()})
         if not dispatched:
             # No worker listening (egress.go errNoEgressWorkers analog).
@@ -151,6 +152,7 @@ class EgressService:
         if info.status in (EgressStatus.COMPLETE, EgressStatus.FAILED, EgressStatus.ABORTED):
             return web.json_response({"msg": "egress already ended"}, status=400)
         info.status = EgressStatus.ENDING
+        self.server.ioinfo.stamp(egress_id)
         await self._publish_job({"kind": "stop", "egress": info.to_dict()})
         return web.json_response(info.to_dict())
 

@@ -120,6 +120,7 @@ class IngressService:
                 video=body.get("video", {}),
             )
             self.ingresses[info.ingress_id] = info
+            self.server.ioinfo.stamp(info.ingress_id)
             await self._publish({"kind": "create", "ingress": info.to_dict()})
             return web.json_response(info.to_dict())
         if method == "UpdateIngress":

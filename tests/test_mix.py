@@ -1,0 +1,96 @@
+"""Batched audio mixing (ops/mix — the BASELINE config-2 MCU seat).
+
+Reference parity note: the reference SFU never decodes/mixes
+(pkg/sfu/audio/audiolevel.go is level detection only); this capability
+is additive. Codec math is validated by exact G.711 roundtrips.
+"""
+
+import jax.numpy as jnp
+import numpy as np
+
+from livekit_server_tpu.ops import mix
+
+
+def test_ulaw_roundtrip_all_bytes():
+    """encode(decode(b)) == b for every µ-law byte (both G.711 halves of
+    the codec agree bit-exactly)."""
+    b = np.arange(256, dtype=np.uint8)
+    pcm = jnp.asarray(mix.ULAW_TABLE)[b]
+    out = np.asarray(mix.encode_ulaw(pcm))
+    # 0x7F/0xFF both decode to ±0-ish values that re-encode canonically;
+    # G.711 has two zero codes — compare via decoded values instead.
+    dec1 = mix.ULAW_TABLE[b]
+    dec2 = mix.ULAW_TABLE[out]
+    np.testing.assert_allclose(dec1, dec2, atol=1e-6)
+
+
+def test_ulaw_quantization_error_bounded():
+    rng = np.random.default_rng(0)
+    x = rng.uniform(-0.99, 0.99, 4096).astype(np.float32)
+    enc = np.asarray(mix.encode_ulaw(jnp.asarray(x)))
+    dec = mix.ULAW_TABLE[enc]
+    # µ-law SNR: error bounded by segment step (~1/16 of magnitude + bias)
+    err = np.abs(dec - x)
+    assert np.all(err <= np.maximum(np.abs(x) / 8.0, 0.02))
+
+
+def test_alaw_decode_known_values():
+    # A-law 0x55-inverted code for zero: 0xD5 / 0x55 decode near zero.
+    assert abs(float(mix.ALAW_TABLE[0xD5])) < 0.01
+    assert abs(float(mix.ALAW_TABLE[0x55])) < 0.01
+    # Sign symmetry: codes differing only in the sign bit mirror.
+    for c in (0x01, 0x33, 0x7F):
+        a = float(mix.ALAW_TABLE[c ^ 0x80])
+        b = float(mix.ALAW_TABLE[c])
+        assert abs(a + b) < 1e-6
+
+
+def test_decode_tick_codec_routing():
+    payload = jnp.asarray(np.full((1, 2, 4), 0x42, np.uint8))
+    codec = jnp.asarray([[mix.CODEC_PCMU, mix.CODEC_PCMA]])
+    out = np.asarray(mix.decode_tick(payload, codec))
+    assert abs(out[0, 0, 0] - mix.ULAW_TABLE[0x42]) < 1e-6
+    assert abs(out[0, 1, 0] - mix.ALAW_TABLE[0x42]) < 1e-6
+
+
+def test_mix_excludes_self_and_inactive():
+    R, T, S, N = 1, 3, 2, 8
+    pcm = np.zeros((R, T, N), np.float32)
+    pcm[0, 0, :] = 0.1   # track 0: sub 0's own voice
+    pcm[0, 1, :] = 0.2   # track 1: another speaker
+    pcm[0, 2, :] = 0.4   # track 2: INACTIVE — must not mix
+    level = jnp.asarray([[0.5, 0.6, 0.9]])
+    active = jnp.asarray([[True, True, False]])
+    sub_track = jnp.asarray([[0, 1]])   # sub0 publishes track0, sub1 track1
+    gain = jnp.ones((R, T), jnp.float32)
+    out = np.asarray(mix.mix_tick(pcm, level, active, sub_track, gain))
+    # sub0 hears track1 only; sub1 hears track0 only (self + inactive cut)
+    np.testing.assert_allclose(out[0, 0], np.tanh(pcm[0, 1]), atol=1e-6)
+    np.testing.assert_allclose(out[0, 1], np.tanh(pcm[0, 0]), atol=1e-6)
+
+
+def test_mix_top_k_gates_speakers():
+    R, T, S, N = 1, 5, 1, 4
+    pcm = np.ones((R, T, N), np.float32) * 0.01
+    level = jnp.asarray([[0.1, 0.9, 0.8, 0.7, 0.05]])
+    active = jnp.ones((R, T), bool)
+    sub_track = jnp.asarray([[-1]])     # pure listener
+    gain = jnp.ones((R, T), jnp.float32)
+    out = np.asarray(mix.mix_tick(pcm, level, active, sub_track, gain, top_k=3))
+    # exactly the 3 loudest tracks mixed: 3 × 0.01
+    np.testing.assert_allclose(out[0, 0], np.tanh(0.03 * np.ones(N)), atol=1e-6)
+
+
+def test_mix_room_batch_shape():
+    """The production shape compiles and runs batched (einsum → MXU)."""
+    R, T, S, N = 32, 8, 6, 240
+    rng = np.random.default_rng(1)
+    out = mix.mix_tick(
+        jnp.asarray(rng.standard_normal((R, T, N)), jnp.float32) * 0.1,
+        jnp.asarray(rng.random((R, T)), jnp.float32),
+        jnp.asarray(rng.random((R, T)) < 0.7),
+        jnp.asarray(rng.integers(-1, T, (R, S)), jnp.int32),
+        jnp.ones((R, T), jnp.float32),
+    )
+    assert out.shape == (R, S, N)
+    assert np.isfinite(np.asarray(out)).all()

@@ -425,3 +425,53 @@ async def test_bus_client_survives_malformed_frame():
         await client.close()
     finally:
         bus.close()
+
+
+async def test_egress_records_from_dead_node_reaped():
+    """Lifecycle reaper (redisstore.go:67-944 cleanup-worker seat): an
+    egress whose worker/node dies mid-job must not stay ACTIVE in every
+    node's aggregator forever — it goes FAILED after the stale window and
+    expires after the ended TTL, so ListEgress stays clean cluster-wide."""
+    import json as _json
+    import time as _time
+
+    from livekit_server_tpu.service.egress import EgressStatus
+
+    bus = await start_bus()
+    try:
+        srv_a, cl_a = await start_node(bus.port)
+        srv_b, cl_b = await start_node(bus.port)
+        try:
+            # A worker (lived on some third node) reports an ACTIVE egress;
+            # both aggregators adopt it.
+            info = {
+                "egress_id": "EG_dead", "room_name": "r", "kind": "track",
+                "status": int(EgressStatus.ACTIVE), "started_at": 0,
+                "ended_at": 0, "error": "", "request": {},
+            }
+            await cl_a.publish("egress_updates", _json.dumps(info))
+            await asyncio.sleep(0.1)
+            assert "EG_dead" in srv_a.ioinfo.egresses
+            assert "EG_dead" in srv_b.ioinfo.egresses
+
+            # The worker's node dies (no further updates). After the stale
+            # window the record is failed...
+            now = _time.monotonic()
+            for srv in (srv_a, srv_b):
+                srv.ioinfo.reap(now + srv.ioinfo.STALE_ACTIVE_S + 1)
+                rec = srv.ioinfo.egresses["EG_dead"]
+                assert rec.status == EgressStatus.FAILED
+                assert "lost" in rec.error
+            # ...and after the ended TTL it is gone from every List.
+            for srv in (srv_a, srv_b):
+                srv.ioinfo.reap(
+                    _time.monotonic() + srv.ioinfo.ENDED_TTL_S + 1
+                )
+                assert "EG_dead" not in srv.ioinfo.egresses
+        finally:
+            await srv_a.stop()
+            await srv_b.stop()
+            await cl_a.close()
+            await cl_b.close()
+    finally:
+        bus.close()

@@ -175,3 +175,83 @@ def test_sharded_tick_with_pallas_kernels_interpreted():
         f"interpret-mode equivalence subprocess failed rc={last.returncode}\n"
         f"{last.stdout[-2000:]}\n{last.stderr[-3000:]}"
     )
+
+
+async def test_sharded_runtime_loop_matches_single_device(mesh):
+    """VERDICT r3 #7: the PlaneRuntime stage/dispatch/complete loop —
+    not just the jitted tick — against SHARDED state on the 8-device
+    mesh. Packets flow into rooms living on different shards (host
+    ingest fan-in crosses the shard boundary), egress fans out through
+    the real UDP transport, and every forwarded (room, sub, sn, ts)
+    matches a single-device runtime fed identically."""
+    import asyncio
+    import socket
+
+    from livekit_server_tpu.runtime import PlaneRuntime
+    from livekit_server_tpu.runtime.ingest import PacketIn
+    from livekit_server_tpu.runtime.udp import start_udp_transport
+
+    dims = plane.PlaneDims(rooms=8, tracks=2, pkts=4, subs=2)
+    rt_m = PlaneRuntime(dims, tick_ms=10, mesh=mesh)
+    rt_s = PlaneRuntime(dims, tick_ms=10)
+    udp = await start_udp_transport(rt_m.ingest, "127.0.0.1", 0)
+    sink = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
+    sink.bind(("127.0.0.1", 0))
+    sink.setblocking(False)
+    try:
+        rooms = (0, 3, 7)  # three different shards of the 8-way mesh
+        for rt in (rt_m, rt_s):
+            for r in rooms:
+                rt.set_track(r, 0, published=True, is_video=False)
+                rt.set_subscription(r, 0, 1, subscribed=True)
+        for r in rooms:
+            udp.assign_ssrc(r, 0, is_video=False)
+            udp.register_subscriber(r, 1, sink.getsockname())
+        rt_m.on_tick(lambda res: udp.send_egress_batch(res.egress_batch))
+
+        def key(b):
+            return sorted(zip(
+                b.rooms.tolist(), b.subs.tolist(),
+                (np.asarray(b.sn) & 0xFFFF).tolist(),
+                np.asarray(b.ts).tolist(),
+            ))
+
+        for tick in range(4):
+            for rt in (rt_m, rt_s):
+                for r in rooms:
+                    rt.ingest.push(PacketIn(
+                        room=r, track=0, sn=100 + tick, ts=960 * tick,
+                        size=40, payload=bytes([r]) * 40,
+                    ))
+            res_m = await rt_m.step_once()
+            res_s = await rt_s.step_once()
+            assert key(res_m.egress_batch) == key(res_s.egress_batch)
+            assert len(res_m.egress_batch) == len(rooms)
+        # Egress actually left on the wire (fan-out crossed every shard).
+        await asyncio.sleep(0.05)
+        got = 0
+        while True:
+            try:
+                sink.recvfrom(2048)
+                got += 1
+            except BlockingIOError:
+                break
+        assert got >= 4 * len(rooms)
+
+        # And the PRODUCTION serving loop runs against the sharded state:
+        # real cadence, pipelined stage/dispatch/complete.
+        rt_m.start()
+        for tick in range(3):
+            for r in rooms:
+                rt_m.ingest.push(PacketIn(
+                    room=r, track=0, sn=200 + tick, ts=960 * (10 + tick),
+                    size=40, payload=b"y" * 40,
+                ))
+            await asyncio.sleep(0.05)
+        assert rt_m.stats["ticks"] >= 2
+        assert rt_m.stats["fwd_packets"] >= len(rooms)
+    finally:
+        await rt_m.stop()
+        await rt_s.stop()
+        udp.transport.close()
+        sink.close()

@@ -603,3 +603,44 @@ async def test_stream_state_update_on_pause_and_resume(runtime):
     assert msgs and msgs[-1].data["stream_states"] == [
         {"track_sid": sid, "state": "active"}
     ]
+
+
+async def test_egress_cap_auto_widens_on_overflow():
+    """A burst that overflows the device egress cap must widen it at the
+    next tick boundary and then forward with zero steady-state drops
+    (plane.py:176-179's contract; reference analog: bounded pacer queues
+    that drain, pacer/leaky_bucket.go:47-200)."""
+    dims = plane.PlaneDims(rooms=1, tracks=2, pkts=4, subs=8)
+    # Deliberately tiny cap: the full burst is 2*4*8 = 64 writes.
+    rt = PlaneRuntime(dims, tick_ms=10, egress_cap=8)
+
+    def burst():
+        for t in range(2):
+            for k in range(4):
+                rt.ingest.push(PacketIn(
+                    room=0, track=t, sn=100 + k + t * 50, ts=960 * k,
+                    size=60, payload=b"x" * 60,
+                ))
+
+    for t in range(2):
+        rt.set_track(0, t, published=True, is_video=False)
+        for s in range(8):
+            rt.set_subscription(0, t, s, subscribed=True)
+    burst()
+    res = await rt.step_once()
+    assert rt.stats.get("egress_overflow", 0) > 0
+    assert len(res.egress_batch) == 8  # cap-limited tick
+    # Next tick: cap widened (one recompile), full burst forwards.
+    burst()
+    res = await rt.step_once()
+    assert rt.stats.get("egress_cap_widened") == 1
+    assert rt.egress_cap == 64
+    assert len(res.egress_batch) == 64
+    over = rt.stats.get("egress_overflow", 0)
+    # Steady state: no further overflow, no further recompiles.
+    burst()
+    res = await rt.step_once()
+    assert len(res.egress_batch) == 64
+    assert rt.stats.get("egress_overflow", 0) == over
+    assert rt.stats.get("egress_cap_widened") == 1
+    await rt.stop()
