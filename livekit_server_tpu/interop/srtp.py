@@ -60,6 +60,34 @@ def derive_srtp_keys(master_key: bytes, master_salt: bytes):
     )
 
 
+def _estimate_roc(roc: int, s_l: int, seq: int) -> int:
+    """RFC 3711 §3.3.1 ROC estimate, SIGNED (caller masks for the IV).
+
+    Run on BOTH sides: the receiver to guess an inbound packet's ROC, and
+    the sender on its own stream — protecting each packet under exactly
+    the value a standard receiver will guess is the only choice that
+    keeps the two in lockstep for every SN pattern (wraps with arbitrary
+    gaps, cross-wrap RTX, app-level jumps)."""
+    if s_l < 32768:
+        return roc - 1 if seq - s_l > 32768 else roc
+    return roc + 1 if s_l - seq > 32768 else roc
+
+
+def _replay_accept(cur: int, window: int, started: bool, idx: int):
+    """64-bit sliding replay window (RFC 3711 §3.3.2) over a monotone
+    packet index — shared by the SRTP ((roc<<16)|seq) and SRTCP (31-bit
+    index) paths. Returns (accepted, new_highest, new_window)."""
+    if not started:
+        return True, idx, 1
+    if idx > cur:
+        shift = idx - cur
+        return True, idx, ((window << min(shift, 64)) | 1) & ((1 << 64) - 1)
+    off = cur - idx
+    if off >= 64 or (window >> off) & 1:
+        return False, cur, window
+    return True, cur, window | (1 << off)
+
+
 def _rtp_iv(salt: bytes, ssrc: int, roc: int, seq: int) -> bytes:
     """RFC 7714 §8.1: 12-byte IV = (0²‖ssrc‖roc‖seq) XOR salt."""
     raw = (
@@ -96,8 +124,13 @@ class SrtpSession:
     rtcp_index: int = 0
     # Inbound per-SSRC ROC/replay state: ssrc → [roc, highest_seq, window]
     _rx: dict = field(default_factory=dict)
-    # Outbound per-SSRC ROC: ssrc → [roc, last_seq, started]
+    # Outbound per-SSRC ROC: ssrc → [roc, highest_seq, started] — st[1]
+    # must stay the HIGHEST SN of the current ROC era (backward/RTX steps
+    # leave it untouched), or the wrap detection desyncs.
     _tx: dict = field(default_factory=dict)
+    # Inbound SRTCP replay state (RFC 3711 §3.3.2): ssrc →
+    # [highest_index, window, started]
+    _rx_rtcp: dict = field(default_factory=dict)
 
     def __post_init__(self):
         (self.rtp_key, self.rtp_salt, self.rtcp_key, self.rtcp_salt) = (
@@ -115,11 +148,13 @@ class SrtpSession:
         ssrc = int.from_bytes(packet[8:12], "big")
         if roc is None:
             st = self._tx.setdefault(ssrc, [0, seq, False])
-            if st[2] and seq < 0x1000 and st[1] > 0xF000:
-                st[0] = (st[0] + 1) & 0xFFFFFFFF  # wrapped
-            st[1] = seq
+            sguess = _estimate_roc(st[0], st[1], seq) if st[2] else st[0]
+            roc = sguess & 0xFFFFFFFF
+            # Advance exactly like the receiver does (signed index so a
+            # roc-1 guess at roc=0 can't masquerade as a huge step).
+            if not st[2] or ((sguess << 16) | seq) > ((st[0] << 16) | st[1]):
+                st[0], st[1] = roc, seq
             st[2] = True
-            roc = st[0]
         iv = _rtp_iv(self.rtp_salt, ssrc, roc, seq)
         ct = self._rtp_aead.encrypt(iv, packet[hdr_len:], packet[:hdr_len])
         return packet[:hdr_len] + ct
@@ -133,35 +168,26 @@ class SrtpSession:
         seq = int.from_bytes(packet[2:4], "big")
         ssrc = int.from_bytes(packet[8:12], "big")
         if roc is not None:
-            guess = roc
+            sguess = roc
             st = None
         else:
             st = self._rx.setdefault(ssrc, [0, seq, 0, False])
-            r, s_l = st[0], st[1]
-            if not st[3]:
-                guess = r
-            elif s_l < 32768:
-                guess = (r - 1) & 0xFFFFFFFF if seq - s_l > 32768 else r
-            else:
-                guess = (r + 1) & 0xFFFFFFFF if s_l - seq > 32768 else r
-        iv = _rtp_iv(self.rtp_salt, ssrc, guess, seq)
+            sguess = _estimate_roc(st[0], st[1], seq) if st[3] else st[0]
+        iv = _rtp_iv(self.rtp_salt, ssrc, sguess & 0xFFFFFFFF, seq)
         try:
             pt = self._rtp_aead.decrypt(iv, packet[hdr_len:], packet[:hdr_len])
         except Exception:  # InvalidTag
             return None
         if st is not None:
-            idx = (guess << 16) | seq
-            cur = (st[0] << 16) | st[1] if st[3] else -1
-            if idx > cur:
-                shift = idx - cur if st[3] else 1
-                st[2] = ((st[2] << min(shift, 64)) | 1) & ((1 << 64) - 1)
-                st[0], st[1] = guess, seq
-            else:
-                off = cur - idx
-                if off >= 64 or (st[2] >> off) & 1:
-                    return None  # replay
-                st[2] |= 1 << off
-            st[3] = True
+            # Signed index: a roc-1 guess at roc=0 goes negative and is
+            # (correctly) rejected as too old, instead of wrapping into an
+            # astronomically-large index that would corrupt the state.
+            idx = (sguess << 16) | seq
+            cur = (st[0] << 16) | st[1]
+            ok, new_cur, st[2] = _replay_accept(cur, st[2], st[3], idx)
+            if not ok:
+                return None  # replay
+            st[0], st[1], st[3] = new_cur >> 16, new_cur & 0xFFFF, True
         return packet[:hdr_len] + pt
 
     @staticmethod
@@ -201,4 +227,12 @@ class SrtpSession:
             pt = self._rtcp_aead.decrypt(iv, packet[8:-4], aad)
         except Exception:
             return None
+        # SRTCP replay protection (RFC 3711 §3.3.2): sliding 64-bit window
+        # over the 31-bit index, per sender SSRC — checked only after the
+        # tag authenticates, so an attacker can't poison the window.
+        st = self._rx_rtcp.setdefault(ssrc, [0, 0, False])
+        ok, st[0], st[1] = _replay_accept(st[0], st[1], st[2], index)
+        if not ok:
+            return None  # replayed or too-old index
+        st[2] = True
         return packet[:8] + pt

@@ -20,6 +20,7 @@ from __future__ import annotations
 import hashlib
 import hmac
 import secrets
+import socket
 import struct
 import zlib
 from dataclasses import dataclass, field
@@ -119,14 +120,29 @@ def parse_stun(data: bytes, integrity_key: bytes | None = None) -> StunMessage |
     return msg
 
 
-def _xor_address(addr: tuple[str, int], txn_id: bytes) -> bytes:
-    ip, port = addr
+def _xor_address(addr: tuple, txn_id: bytes) -> bytes:
+    # AF_INET6 sockets report 4-tuples (host, port, flowinfo, scope_id).
+    ip, port = addr[0], addr[1]
     xport = port ^ (MAGIC_COOKIE >> 16)
-    packed = bytes(int(b) for b in ip.split("."))
-    xip = bytes(
-        a ^ b for a, b in zip(packed, struct.pack("!I", MAGIC_COOKIE))
-    )
-    return struct.pack("!BBH", 0, 0x01, xport) + xip
+    if ":" in ip:
+        # Dual-stack sockets report v4 peers as ::ffff:a.b.c.d and
+        # link-local peers with a %zone suffix — unmap/strip before
+        # encoding so v4 clients get a family-0x01 address they can route.
+        ip = ip.split("%", 1)[0]
+        if ip.lower().startswith("::ffff:") and "." in ip:
+            ip = ip.rsplit(":", 1)[1]
+    if ":" in ip:
+        # RFC 5389 §15.2 family 0x02: 128-bit address XORed against
+        # magic-cookie ‖ transaction-id.
+        packed = socket.inet_pton(socket.AF_INET6, ip)
+        mask = struct.pack("!I", MAGIC_COOKIE) + txn_id
+        family = 0x02
+    else:
+        packed = socket.inet_pton(socket.AF_INET, ip)
+        mask = struct.pack("!I", MAGIC_COOKIE)
+        family = 0x01
+    xip = bytes(a ^ b for a, b in zip(packed, mask))
+    return struct.pack("!BBH", 0, family, xport) + xip
 
 
 def build_message(

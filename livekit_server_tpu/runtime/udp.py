@@ -127,13 +127,22 @@ class ForwardLatencyProbe:
             if m > self.max_s:
                 self.max_s = m
 
+    def _quantile_from(self, counts, n: int, max_s: float, q: float) -> float:
+        if n == 0:
+            return 0.0
+        cum = np.cumsum(counts)
+        b = int(np.searchsorted(cum, q * n))
+        if b >= self.N_BINS:
+            # Overflow bin (beyond the 60 s top edge): the exact maximum is
+            # a tighter answer than the collapsed last-edge value.
+            return max_s
+        return float(self.edges[b])
+
     def quantile(self, q: float) -> float:
         """Approximate quantile in seconds (upper edge of the q-bin)."""
-        if self.n == 0:
-            return 0.0
-        cum = np.cumsum(self.counts)
-        b = int(np.searchsorted(cum, q * self.n))
-        return float(self.edges[min(b, self.N_BINS - 1)])
+        with self._lock:
+            counts, n, max_s = self.counts.copy(), self.n, self.max_s
+        return self._quantile_from(counts, n, max_s, q)
 
     def reset(self) -> None:
         with self._lock:
@@ -143,13 +152,18 @@ class ForwardLatencyProbe:
             self.max_s = 0.0
 
     def summary(self) -> dict:
+        # Snapshot under the lock: the pacer worker mutates these fields
+        # concurrently and /debug must not read torn stats.
+        with self._lock:
+            counts = self.counts.copy()
+            n, sum_s, max_s = self.n, self.sum_s, self.max_s
         return {
-            "n": self.n,
-            "mean_ms": round(self.sum_s / self.n * 1000.0, 3) if self.n else 0.0,
-            "p50_ms": round(self.quantile(0.50) * 1000.0, 3),
-            "p90_ms": round(self.quantile(0.90) * 1000.0, 3),
-            "p99_ms": round(self.quantile(0.99) * 1000.0, 3),
-            "max_ms": round(self.max_s * 1000.0, 3),
+            "n": n,
+            "mean_ms": round(sum_s / n * 1000.0, 3) if n else 0.0,
+            "p50_ms": round(self._quantile_from(counts, n, max_s, 0.50) * 1000.0, 3),
+            "p90_ms": round(self._quantile_from(counts, n, max_s, 0.90) * 1000.0, 3),
+            "p99_ms": round(self._quantile_from(counts, n, max_s, 0.99) * 1000.0, 3),
+            "max_ms": round(max_s * 1000.0, 3),
         }
 
 

@@ -84,3 +84,56 @@ def test_demux_rejects_non_stun():
     assert stun.parse_stun(b"\x80\x60" + b"x" * 30) is None  # RTP-ish
     assert stun.parse_stun(b"\x16\xfe\xfd" + b"x" * 30) is None  # DTLS
     assert stun.parse_stun(b"") is None
+
+def test_xor_mapped_address_ipv6():
+    """RFC 5389 §15.2 family 0x02: 128-bit address XORed against
+    cookie‖txn-id (v4-only _xor_address used to emit garbage here)."""
+    import socket
+    import struct
+
+    req = stun.parse_stun(
+        stun.build_binding_request("u:me", b"pw"), integrity_key=b"pw"
+    )
+    resp = stun.build_binding_response(req, ("2001:db8::1", 43210), b"pw")
+    msg = stun.parse_stun(resp, integrity_key=b"pw")
+    assert msg is not None and msg.integrity_ok
+    xma = msg.attr(stun.ATTR_XOR_MAPPED_ADDRESS)
+    assert xma[1] == 0x02 and len(xma) == 4 + 16
+    port = struct.unpack("!H", xma[2:4])[0] ^ (stun.MAGIC_COOKIE >> 16)
+    mask = struct.pack("!I", stun.MAGIC_COOKIE) + req.txn_id
+    ip = bytes(a ^ b for a, b in zip(xma[4:], mask))
+    assert port == 43210
+    assert ip == socket.inet_pton(socket.AF_INET6, "2001:db8::1")
+
+
+def test_xor_mapped_address_v4_mapped_and_scoped():
+    """Dual-stack quirks: ::ffff:a.b.c.d must unmap to family 0x01; a
+    %zone suffix must not crash the responder."""
+    import struct
+
+    req = stun.parse_stun(
+        stun.build_binding_request("u:me", b"pw"), integrity_key=b"pw"
+    )
+    resp = stun.build_binding_response(
+        req, ("::ffff:203.0.113.5", 1234), b"pw"
+    )
+    xma = stun.parse_stun(resp).attr(stun.ATTR_XOR_MAPPED_ADDRESS)
+    assert xma[1] == 0x01 and len(xma) == 4 + 4
+    ip = bytes(
+        a ^ b
+        for a, b in zip(xma[4:], struct.pack("!I", stun.MAGIC_COOKIE))
+    )
+    assert ip == bytes([203, 0, 113, 5])
+    # Scoped link-local: must produce a family-0x02 answer, not raise.
+    resp = stun.build_binding_response(req, ("fe80::1%eth0", 5), b"pw")
+    assert stun.parse_stun(resp).attr(stun.ATTR_XOR_MAPPED_ADDRESS)[1] == 0x02
+
+
+def test_binding_response_with_4tuple_addr():
+    """AF_INET6 recvfrom yields (host, port, flowinfo, scope_id) — the
+    responder must accept it directly."""
+    req = stun.parse_stun(
+        stun.build_binding_request("u:me", b"pw"), integrity_key=b"pw"
+    )
+    resp = stun.build_binding_response(req, ("2001:db8::2", 9, 0, 0), b"pw")
+    assert stun.parse_stun(resp).attr(stun.ATTR_XOR_MAPPED_ADDRESS)[1] == 0x02

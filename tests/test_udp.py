@@ -1344,3 +1344,50 @@ async def test_send_side_bwe_off_switch():
     finally:
         tr.close()
         await runtime.stop()
+
+
+def test_probe_overflow_bin_reports_exact_max():
+    """Samples beyond the histogram's 60 s top edge land in the overflow
+    bin; quantiles that fall there must report the exact max, not the
+    collapsed last-edge value."""
+    from livekit_server_tpu.runtime.udp import ForwardLatencyProbe
+
+    p = ForwardLatencyProbe()
+    p.observe(np.full(100, 75.0))  # all beyond the top edge
+    s = p.summary()
+    assert s["p50_ms"] == s["p99_ms"] == s["max_ms"] == 75000.0
+    # Mixed: in-range p50, overflow p99.
+    p.reset()
+    p.observe(np.concatenate([np.full(95, 0.010), np.full(5, 90.0)]))
+    s = p.summary()
+    assert 9.0 <= s["p50_ms"] <= 12.0
+    assert s["p99_ms"] == 90000.0
+
+
+def test_probe_summary_concurrent_with_observe():
+    """summary()/quantile() snapshot under the probe lock: hammer observe
+    from a thread while reading — derived stats must stay internally
+    consistent (n == counts sum implied by mean/sum never torn)."""
+    import threading
+
+    from livekit_server_tpu.runtime.udp import ForwardLatencyProbe
+
+    p = ForwardLatencyProbe()
+    stop = threading.Event()
+
+    def writer():
+        while not stop.is_set():
+            p.observe(np.full(64, 0.005))
+
+    t = threading.Thread(target=writer)
+    t.start()
+    try:
+        for _ in range(300):
+            s = p.summary()
+            if s["n"]:
+                # mean of identical samples can only be exact if sum_s and
+                # n were read from one consistent snapshot
+                assert abs(s["mean_ms"] - 5.0) < 1e-6
+    finally:
+        stop.set()
+        t.join()
