@@ -31,6 +31,7 @@ class PublishedTrack:
     track_col: int
     cid: str = ""              # client's local id until published
     ssrc: int = 0              # UDP-transport media binding (0 = WS media)
+    via_gateway: bool = False  # claimed by a standards-lane negotiation
 
     @property
     def is_video(self) -> bool:
@@ -72,6 +73,7 @@ class Participant:
         self.attributes: dict[str, str] = {}
         self.sub_col: int = -1          # subscriber column in the room row
         self.crypto_session = None      # media-wire AEAD session (join-minted)
+        self.gateway_peer = None        # standards-lane DTLS-SRTP peer
         # Last signaled allocator stream state per subscribed track sid
         # (streamallocator.go StreamStateUpdate change detection).
         self.stream_paused: dict[str, bool] = {}

@@ -1,0 +1,477 @@
+"""Standards-lane WebRTC gateway: ICE-lite + DTLS-SRTP end-to-end.
+
+The client here is an independent standard-wire endpoint: its own
+certificate, ICE credentials, OpenSSL DTLS *client* role and RFC
+7714 SRTP — it speaks only RFC wire formats (STUN/DTLS/SRTP/SDP) at the
+server's real UDP socket, exactly like a stock WebRTC stack would
+(aiortc/Pion are not in this image; OpenSSL's own DTLS state machine is
+the independent conformance anchor on both ends).
+
+Reference parity: pkg/rtc/transport.go:253-374 (DTLS → SRTP contexts),
+test/client/client.go:147 (the reference's stock-client harness).
+"""
+
+import asyncio
+import secrets
+import socket
+import time
+
+import numpy as np
+
+from livekit_server_tpu.interop import dtls, sdp, srtp, stun
+from livekit_server_tpu.models import plane
+from livekit_server_tpu.runtime import PlaneRuntime
+from livekit_server_tpu.runtime.crypto import MediaCryptoRegistry
+from livekit_server_tpu.runtime.udp import start_udp_transport
+from tests.test_native import vp8_payload
+
+DIMS = plane.PlaneDims(rooms=2, tracks=3, pkts=8, subs=3)
+
+
+async def _recv(sock, timeout=5.0):
+    t0 = time.monotonic()
+    while time.monotonic() - t0 < timeout:
+        try:
+            return sock.recvfrom(65536)
+        except BlockingIOError:
+            await asyncio.sleep(0.005)
+    raise TimeoutError("no datagram")
+
+
+class StockWireClient:
+    """A WebRTC endpoint built purely from RFC wire formats."""
+
+    def __init__(self):
+        self.sock = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
+        self.sock.bind(("127.0.0.1", 0))
+        self.sock.setblocking(False)
+        self.cert, self.key, self.fp = dtls.generate_certificate("client")
+        self.ufrag = secrets.token_urlsafe(3)
+        self.pwd = secrets.token_urlsafe(18)
+        self.audio_ssrc = 0x1111AAAA
+        self.video_ssrc = 0x2222BBBB
+        self.dtls = None
+        self.tx = None          # SrtpSession protecting what we send
+        self.rx = None
+        self.server_addr = None
+
+    def offer(self) -> str:
+        return (
+            "v=0\r\no=- 1 2 IN IP4 127.0.0.1\r\ns=-\r\nt=0 0\r\n"
+            "a=group:BUNDLE 0 1 2\r\n"
+            f"a=ice-ufrag:{self.ufrag}\r\na=ice-pwd:{self.pwd}\r\n"
+            f"a=fingerprint:sha-256 {self.fp}\r\na=setup:actpass\r\n"
+            "m=audio 9 UDP/TLS/RTP/SAVPF 109\r\na=mid:0\r\na=sendonly\r\n"
+            "a=rtcp-mux\r\na=rtpmap:109 opus/48000/2\r\n"
+            "a=extmap:1 urn:ietf:params:rtp-hdrext:ssrc-audio-level\r\n"
+            f"a=ssrc:{self.audio_ssrc} cname:cli\r\n"
+            "m=video 9 UDP/TLS/RTP/SAVPF 120\r\na=mid:1\r\na=sendonly\r\n"
+            "a=rtcp-mux\r\na=rtpmap:120 VP8/90000\r\n"
+            f"a=ssrc:{self.video_ssrc} cname:cli\r\n"
+            "m=video 9 UDP/TLS/RTP/SAVPF 120\r\na=mid:2\r\na=recvonly\r\n"
+            "a=rtcp-mux\r\na=rtpmap:120 VP8/90000\r\n"
+        )
+
+    async def connect(self, answer_sdp: str):
+        """STUN binding → DTLS handshake → SRTP sessions."""
+        ans = sdp.parse_sdp(answer_sdp)
+        assert ans.ice_lite
+        m = ans.media[0]
+        srv_ufrag = ans.media_ufrag(m)
+        srv_pwd = ans.media_pwd(m)
+        srv_fp = ans.media_fingerprint(m).split(None, 1)[1]
+        # Candidate from the answer names the server media socket.
+        cand = [ln for ln in answer_sdp.split("\r\n")
+                if ln.startswith("a=candidate:")][0].split()
+        self.server_addr = (cand[4], int(cand[5]))
+
+        # ICE connectivity check: USERNAME = remote:local, MESSAGE-
+        # INTEGRITY under the REMOTE (server) pwd — RFC 8445 §7.2.2.
+        req = stun.build_binding_request(
+            f"{srv_ufrag}:{self.ufrag}", srv_pwd.encode()
+        )
+        self.sock.sendto(req, self.server_addr)
+        data, _ = await _recv(self.sock)
+        resp = stun.parse_stun(data, integrity_key=srv_pwd.encode())
+        assert resp is not None and resp.msg_type == stun.BINDING_SUCCESS
+        assert resp.integrity_ok and resp.fingerprint_ok is not False
+        xma = resp.attr(stun.ATTR_XOR_MAPPED_ADDRESS)
+        assert xma is not None  # reflexive address echoed
+
+        self.dtls = dtls.DtlsEndpoint(
+            "client", self.cert, self.key, peer_fingerprint=srv_fp
+        )
+        for d in self.dtls.pump():
+            self.sock.sendto(d, self.server_addr)
+        t0 = time.monotonic()
+        while not self.dtls.handshake_complete:
+            assert time.monotonic() - t0 < 10, "DTLS handshake stuck"
+            data, _ = await _recv(self.sock)
+            if not dtls.is_dtls(data):
+                continue
+            for d in self.dtls.feed(data):
+                self.sock.sendto(d, self.server_addr)
+        (lk, ls), (rk, rs) = self.dtls.export_srtp_keys()
+        self.tx = srtp.SrtpSession(master_key=lk, master_salt=ls)
+        self.rx = srtp.SrtpSession(master_key=rk, master_salt=rs)
+
+    def send_rtp(self, ssrc: int, pt: int, sn: int, ts: int,
+                 payload: bytes, marker=True) -> None:
+        pkt = (
+            bytes([0x80, (0x80 if marker else 0) | pt])
+            + (sn & 0xFFFF).to_bytes(2, "big")
+            + (ts & 0xFFFFFFFF).to_bytes(4, "big")
+            + ssrc.to_bytes(4, "big")
+            + payload
+        )
+        self.sock.sendto(self.tx.protect_rtp(pkt), self.server_addr)
+
+    def send_rtcp(self, pkt: bytes) -> None:
+        self.sock.sendto(self.tx.protect_rtcp(pkt), self.server_addr)
+
+    async def recv_media(self, timeout=5.0):
+        """→ (kind, clear_packet): kind 'rtp' or 'rtcp'."""
+        t0 = time.monotonic()
+        while time.monotonic() - t0 < timeout:
+            data, _ = await _recv(self.sock, timeout)
+            if len(data) >= 2 and 192 <= data[1] <= 223:
+                clear = self.rx.unprotect_rtcp(data)
+                if clear is not None:
+                    return "rtcp", clear
+            else:
+                clear = self.rx.unprotect_rtp(data)
+                if clear is not None:
+                    return "rtp", clear
+        raise TimeoutError("no media")
+
+    def close(self):
+        if self.dtls is not None:
+            self.dtls.close()
+        self.sock.close()
+
+
+async def _setup(subscribe=True):
+    runtime = PlaneRuntime(DIMS, tick_ms=10)
+    reg = MediaCryptoRegistry()
+    udp = await start_udp_transport(
+        runtime.ingest, host="127.0.0.1", port=0, crypto=reg
+    )
+    gw = udp.enable_gateway()
+    runtime.set_track(0, 0, published=True, is_video=False)
+    runtime.set_track(0, 1, published=True, is_video=True)
+    udp.set_track_kind(0, 0, False)
+    udp.set_track_kind(0, 1, True)
+    if subscribe:
+        runtime.set_subscription(0, 0, 1, subscribed=True)
+        runtime.set_subscription(0, 1, 1, subscribed=True)
+    cli = StockWireClient()
+    answer, peer = gw.create_peer(
+        cli.offer(),
+        publish=[
+            {"mid": "0", "room": 0, "track": 0, "mime": "opus"},
+            {"mid": "1", "room": 0, "track": 1, "mime": "vp8"},
+        ],
+        subscribe=(0, 1) if subscribe else None,
+    )
+    return runtime, udp, gw, cli, answer, peer
+
+
+async def test_gateway_end_to_end_media():
+    """A standard-wire client joins (STUN→DTLS→SRTP), publishes VP8 +
+    Opus, and receives its subscribed media back as SRTP."""
+    runtime, udp, gw, cli, answer, peer = await _setup()
+    try:
+        await cli.connect(answer)
+        assert peer.dtls.handshake_complete
+        assert peer.srtp_ready
+        assert gw.stats["dtls_done"] == 1
+
+        # Publish a CONTINUOUS stream (video layer liveness needs an
+        # ongoing keyframe-bearing flow, not a one-shot burst); PTs come
+        # from OUR answer (opus 111, vp8 96).
+        vp8 = vp8_payload(keyframe=True) + b"\x42" * 40
+        got_video = got_audio = False
+        deadline = time.monotonic() + 30
+        sn_seen = []
+        i = 0
+        while not (got_video and got_audio):
+            assert time.monotonic() < deadline, (
+                f"no egress; udp={udp.stats} gw={gw.stats}"
+            )
+            cli.send_rtp(cli.video_ssrc, 96, 100 + i, 3000 * i, vp8,
+                         marker=True)
+            cli.send_rtp(cli.audio_ssrc, 111, 200 + i, 960 * i,
+                         b"\x51" * 30)
+            i += 1
+            await asyncio.sleep(0.02)
+            res = await runtime.step_once()
+            udp.send_egress_batch(res.egress_batch)
+            try:
+                while True:
+                    kind, clear = await cli.recv_media(timeout=0.2)
+                    if kind != "rtp":
+                        continue
+                    pt = clear[1] & 0x7F
+                    ssrc = int.from_bytes(clear[8:12], "big")
+                    if pt == 96:
+                        got_video = True
+                        assert ssrc == udp.subscriber_ssrc(0, 1, 1)
+                        assert clear.endswith(b"\x42" * 40)  # frame bytes
+                        sn_seen.append(
+                            int.from_bytes(clear[2:4], "big")
+                        )
+                    elif pt == 111:
+                        got_audio = True
+                        assert ssrc == udp.subscriber_ssrc(0, 1, 0)
+                        assert clear.endswith(b"\x51" * 30)
+            except TimeoutError:
+                pass
+        assert gw.stats["srtp_rx"] >= 4
+        assert gw.stats["srtp_tx"] >= 2
+    finally:
+        cli.close()
+        udp.transport.close()
+        await runtime.stop()
+
+
+async def test_gateway_rtcp_both_directions():
+    """Client SRTCP reaches the server RTCP handler; server PLI reaches
+    the client as SRTCP."""
+    runtime, udp, gw, cli, answer, peer = await _setup()
+    try:
+        await cli.connect(answer)
+        # Client → server: a receiver report lands in the RTCP handler.
+        base = udp.stats["rtcp_rx"]
+        rr = (
+            bytes([0x80, 201, 0, 1]) + (0xCAFE).to_bytes(4, "big")
+        )
+        cli.send_rtcp(rr)
+        t0 = time.monotonic()
+        while udp.stats["rtcp_rx"] == base:
+            assert time.monotonic() - t0 < 5, f"gw={gw.stats}"
+            await asyncio.sleep(0.01)
+        assert gw.stats["srtcp_rx"] >= 1
+
+        # Publish one video packet so the track's SSRC latches an addr.
+        vp8 = vp8_payload(keyframe=True) + b"k" * 20
+        cli.send_rtp(cli.video_ssrc, 96, 500, 9000, vp8)
+        await asyncio.sleep(0.05)
+        await runtime.step_once()
+        # Server → client: PLI must arrive SRTCP-protected.
+        udp.send_pli(0, 1)
+        kind, clear = await cli.recv_media()
+        while kind != "rtcp" or clear[1] != 206:
+            kind, clear = await cli.recv_media()
+        assert clear[1] == 206 and (clear[0] & 0x1F) == 1  # PSFB PLI
+        assert int.from_bytes(clear[8:12], "big") == cli.video_ssrc
+    finally:
+        cli.close()
+        udp.transport.close()
+        await runtime.stop()
+
+
+async def test_gateway_rejects_bad_stun_and_unknown_srtp():
+    """Unauthenticated STUN gets no answer; SRTP from an unlatched
+    address is dropped."""
+    runtime, udp, gw, cli, answer, peer = await _setup(subscribe=False)
+    try:
+        ans = sdp.parse_sdp(answer)
+        srv_ufrag = ans.media_ufrag(ans.media[0])
+        cand = [ln for ln in answer.split("\r\n")
+                if ln.startswith("a=candidate:")][0].split()
+        server_addr = (cand[4], int(cand[5]))
+        # Wrong integrity key → server must not answer.
+        req = stun.build_binding_request(
+            f"{srv_ufrag}:{cli.ufrag}", b"wrong-password-000000"
+        )
+        cli.sock.sendto(req, server_addr)
+        try:
+            await _recv(cli.sock, timeout=0.5)
+            raise AssertionError("server answered unauthenticated STUN")
+        except TimeoutError:
+            pass
+        t0 = time.monotonic()
+        while gw.stats["stun_bad"] == 0:
+            assert time.monotonic() - t0 < 5
+            await asyncio.sleep(0.01)
+        # A random SRTP-looking packet from an unlatched addr never
+        # reaches the gateway lane: it falls to the normal media path and
+        # dies as an unknown SSRC (or parse error) — not srtp_rx.
+        before_rx = gw.stats["srtp_rx"]
+        before_unknown = udp.stats["unknown_ssrc"] + udp.stats["parse_errors"]
+        cli.sock.sendto(
+            b"\x80\x60" + bytes(10) + secrets.token_bytes(60), server_addr
+        )
+        t0 = time.monotonic()
+        while (udp.stats["unknown_ssrc"] + udp.stats["parse_errors"]
+               == before_unknown):
+            assert time.monotonic() - t0 < 5
+            await asyncio.sleep(0.01)
+        assert gw.stats["srtp_rx"] == before_rx
+    finally:
+        cli.close()
+        udp.transport.close()
+        await runtime.stop()
+
+
+async def test_signal_offer_negotiates_gateway():
+    """The signal-plane 'offer' arm: a real SDP offer creates a gateway
+    peer, binds pending tracks + auto tracks, registers the subscriber
+    lane, and answers ICE-lite; leave tears it all down."""
+    from livekit_server_tpu.protocol.signal import SignalRequest
+    from livekit_server_tpu.rtc import Room, handle_participant_signal
+    from tests.test_rtc_runtime import drain_sink, make_participant
+
+    runtime = PlaneRuntime(DIMS, tick_ms=10)
+    udp = await start_udp_transport(
+        runtime.ingest, host="127.0.0.1", port=0,
+        crypto=MediaCryptoRegistry(),
+    )
+    try:
+        room = Room("gw", runtime)
+        room.udp = udp
+        cli = StockWireClient()
+        p, sink = make_participant(room, "webrtc-user")
+        room.join(p)
+        # Announce ONE track (audio) — the video section auto-publishes.
+        handle_participant_signal(room, p, SignalRequest(
+            "add_track", {"cid": "mic", "type": 0, "name": "mic"}
+        ))
+        handle_participant_signal(room, p, SignalRequest(
+            "offer", {"sdp": cli.offer()}
+        ))
+        msgs = drain_sink(sink)
+        answers = [m for m in msgs if m.kind == "answer"]
+        assert len(answers) == 1
+        ans_text = answers[0].data["sdp"]
+        assert "a=ice-lite" in ans_text
+        ans = sdp.parse_sdp(ans_text)
+        assert ans.media[0].codecs == {111: "opus"}
+        assert ans.media[1].codecs == {96: "vp8"}
+        # Peer exists with both SSRCs bound to plane columns.
+        peer = p.gateway_peer
+        assert peer is not None
+        assert {s for s, *_ in peer.publish} == {
+            cli.audio_ssrc, cli.video_ssrc
+        }
+        assert cli.audio_ssrc in udp.bindings
+        assert udp.bindings[cli.video_ssrc].is_video
+        # The announced pending track was consumed, an auto track added.
+        assert not p.pending_tracks
+        assert len(p.published) == 2
+        # Subscriber lane is NOT registered yet: egress routing flips to
+        # ("srtp", ufrag) only once DTLS completes — overwriting a live
+        # address at offer time would black out an active subscriber.
+        assert peer.sub == (room.slots.row, p.sub_col)
+        assert (room.slots.row, p.sub_col) not in udp.sub_addrs
+        # Renegotiation replaces the association and REUSES the gateway
+        # tracks (no duplicate columns per onnegotiationneeded).
+        handle_participant_signal(room, p, SignalRequest(
+            "offer", {"sdp": cli.offer()}
+        ))
+        peer2 = p.gateway_peer
+        assert peer2 is not None and peer2 is not peer
+        assert peer.ufrag not in udp.gateway.peers_by_ufrag
+        assert len(p.published) == 2
+        assert {s for s, *_ in peer2.publish} == {
+            cli.audio_ssrc, cli.video_ssrc
+        }
+        # Leave: bindings and peer die with the participant.
+        from livekit_server_tpu.protocol import models as pm
+
+        room.remove_participant(p, pm.DisconnectReason.CLIENT_INITIATED)
+        assert cli.audio_ssrc not in udp.bindings
+        assert not udp.gateway.peers_by_ufrag
+        cli.close()
+    finally:
+        udp.transport.close()
+        await runtime.stop()
+
+
+def test_answer_rejects_datachannel_and_bundles_accepted_only():
+    """A stock browser offer carries m=application (datachannel): the
+    answer must reject it with port 0 and keep it OUT of the BUNDLE group
+    (JSEP forbids bundling rejected sections)."""
+    offer_text = (
+        "v=0\r\no=- 1 2 IN IP4 127.0.0.1\r\ns=-\r\nt=0 0\r\n"
+        "a=group:BUNDLE 0 1\r\n"
+        "a=ice-ufrag:abcd\r\na=ice-pwd:0123456789012345678901\r\n"
+        "a=fingerprint:sha-256 AA:BB\r\na=setup:actpass\r\n"
+        "m=audio 9 UDP/TLS/RTP/SAVPF 109\r\na=mid:0\r\na=sendonly\r\n"
+        "a=rtpmap:109 opus/48000/2\r\na=ssrc:7 cname:x\r\n"
+        "m=application 9 UDP/DTLS/SCTP webrtc-datachannel\r\na=mid:1\r\n"
+    )
+    ans_text = sdp.build_answer(
+        sdp.parse_sdp(offer_text), "u", "p" * 22, "AB:CD", ("1.2.3.4", 5)
+    )
+    bundle = [ln for ln in ans_text.split("\r\n")
+              if ln.startswith("a=group:BUNDLE")][0]
+    assert bundle == "a=group:BUNDLE 0"
+    assert "m=application 0 " in ans_text
+
+
+def test_answer_places_egress_ssrcs_in_matching_sections():
+    """a=ssrc declarations must live INSIDE their kind's recv m-section,
+    not appended at the end of the SDP."""
+    offer_text = (
+        "v=0\r\no=- 1 2 IN IP4 127.0.0.1\r\ns=-\r\nt=0 0\r\n"
+        "a=ice-ufrag:abcd\r\na=ice-pwd:0123456789012345678901\r\n"
+        "a=fingerprint:sha-256 AA:BB\r\na=setup:actpass\r\n"
+        "m=audio 9 UDP/TLS/RTP/SAVPF 109\r\na=mid:0\r\na=recvonly\r\n"
+        "a=rtpmap:109 opus/48000/2\r\n"
+        "m=video 9 UDP/TLS/RTP/SAVPF 120\r\na=mid:1\r\na=recvonly\r\n"
+        "a=rtpmap:120 VP8/90000\r\n"
+    )
+    ans_text = sdp.build_answer(
+        sdp.parse_sdp(offer_text), "u", "p" * 22, "AB:CD", ("1.2.3.4", 5),
+        ssrc_by_mid={"0": [111111], "1": [222222]},
+    )
+    audio_part = ans_text.split("m=audio")[1].split("m=video")[0]
+    video_part = ans_text.split("m=video")[1]
+    assert "a=ssrc:111111" in audio_part and "a=ssrc:222222" not in audio_part
+    assert "a=ssrc:222222" in video_part and "a=ssrc:111111" not in video_part
+
+
+async def test_gateway_traffic_survives_require_encryption_batch_path():
+    """require_encryption drops cleartext — but STUN/DTLS/SRTP carry
+    their own crypto and must still reach the gateway through the BATCH
+    rx path (feed_batch), matching the per-datagram path's order."""
+    runtime = PlaneRuntime(DIMS, tick_ms=10)
+    udp = await start_udp_transport(
+        runtime.ingest, host="127.0.0.1", port=0,
+        crypto=MediaCryptoRegistry(), require_encryption=True,
+    )
+    gw = udp.enable_gateway()
+    try:
+        cli = StockWireClient()
+        answer, peer = gw.create_peer(cli.offer())
+        ans = sdp.parse_sdp(answer)
+        srv_ufrag = ans.media_ufrag(ans.media[0])
+        srv_pwd = ans.media_pwd(ans.media[0])
+        req = stun.build_binding_request(
+            f"{srv_ufrag}:{cli.ufrag}", srv_pwd.encode()
+        )
+        # Deliver through the BATCH path directly.
+        blob = np.frombuffer(req, np.uint8)
+        udp.feed_batch(
+            blob, np.zeros(1, np.int64), np.array([len(req)], np.int32),
+            np.array([0x7F000001], np.uint32),
+            np.array([54321], np.uint16), 1,
+        )
+        assert gw.stats["stun_rx"] == 1
+        assert peer.addr_code != 0  # latched via the batch path
+        # A cleartext RTP datagram in the same mode still dies.
+        rtp_like = b"\x80\x60" + bytes(50)
+        blob = np.frombuffer(rtp_like, np.uint8)
+        before = udp.stats["plaintext_drop"]
+        udp.feed_batch(
+            blob, np.zeros(1, np.int64),
+            np.array([len(rtp_like)], np.int32),
+            np.array([0x7F000001], np.uint32),
+            np.array([54322], np.uint16), 1,
+        )
+        assert udp.stats["plaintext_drop"] == before + 1
+        cli.close()
+    finally:
+        udp.transport.close()
+        await runtime.stop()
