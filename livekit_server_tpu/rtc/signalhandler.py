@@ -107,6 +107,28 @@ def handle_participant_signal(room, participant: Participant, req: SignalRequest
             # RED capability opt-in (RFC 2198 Opus redundancy; the
             # reference negotiates RED in SDP — redreceiver.go).
             udp.set_sub_red(room.slots.row, participant.sub_col, bool(data["red"]))
+        if udp is not None and participant.sub_col >= 0 and "audio_mix" in data:
+            # MCU seat opt-in (runtime/mixer.py): the subscriber receives
+            # ONE server-mixed Opus stream with their own voice excluded;
+            # they typically unsubscribe the individual audio tracks in
+            # the same message. An opt-out on a node with no mixer is a
+            # no-op — it must not instantiate one.
+            mixer = None
+            if data["audio_mix"] or udp.audio_mixer is not None:
+                try:
+                    mixer = udp.enable_audio_mixer()
+                except Exception:  # noqa: BLE001 — libopus absent: ignore
+                    mixer = None
+            if mixer is not None:
+                own = next(
+                    (t.track_col for t in participant.published.values()
+                     if not t.is_video),
+                    -1,
+                )
+                mixer.enable_sub(
+                    room.slots.row, participant.sub_col,
+                    bool(data["audio_mix"]), exclude_track=own,
+                )
         for sid in data.get("track_sids", []):
             if data.get("subscribe", True):
                 room.subscribe(participant, sid)

@@ -1,0 +1,228 @@
+"""MCU seat: server-side Opus mixing (BASELINE config 2).
+
+Two publishers send distinct tones as real Opus packets through the UDP
+rx path; an opted-in subscriber receives ONE mixed Opus stream whose
+spectrum carries BOTH tones, and a publisher-subscriber never hears
+their own tone (self-exclusion). Reference stance: the reference is
+SFU-only (pkg/sfu/audio/audiolevel.go) — the mix is this build's own
+BASELINE commitment.
+"""
+
+import asyncio
+import socket
+
+import numpy as np
+import pytest
+
+from livekit_server_tpu.interop import opus
+from livekit_server_tpu.models import plane
+from livekit_server_tpu.runtime import PlaneRuntime
+from livekit_server_tpu.runtime.udp import start_udp_transport
+
+pytestmark = pytest.mark.skipif(
+    not opus.available(), reason="libopus not present"
+)
+
+DIMS = plane.PlaneDims(rooms=2, tracks=3, pkts=8, subs=4)
+
+
+def _tone(freq: float, frame: int) -> np.ndarray:
+    t = (np.arange(960) + frame * 960) / 48000.0
+    return (np.sin(2 * np.pi * freq * t) * 9000).astype(np.int16)
+
+
+def _rtp(ssrc: int, sn: int, ts: int, payload: bytes) -> bytes:
+    return (
+        bytes([0x80, 0x80 | 111])
+        + (sn & 0xFFFF).to_bytes(2, "big")
+        + (ts & 0xFFFFFFFF).to_bytes(4, "big")
+        + ssrc.to_bytes(4, "big")
+        + payload
+    )
+
+
+def _spectrum_peaks(pcm: np.ndarray, freqs) -> dict:
+    mag = np.abs(np.fft.rfft(pcm.astype(float)))
+    f = np.fft.rfftfreq(len(pcm), 1 / 48000.0)
+    noise = np.median(mag) + 1e-9
+    out = {}
+    for q in freqs:
+        band = mag[(f > q - 60) & (f < q + 60)]
+        out[q] = float(band.max() / noise) if band.size else 0.0
+    return out
+
+
+async def test_mixer_end_to_end_two_tones_and_self_exclusion():
+    runtime = PlaneRuntime(DIMS, tick_ms=10)
+    transport = await start_udp_transport(runtime.ingest, "127.0.0.1", 0)
+    port = transport.transport.get_extra_info("sockname")[1]
+    try:
+        # Two audio publishers (tracks 0, 1); track 1's publisher is also
+        # subscriber 1 (self-exclusion case); subscriber 2 is listen-only.
+        runtime.set_track(0, 0, published=True, is_video=False)
+        runtime.set_track(0, 1, published=True, is_video=False)
+        ssrc_a = transport.assign_ssrc(0, 0, is_video=False)
+        ssrc_b = transport.assign_ssrc(0, 1, is_video=False)
+
+        sub_b = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
+        sub_b.bind(("127.0.0.1", 0))
+        sub_b.setblocking(False)
+        sub_l = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
+        sub_l.bind(("127.0.0.1", 0))
+        sub_l.setblocking(False)
+        transport.register_subscriber(0, 1, sub_b.getsockname())
+        transport.register_subscriber(0, 2, sub_l.getsockname())
+
+        mixer = transport.enable_audio_mixer()
+        mixer.enable_sub(0, 1, exclude_track=1)   # B: never hears B
+        mixer.enable_sub(0, 2)                    # listener: hears A+B
+
+        enc_a, enc_b = opus.OpusEncoder(), opus.OpusEncoder()
+        pub = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
+        pub.bind(("127.0.0.1", 0))
+
+        dec_b, dec_l = opus.OpusDecoder(), opus.OpusDecoder()
+        pcm_b, pcm_l = [], []
+        for frame in range(40):
+            pub.sendto(
+                _rtp(ssrc_a, 100 + frame, 960 * frame,
+                     enc_a.encode(_tone(440.0, frame))),
+                ("127.0.0.1", port),
+            )
+            pub.sendto(
+                _rtp(ssrc_b, 200 + frame, 960 * frame,
+                     enc_b.encode(_tone(1320.0, frame))),
+                ("127.0.0.1", port),
+            )
+            await asyncio.sleep(0.004)
+            mixer.tick()  # drive the frame clock deterministically
+            for sock_, dec_, acc in (
+                (sub_b, dec_b, pcm_b), (sub_l, dec_l, pcm_l),
+            ):
+                while True:
+                    try:
+                        d = sock_.recvfrom(4096)[0]
+                    except BlockingIOError:
+                        break
+                    if 192 <= d[1] <= 223 or (d[1] & 0x7F) != 111:
+                        continue
+                    acc.append(dec_.decode(d[12:]))
+        assert mixer.stats["frames_mixed"] > 10, mixer.debug_summary()
+        assert len(pcm_l) > 10 and len(pcm_b) > 10
+        # Listener hears BOTH tones; B hears A's tone but NOT their own.
+        tail_l = np.concatenate(pcm_l[len(pcm_l) // 2 :])
+        tail_b = np.concatenate(pcm_b[len(pcm_b) // 2 :])
+        pk_l = _spectrum_peaks(tail_l, [440.0, 1320.0])
+        pk_b = _spectrum_peaks(tail_b, [440.0, 1320.0])
+        assert pk_l[440.0] > 20 and pk_l[1320.0] > 20, pk_l
+        assert pk_b[440.0] > 20, pk_b
+        assert pk_b[1320.0] < pk_b[440.0] / 4, pk_b
+        pub.close()
+        sub_b.close()
+        sub_l.close()
+    finally:
+        if transport.audio_mixer is not None:
+            transport.audio_mixer.close()
+        transport.transport.close()
+        await runtime.stop()
+
+
+async def test_mixer_signal_opt_in_and_teardown():
+    """subscription {"audio_mix": true} enables the mixer for that
+    subscriber with self-exclusion; leave tears the lane down."""
+    from livekit_server_tpu.protocol.signal import SignalRequest
+    from livekit_server_tpu.rtc import Room, handle_participant_signal
+    from livekit_server_tpu.protocol import models as pm
+    from tests.test_rtc_runtime import make_participant
+
+    runtime = PlaneRuntime(DIMS, tick_ms=10)
+    transport = await start_udp_transport(runtime.ingest, "127.0.0.1", 0)
+    try:
+        room = Room("mix", runtime)
+        room.udp = transport
+        p, _sink = make_participant(room, "alice")
+        room.join(p)
+        handle_participant_signal(room, p, SignalRequest(
+            "add_track", {"cid": "mic", "type": 0, "name": "mic"}
+        ))
+        track = p.publish_pending("mic")
+        assert track is not None
+        handle_participant_signal(room, p, SignalRequest(
+            "subscription", {"track_sids": [], "audio_mix": True}
+        ))
+        mixer = transport.audio_mixer
+        assert mixer is not None
+        rm = mixer.rooms[room.slots.row]
+        assert p.sub_col in rm.subs
+        assert rm.subs[p.sub_col].exclude_track == track.track_col
+        # Opt out via the same signal.
+        handle_participant_signal(room, p, SignalRequest(
+            "subscription", {"track_sids": [], "audio_mix": False}
+        ))
+        assert room.slots.row not in mixer.rooms
+    finally:
+        if transport.audio_mixer is not None:
+            transport.audio_mixer.close()
+        transport.transport.close()
+        await runtime.stop()
+
+
+async def test_mixer_exclusion_tracks_publish_order_and_release():
+    """Self-exclusion stays correct when opt-in precedes publish, and a
+    released track's decoder lane + stale exclusions are scrubbed."""
+    from livekit_server_tpu.protocol.signal import SignalRequest
+    from livekit_server_tpu.rtc import Room, handle_participant_signal
+    from tests.test_rtc_runtime import make_participant
+
+    runtime = PlaneRuntime(DIMS, tick_ms=10)
+    transport = await start_udp_transport(runtime.ingest, "127.0.0.1", 0)
+    try:
+        room = Room("mix2", runtime)
+        room.udp = transport
+        p, _ = make_participant(room, "alice")
+        room.join(p)
+        # Opt in BEFORE publishing the mic (normal client ordering).
+        handle_participant_signal(room, p, SignalRequest(
+            "subscription", {"track_sids": [], "audio_mix": True}
+        ))
+        mixer = transport.audio_mixer
+        rm = mixer.rooms[room.slots.row]
+        assert rm.subs[p.sub_col].exclude_track == -1
+        handle_participant_signal(room, p, SignalRequest(
+            "add_track", {"cid": "mic", "type": 0, "name": "mic"}
+        ))
+        track = p.publish_pending("mic")
+        assert rm.subs[p.sub_col].exclude_track == track.track_col
+        # Feed the lane, then unpublish: lane + exclusion must be scrubbed.
+        mixer.push(room.slots.row, track.track_col, 0,
+                   opus.OpusEncoder().encode(_tone(440.0, 0)))
+        assert track.track_col in rm.tracks
+        p.unpublish_track(track.info.sid)
+        assert track.track_col not in rm.tracks
+        assert rm.subs[p.sub_col].exclude_track == -1
+    finally:
+        if transport.audio_mixer is not None:
+            transport.audio_mixer.close()
+        transport.transport.close()
+        await runtime.stop()
+
+
+async def test_mixer_opt_out_does_not_instantiate():
+    from livekit_server_tpu.protocol.signal import SignalRequest
+    from livekit_server_tpu.rtc import Room, handle_participant_signal
+    from tests.test_rtc_runtime import make_participant
+
+    runtime = PlaneRuntime(DIMS, tick_ms=10)
+    transport = await start_udp_transport(runtime.ingest, "127.0.0.1", 0)
+    try:
+        room = Room("mix3", runtime)
+        room.udp = transport
+        p, _ = make_participant(room, "bob")
+        room.join(p)
+        handle_participant_signal(room, p, SignalRequest(
+            "subscription", {"track_sids": [], "audio_mix": False}
+        ))
+        assert transport.audio_mixer is None
+    finally:
+        transport.transport.close()
+        await runtime.stop()
