@@ -103,18 +103,25 @@ def _on_kill(signum, frame):  # noqa: ARG001
 # -- device throughput ------------------------------------------------------
 
 def device_bench(dims, spec, ticks: int, warmup: int) -> dict:
-    """Chained PRODUCTION steps — the packed-wire graph PlaneRuntime
-    actually dispatches (pack_tick_inputs → media_plane_tick →
-    pack_tick_outputs, state donated) — measured as a TWO-WINDOW slope so
-    the fixed per-run dispatch/sync cost (large through a tunneled dev
-    chip, nonzero even locally) cancels out.
+    """PRODUCTION tick graph (unpack_tick_inputs → media_plane_tick →
+    pack_tick_outputs, state donated), measured as a `ticks`-long
+    `lax.scan` per dispatch with a TWO-WINDOW slope.
+
+    Two rig artifacts are engineered out (both burned earlier rounds):
+      * per-tick HOST UPLOADS — r3/r4 staged inputs per step, so through
+        the ~100 ms axon tunnel the "device tick" was mostly input
+        transfer (cfg4 read 170 ms when the device was busy 5 ms). Inputs
+        now land in HBM ONCE as a stacked pool; the scan body indexes it
+        with a rotating cursor.
+      * per-dispatch overhead — the axon client costs ~15 ms per execute
+        call with this step's buffer count. Scanning `ticks` ticks inside
+        one dispatch dilutes it to D/ticks, and the window slope (3 calls
+        vs 1) cancels the remainder up to 2D/2N — bounded, stated, small.
 
     The packed output buffer is CONSUMED on-device into a checksum:
     nothing dead-code-eliminates (r3's scalar-returning variant let XLA
-    drop the egress compaction + output packing — those ladder numbers
-    under-reported the production tick), while per-call transfer stays
-    scalar-sized, so the slope measures compute rather than the tunnel's
-    per-MB fetch cost."""
+    drop the output path), and per-call transfer stays scalar-sized.
+    """
     import functools
 
     import jax
@@ -122,63 +129,96 @@ def device_bench(dims, spec, ticks: int, warmup: int) -> dict:
 
     from livekit_server_tpu.models import plane, synth
 
+    R, T, K, S = dims
     state = synth.make_state(dims, spec)
-    cap = plane.default_egress_cap(dims)
-
-    @functools.partial(jax.jit, donate_argnums=(0,))
-    def step(state, fwd, evaluated, chk, pkt, fb, tf, tick_ms, roll):
-        inp = plane.unpack_tick_inputs(pkt, fb, tf, tick_ms, roll)
-        ev = jnp.sum(
-            (inp.valid[:, :, :, None] & state.ctrl.subscribed[:, :, None, :]),
-            dtype=jnp.int32,
-        )
-        state, out = plane.media_plane_tick(state, inp, egress_cap=cap)
-        buf = plane.pack_tick_outputs(out)
-        return (
-            state,
-            fwd + out.fwd_packets.sum(),
-            evaluated + ev,
-            chk + buf.astype(jnp.int64).sum(),
-        )
-
     traffic = synth.init_traffic(dims, spec)
-    # Inputs are pre-staged ON DEVICE: through a tunneled dev chip a
-    # per-tick host upload costs ~50 ms and would swamp the compute being
-    # measured (a locally-attached chip uploads in microseconds — the
-    # runtime's real per-tick upload is negligible there). The HBM cost is
-    # bounded: ~1 MB/tick at the default shape (~200 MB total), ~9 MB/tick
-    # for the 2-tick memory-feasibility run.
-    inputs = []
-    for i in range(warmup + 4 * ticks):
+
+    # Host-built input pool, ONE upload. Capped at ~128 MB of HBM; the
+    # scan cursor wraps, so windows beyond the pool replay traffic with
+    # live state (SN replays read as late packets — selection/allocation
+    # work, the measured quantity, is unaffected).
+    per_tick = (len(plane.PKT_FIELDS) * R * T * K + 8 * R * S + R * T) * 4
+    n_want = warmup + 5 * ticks
+    # Pool cap: the axon client's per-call cost grows with threaded-buffer
+    # payload, so a modest wrapped pool beats a full distinct-tick pool.
+    pool_n = max(min(ticks, 8), min(n_want, int(128e6 // max(per_tick, 1))))
+    pks, fbs, tfs = [], [], []
+    for i in range(pool_n):
         traffic, inp = synth.next_tick(traffic, dims, spec, tick_index=i)
-        inputs.append(plane.pack_tick_inputs(jax.tree.map(jnp.asarray, inp)))
+        pkt, fb, tf, _, _ = plane.pack_tick_inputs(inp)
+        pks.append(pkt)
+        fbs.append(fb)
+        tfs.append(tf)
+    pool_pkt = jnp.asarray(np.stack(pks))
+    pool_fb = jnp.asarray(np.stack(fbs))
+    pool_tf = jnp.asarray(np.stack(tfs))
+    del pks, fbs, tfs
+    tick_ms_c = jnp.int32(spec.tick_ms)
+    roll_c = jnp.int32(0)
 
-    fwd = jnp.zeros((), jnp.int32)
-    ev = jnp.zeros((), jnp.int32)
-    chk = jnp.zeros((), jnp.int64)
-    for i in range(warmup):
-        state, fwd, ev, chk = step(state, fwd, ev, chk, *inputs[i])
-    int(chk)  # force completion with a host read (tunnel-safe)
+    # Pools are DONATED and threaded through the returns: the axon client
+    # charges per-call costs proportional to argument-buffer payload, and
+    # donation keeps the handles stable (measured: pools-as-fresh-args
+    # added ~12 ms/tick at cfg4; donated-threaded matches closure-constant
+    # speed without baking a 0.5 GB constant into the executable).
+    @functools.partial(jax.jit, donate_argnums=(0, 1, 2, 3, 4, 5, 6))
+    def run_window(state, fwd, ev, chk, pool_pkt, pool_fb, pool_tf, start):
+        def body(carry, i):
+            state, fwd, ev, chk = carry
+            idx = (start + i) % pool_n
+            pkt = jax.lax.dynamic_index_in_dim(pool_pkt, idx, keepdims=False)
+            fb = jax.lax.dynamic_index_in_dim(pool_fb, idx, keepdims=False)
+            tf = jax.lax.dynamic_index_in_dim(pool_tf, idx, keepdims=False)
+            inp = plane.unpack_tick_inputs(pkt, fb, tf, tick_ms_c, roll_c)
+            ev2 = jnp.sum(
+                inp.valid[:, :, :, None] & state.ctrl.subscribed[:, :, None, :],
+                dtype=jnp.int32,
+            )
+            state, out = plane.media_plane_tick(state, inp)
+            buf = plane.pack_tick_outputs(out)
+            return (
+                state,
+                fwd + out.fwd_packets.sum(),
+                ev + ev2,
+                chk + buf.astype(jnp.int64).sum(),
+            ), None
 
-    def window(state, n, start):
+        (state, fwd, ev, chk), _ = jax.lax.scan(
+            body, (state, fwd, ev, chk), jnp.arange(ticks, dtype=jnp.int32)
+        )
+        return state, fwd, ev, chk, pool_pkt, pool_fb, pool_tf
+
+    pools = [pool_pkt, pool_fb, pool_tf]
+
+    def window(state, n_calls, start):
+        # Accumulators stay ON DEVICE across the window's calls: every
+        # int(...) fetch costs a full tunnel round trip (~100 ms on this
+        # rig), so the window fetches exactly once, at the end — the same
+        # number of fetches per window, cancelling in the slope.
         fwd = jnp.zeros((), jnp.int32)
         ev = jnp.zeros((), jnp.int32)
         chk = jnp.zeros((), jnp.int64)
         t0 = time.perf_counter()
-        for i in range(start, start + n):
-            state, fwd, ev, chk = step(state, fwd, ev, chk, *inputs[i])
+        for j in range(n_calls):
+            state, fwd, ev, chk, pools[0], pools[1], pools[2] = run_window(
+                state, fwd, ev, chk, *pools,
+                jnp.int32((start + j * ticks) % pool_n),
+            )
+        fwd, ev = int(fwd), int(ev)
         int(chk)
-        return state, int(fwd), int(ev), time.perf_counter() - t0
+        return state, fwd, ev, time.perf_counter() - t0
 
-    # Window A: N ticks; window B: 3N ticks of the continuing stream.
-    # t(N) = C + N·τ ⇒ τ = (t_B − t_A)/2N with the fixed cost C cancelled;
-    # the 3×-vs-1× separation keeps timing jitter small relative to dt.
-    state, fwd_a, ev_a, t_a = window(state, ticks, warmup)
-    state, fwd_b, ev_b, t_b = window(state, 3 * ticks, warmup + ticks)
+    # Warmup call pays the compile + first-touch.
+    state, _, _, _ = window(state, 1, 0)
+    # Window A: 1 call (N ticks); window B: 3 calls (3N ticks).
+    # t(c) = c·(D + N·τ) ⇒ τ_eff = (t_B − t_A)/2N = τ + D/N, with the
+    # per-dispatch D (~15 ms on this rig, ~µs locally) diluted by N.
+    state, fwd_a, ev_a, t_a = window(state, 1, ticks)
+    state, fwd_b, ev_b, t_b = window(state, 3, 2 * ticks)
     if t_b < 1.2 * t_a:
-        # Fixed cost dominates (tiny config): the slope is buried in
-        # noise — report window B absolute, EXPLICITLY FLAGGED so BENCH
-        # consumers can't misread a dispatch floor as the tick cost.
+        # Fixed cost dominates (tiny config): report window B absolute,
+        # EXPLICITLY FLAGGED so consumers can't misread a dispatch floor
+        # as the tick cost.
         return {
             "fwd_writes_per_s": round(fwd_b / t_b, 1),
             "evaluated_per_s": round(ev_b / t_b, 1),
@@ -879,7 +919,7 @@ def main() -> None:
             d = plane.PlaneDims(10240, 8, 16, 50)
             s = synth.TrafficSpec(video_tracks=2, audio_tracks=6, tick_ms=20,
                                   video_kbps=1500, svc=True)
-            r = device_bench(d, s, ticks=3, warmup=1)
+            r = device_bench(d, s, ticks=5, warmup=1)
             RESULT["northstar_10240rooms_50subs_tick_ms"] = r["device_tick_ms"]
             RESULT["mem_1k_rooms_50subs_ok"] = True  # 10k×50 subsumes it
         except Exception as e:  # noqa: BLE001

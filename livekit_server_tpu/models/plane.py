@@ -13,14 +13,26 @@ tick (~5-20 ms): for every room, every published track, every subscriber, it
      (reference: allocateAllTracks + Forwarder provisional algebra)
   5. selects simulcast/temporal layers per packet per subscriber
      (reference: videolayerselector — the Select half of WriteRTP)
-  6. munges SN/TS and VP8 descriptors per (packet, subscriber)
-     (reference: rtpmunger.go + codecmunger/vp8.go — the rewrite half)
-  7. mixes audio levels into active-speaker rankings per room
+  6. mixes audio levels into active-speaker rankings per room
      (reference: audio.AudioLevel + Room.audioUpdateWorker)
 
 The whole thing is jit-compiled once; the room axis is vmapped and shards
 over the device mesh (livekit_server_tpu.parallel). The host control plane
 mutates subscription/mute masks and reads egress outputs between ticks.
+
+Decide on device, rewrite on host (round-5 split)
+-------------------------------------------------
+The tick's egress product is three BIT-PACKED mask tensors — send / drop /
+switch per (track, packet, subscriber), ⌈S/32⌉ words each — NOT per-send
+SN/TS values. The SN/TS/VP8 offset rewriting (rtpmunger.go +
+codecmunger/vp8.go semantics) runs on the HOST (runtime/munge.py + the
+native walker), in the egress path that already touches every outgoing
+packet's bytes — exactly where the reference runs it. Device tracing
+showed the former device-side compaction (`jnp.nonzero` + six value
+gathers) WAS the tick at scale: TPUs have no vector gather, so the
+gathers cost ~29 ms of a 38 ms cfg4 tick, and at the 10k-room north-star
+shape any multi-pass op over the dense [R,T,K,S] value tensors is
+unaffordable. Masks are one elementwise pass and 32× smaller on the wire.
 
 Shape glossary (static per compiled program):
   R rooms · T tracks/room · K packets/track/tick · S subscribers/room
@@ -42,12 +54,10 @@ from livekit_server_tpu.ops import (
     pacer,
     quality,
     red,
-    rtpmunger,
     rtpstats,
+    scanops,
     selector,
     streamtracker,
-    svc,
-    vp8,
 )
 
 MAX_LAYERS = 3          # simulcast spatial layers (reference: 3 — receiver.go)
@@ -93,14 +103,17 @@ class SubControl(NamedTuple):
 
 
 class PlaneState(NamedTuple):
-    """Full media-plane state, all leading axis [R] (sharded over mesh)."""
+    """Full media-plane state, all leading axis [R] (sharded over mesh).
+
+    SN/TS/VP8 munger state lives on the HOST (runtime/munge.py HostMunger)
+    since the round-5 decide-on-device/rewrite-on-host split; the device
+    carries only decision state.
+    """
 
     meta: TrackMeta
     ctrl: SubControl
     stats: rtpstats.StreamStats          # [R, T*L] per (track, layer) stream
     audio_state: audio.AudioLevelState   # [R, T]
-    munger: rtpmunger.MungerState        # [R, T, S]
-    vp8_state: vp8.VP8State              # [R, T, S]
     sel: selector.SelectorState          # [R, T, S]
     bwe_state: bwe.BWEState              # [R, S]
     delay_bwe: bwe.DelayBWEState         # [R, S] — TWCC send-side estimator
@@ -169,23 +182,20 @@ class TickInputs(NamedTuple):
 class TickOutputs(NamedTuple):
     """Egress + signal tensors pulled by the host after each tick.
 
-    Egress is COMPACTED on device: instead of dense [R, T, K, S] grids
-    (whose device→host transfer dominates the tick on a remote/tunneled
-    chip), each room returns up to `egress_cap` (track,pkt,sub) writes as a
-    fixed-size index list + gathered fields. Compaction is per-room
-    (jnp.nonzero(size=cap) under vmap), so the room axis stays shardable
-    with no cross-chip gathers. `egress_overflow` counts writes dropped by
-    an undersized cap — the host should widen egress_cap if it's ever
-    nonzero (the analog of the reference's bounded pacer queues).
+    Egress is three BIT-PACKED mask tensors (send / drop / switch), one bit
+    per (track, packet, subscriber), W = ⌈S/32⌉ words on the minor axis.
+    One elementwise pass to produce, ~32× smaller than dense bools on the
+    device→host wire, and no gathers anywhere (see module docstring). The
+    host (runtime/munge.py + native walker) expands the bits it forwards
+    and applies the SN/TS/VP8 rewrites with host-owned state.
     """
 
-    egress_idx: jax.Array     # [R, E] int32 — flat t*K*S + k*S + s; -1 = empty
-    egress_sn: jax.Array      # [R, E] int32 — munged SN
-    egress_ts: jax.Array      # [R, E] int32 — munged TS
-    egress_pid: jax.Array     # [R, E] int32 (video only)
-    egress_tl0: jax.Array     # [R, E] int32
-    egress_keyidx: jax.Array  # [R, E] int32
-    egress_overflow: jax.Array  # [R] int32 — sends beyond cap (dropped)
+    send_bits: jax.Array      # [R, T, K, W] int32 — forward pkt k to sub s
+    drop_bits: jax.Array      # [R, T, K, W] int32 — current-stream drop
+                              #   (SN-gap compaction event, rtpmunger.go
+                              #   PacketDropped)
+    switch_bits: jax.Array    # [R, T, K, W] int32 — source-switch re-anchor
+                              #   (forwarder.go processSourceSwitch)
     need_keyframe: jax.Array   # [R, T, S] bool — host sends PLI upstream
     speaker_levels: jax.Array  # [R, SPEAKER_TOP_K] float32
     speaker_tracks: jax.Array  # [R, SPEAKER_TOP_K] int32 — room-local track idx
@@ -205,10 +215,8 @@ class TickOutputs(NamedTuple):
     track_loss_pct: jax.Array  # [R, T] float32
     track_jitter_ms: jax.Array # [R, T] float32
     track_bps: jax.Array       # [R, T] float32 — summed live-layer bitrate
-    # Probe padding synthesized this tick (rtpmunger.padding_tick):
-    pad_sn: jax.Array          # [R, S, PAD_MAX] int32 — munged padding SNs
-    pad_ts: jax.Array          # [R, S, PAD_MAX] int32
-    pad_valid: jax.Array       # [R, S, PAD_MAX] bool
+    # (Probe padding synthesis moved host-side with the munger state —
+    # runtime/munge.py HostMunger.padding.)
     # Allocator budget per subscriber (probe goal baseline + telemetry):
     committed_bps: jax.Array   # [R, S] float32
     pacer_allowed: jax.Array   # [R, S] float32 — leaky-bucket byte budget
@@ -249,8 +257,6 @@ def init_state(dims: PlaneDims) -> PlaneState:
         ctrl=ctrl,
         stats=jax.tree.map(lambda x: tile(x, R), rtpstats.init_state(T * L)),
         audio_state=jax.tree.map(lambda x: tile(x, R), audio.init_state(T)),
-        munger=jax.tree.map(lambda x: tile(x, R, T), rtpmunger.init_state(S)),
-        vp8_state=jax.tree.map(lambda x: tile(x, R, T), vp8.init_state(S)),
         sel=jax.tree.map(lambda x: tile(x, R, T), selector.init_state(S)),
         bwe_state=jax.tree.map(lambda x: tile(x, R), bwe.init_state(S)),
         delay_bwe=jax.tree.map(lambda x: tile(x, R), bwe.delay_init_state(S)),
@@ -261,12 +267,40 @@ def init_state(dims: PlaneDims) -> PlaneState:
     )
 
 
+def mask_words(num_subscribers: int) -> int:
+    """Words on the bit-packed mask minor axis: ⌈S/32⌉."""
+    return (num_subscribers + 31) // 32
+
+
+def _pack_bits(mask: jax.Array) -> jax.Array:
+    """[..., S] bool → [..., W] int32 bit words (bit s%32 of word s//32)."""
+    S = mask.shape[-1]
+    W = mask_words(S)
+    pad = W * 32 - S
+    if pad:
+        mask = jnp.pad(mask, [(0, 0)] * (mask.ndim - 1) + [(0, pad)])
+    w = mask.reshape(*mask.shape[:-1], W, 32).astype(jnp.uint32)
+    weights = jnp.left_shift(
+        jnp.uint32(1), jnp.arange(32, dtype=jnp.uint32)
+    )
+    packed = jnp.sum(w * weights, axis=-1, dtype=jnp.uint32)
+    return jax.lax.bitcast_convert_type(packed, jnp.int32)
+
+
+def unpack_bits(words, num_subscribers: int):
+    """Host-side inverse of `_pack_bits`: [..., W] int32 → [..., S] bool."""
+    import numpy as np
+
+    w = np.asarray(words).astype(np.uint32)
+    bits = (w[..., None] >> np.arange(32, dtype=np.uint32)) & 1
+    return bits.reshape(*w.shape[:-1], -1)[..., :num_subscribers].astype(bool)
+
+
 def _room_tick(
     state: PlaneState,
     inp: TickInputs,
     audio_params: audio.AudioLevelParams,
     bwe_params: bwe.BWEParams,
-    egress_cap: int,
     red_enabled: bool = True,
 ):
     """Tick for ONE room; every field has its leading R axis stripped."""
@@ -349,9 +383,10 @@ def _room_tick(
         boot_bps,
     )
     # Cumulative temporal shares from measured bytes; cold-start fractions
-    # until any bytes attribute.
+    # until any bytes attribute. (scanops: jnp.cumsum lowers to a
+    # reduce-window that measured ~2.7 ms/tick at cfg4 on these tiny axes.)
     tot = jnp.sum(temporal_bytes, axis=-1, keepdims=True)             # [T, L, 1]
-    cum = jnp.cumsum(temporal_bytes, axis=-1)                         # [T, L, 4]
+    cum = scanops.cumsum_small(temporal_bytes, axis=-1)               # [T, L, 4]
     frac0 = jnp.asarray(TEMPORAL_FRACTIONS, jnp.float32)
     frac = jnp.where(tot > 0, cum / jnp.maximum(tot, 1e-6), frac0[None, None, :])
     bitrates = jnp.zeros((T, 4, 4), jnp.float32)
@@ -361,7 +396,9 @@ def _room_tick(
     # reference reports cumulative SVC bitrates) — without this the
     # allocator over-commits the channel by the lower layers' bps.
     bitrates = jnp.where(
-        state.meta.is_svc[:, None, None], jnp.cumsum(bitrates, axis=1), bitrates
+        state.meta.is_svc[:, None, None],
+        scanops.cumsum_small(bitrates, axis=1),
+        bitrates,
     )
     # Audio has a single "layer": zero the matrix so allocation skips it.
     bitrates = jnp.where(state.meta.is_video[:, None, None], bitrates, 0.0)
@@ -391,41 +428,15 @@ def _room_tick(
     switch = jnp.where(is_video, v_switch & base[:, None, :], False)
     need_kf = need_kf & base & state.meta.is_video[:, None]
 
-    # ---- 6. SN/TS + VP8 munging (vmap over tracks) ---------------------
-    # inp.ts_jump: -1 when the host SR-normalized this packet's TS onto
-    # the track's common timeline (exact cross-layer continuity,
-    # forwarder.go:1456); else a one-frame fallback advance.
-    munger_state, out_sn, out_ts, send = jax.vmap(rtpmunger.munge_tick)(
-        state.munger, inp.sn, inp.ts, inp.valid, fwd, drop, switch, inp.ts_jump
-    )
-    vp8_state, out_pid, out_tl0, out_ki = jax.vmap(vp8.munge_tick)(
-        state.vp8_state, inp.pid, inp.tl0, inp.keyidx, inp.begin_pic,
-        inp.valid, fwd, drop, switch,
-    )
-
-    # (NACK/RTX replay is host-side: the egress batch already carries the
-    # munged SN/TS/descriptor of every send, so the host keeps the replay
-    # ring in numpy — runtime/plane_runtime.py HostSequencer — and answers
-    # NACKs at RTCP time instead of tick cadence.)
-
-    # ---- probe padding (WritePaddingRTP, downtrack.go:764) -------------
-    # The host probe controller asks for pad_num packets on pad_track's
-    # downtrack; padding continues the munged SN space after this tick's
-    # real sends, so it must run AFTER munge_tick.
-    pad_n = jnp.where(
-        jnp.arange(T, dtype=jnp.int32)[:, None] == inp.pad_track[None, :],
-        jnp.clip(inp.pad_num, 0, PAD_MAX)[None, :],
-        0,
-    )  # [T, S]
-    ts_adv = jnp.broadcast_to(inp.tick_ms * 90, (T, S)).astype(jnp.int32)
-    munger_state, t_pad_sn, t_pad_ts, t_pad_valid = jax.vmap(
-        lambda st, n, adv: rtpmunger.padding_tick(st, n, PAD_MAX, adv)
-    )(munger_state, pad_n, ts_adv)  # [T, PAD_MAX, S]
-    safe_track = jnp.clip(inp.pad_track, 0, T - 1)           # [S]
-    sub_ix = jnp.arange(S, dtype=jnp.int32)
-    pad_sn = t_pad_sn[safe_track, :, sub_ix]                  # [S, PAD_MAX]
-    pad_ts = t_pad_ts[safe_track, :, sub_ix]
-    pad_valid = t_pad_valid[safe_track, :, sub_ix] & (inp.pad_track >= 0)[:, None]
+    # ---- 6. egress decision finalized --------------------------------
+    # `fwd` IS the send mask: selection already folded in validity, the
+    # subscription/mute base, and the video/audio merge. The SN/TS/VP8
+    # value rewrites happen host-side (runtime/munge.py) from the
+    # send/drop/switch bits + host-owned offset state; NACK/RTX replay is
+    # likewise host-side (runtime/plane_runtime.py HostSequencer), and
+    # probe padding synthesis (WritePaddingRTP, downtrack.go:764) rides
+    # the same host state (HostMunger.padding).
+    send = fwd
 
     # ---- BWE per subscriber (uses this tick's actual send counts) ------
     # Released slots reset their per-sub state first: the next occupant
@@ -573,8 +584,6 @@ def _room_tick(
         ctrl=state.ctrl,
         stats=stats,
         audio_state=audio_state,
-        munger=munger_state,
-        vp8_state=vp8_state,
         sel=sel_state,
         bwe_state=bwe_state,
         delay_bwe=delay_bwe,
@@ -583,28 +592,10 @@ def _room_tick(
         red_state=red_state,
         temporal_bytes=temporal_bytes,
     )
-    # ---- device-side egress compaction ---------------------------------
-    # Dense [T, K, S] grids → up to `egress_cap` (t, k, s) writes. Keeps the
-    # device→host transfer proportional to traffic, not tensor capacity.
-    flat_send = send.reshape(-1)
-    (idx,) = jnp.nonzero(flat_send, size=egress_cap, fill_value=-1)
-    safe = jnp.maximum(idx, 0)
-    hit = idx >= 0
-
-    def compact(x):
-        return jnp.where(hit, x.reshape(-1)[safe], 0)
-
-    n_sends = jnp.sum(flat_send, dtype=jnp.int32)
-    overflow = n_sends - jnp.sum(hit, dtype=jnp.int32)
-
     outputs = TickOutputs(
-        egress_idx=idx.astype(jnp.int32),
-        egress_sn=compact(out_sn),
-        egress_ts=compact(out_ts),
-        egress_pid=compact(out_pid),
-        egress_tl0=compact(out_tl0),
-        egress_keyidx=compact(out_ki),
-        egress_overflow=overflow,
+        send_bits=_pack_bits(send),
+        drop_bits=_pack_bits(drop),
+        switch_bits=_pack_bits(switch),
         need_keyframe=need_kf,
         speaker_levels=spk_levels,
         speaker_tracks=spk_tracks,
@@ -620,9 +611,6 @@ def _room_tick(
         track_loss_pct=loss_pct,
         track_jitter_ms=jitter_ms,
         track_bps=jnp.sum(layer_bps, axis=-1),
-        pad_sn=pad_sn,
-        pad_ts=pad_ts,
-        pad_valid=pad_valid,
         committed_bps=budget,
         pacer_allowed=pacer_allowed,
         deficient=any_deficient,
@@ -633,35 +621,22 @@ def _room_tick(
     return new_state, outputs
 
 
-def default_egress_cap(dims: PlaneDims) -> int:
-    """Per-room egress capacity: every valid packet to up to 4 subscribers,
-    or the full grid if smaller (rounded up to a lane-friendly multiple)."""
-    full = dims.tracks * dims.pkts * dims.subs
-    cap = min(full, max(128, dims.tracks * dims.pkts * 4))
-    return -(-cap // 128) * 128 if cap < full else full
-
-
 def media_plane_tick(
     state: PlaneState,
     inp: TickInputs,
     audio_params: audio.AudioLevelParams = audio.AudioLevelParams(),
     bwe_params: bwe.BWEParams = bwe.BWEParams(),
-    egress_cap: int | None = None,
     red_enabled: bool = True,
 ):
     """One tick of the full media plane, vmapped over the room axis.
 
     jit this (donating `state`) and step it from the runtime loop;
-    `egress_cap` and `red_enabled` are static per compile. The [R] axis is
-    the mesh-sharded axis (see livekit_server_tpu.parallel.mesh).
+    `red_enabled` is static per compile. The [R] axis is the mesh-sharded
+    axis (see livekit_server_tpu.parallel.mesh).
     """
-    if egress_cap is None:
-        T, K, S = inp.sn.shape[1], inp.sn.shape[2], inp.estimate.shape[1]
-        egress_cap = default_egress_cap(PlaneDims(inp.sn.shape[0], T, K, S))
-
     # Scalars (tick_ms) broadcast; everything else has a leading R axis.
     def tick_one(st, i):
-        return _room_tick(st, i, audio_params, bwe_params, egress_cap, red_enabled)
+        return _room_tick(st, i, audio_params, bwe_params, red_enabled)
 
     inp_axes = TickInputs(**{f: 0 for f in TickInputs._fields})._replace(
         tick_ms=None, roll_quality=None
@@ -680,16 +655,20 @@ def media_plane_tick(
 # "double-buffered DMA").
 # ---------------------------------------------------------------------------
 
+# Fields uploaded to the device. TickInputs also carries HOST-ONLY fields
+# (pid / tl0 / keyidx / ts_jump / pad_num / pad_track) consumed by the
+# host munger + padding synthesis (runtime/munge.py) — the device tick
+# never reads them, so they are not packed onto the wire.
 PKT_FIELDS = (
     "sn", "ts", "layer", "temporal", "keyframe", "layer_sync", "begin_pic",
-    "end_frame", "pid", "tl0", "keyidx", "size", "frame_ms", "audio_level",
-    "arrival_rtp", "ts_jump", "valid",
+    "end_frame", "size", "frame_ms", "audio_level", "arrival_rtp", "valid",
 )
 _BOOL_FIELDS = {"keyframe", "layer_sync", "begin_pic", "end_frame", "valid"}
+HOST_ONLY_PKT_FIELDS = ("pid", "tl0", "keyidx", "ts_jump")
 
 
 def pack_tick_inputs(inp: TickInputs):
-    """Host-side: TickInputs → (pkt [F,R,T,K] i32, fb [10,R,S] f32,
+    """Host-side: TickInputs → (pkt [F,R,T,K] i32, fb [8,R,S] f32,
     tf [1,R,T] f32, tick_ms, roll_quality)."""
     import numpy as np
 
@@ -699,8 +678,6 @@ def pack_tick_inputs(inp: TickInputs):
             np.asarray(inp.estimate, np.float32),
             np.asarray(inp.estimate_valid).astype(np.float32),
             np.asarray(inp.nacks, np.float32),
-            np.asarray(inp.pad_num, np.float32),
-            np.asarray(inp.pad_track, np.float32),
             np.asarray(inp.fb_delay_ms, np.float32),
             np.asarray(inp.fb_recv_bps, np.float32),
             np.asarray(inp.fb_valid).astype(np.float32),
@@ -719,24 +696,31 @@ def unpack_tick_inputs(
     pkt: jax.Array, fb: jax.Array, tf: jax.Array,
     tick_ms: jax.Array, roll_quality: jax.Array,
 ) -> TickInputs:
-    """Device-side (traced): stacked arrays → TickInputs."""
+    """Device-side (traced): stacked arrays → TickInputs.
+
+    Host-only fields are filled with zeros: the device algebra never reads
+    them (XLA dead-code-eliminates the placeholders)."""
     fields = {}
     for i, name in enumerate(PKT_FIELDS):
         x = pkt[i]
         fields[name] = x.astype(jnp.bool_) if name in _BOOL_FIELDS else x
+    z_pkt = jnp.zeros_like(pkt[0])
+    for name in HOST_ONLY_PKT_FIELDS:
+        fields[name] = z_pkt
+    z_sub = jnp.zeros(fb.shape[1:], jnp.int32)
     return TickInputs(
         **fields,
         estimate=fb[0],
         estimate_valid=fb[1] > 0.5,
         nacks=fb[2],
         pub_rtt_ms=tf[0],
-        pad_num=fb[3].astype(jnp.int32),
-        pad_track=fb[4].astype(jnp.int32),
-        fb_delay_ms=fb[5],
-        fb_recv_bps=fb[6],
-        fb_valid=fb[7] > 0.5,
-        fb_enabled=fb[8] > 0.5,
-        sub_reset=fb[9] > 0.5,
+        pad_num=z_sub,
+        pad_track=z_sub - 1,
+        fb_delay_ms=fb[3],
+        fb_recv_bps=fb[4],
+        fb_valid=fb[5] > 0.5,
+        fb_enabled=fb[6] > 0.5,
+        sub_reset=fb[7] > 0.5,
         tick_ms=tick_ms,
         roll_quality=roll_quality,
     )
@@ -756,17 +740,17 @@ def pack_tick_outputs(out: TickOutputs) -> jax.Array:
 
 
 def unpack_tick_outputs(
-    buf, dims: PlaneDims, egress_cap: int, red_enabled: bool = True
+    buf, dims: PlaneDims, red_enabled: bool = True
 ) -> TickOutputs:
     """Host-side: flat int32 numpy buffer → TickOutputs of numpy arrays."""
     import numpy as np
 
     R, T, K, S = dims
-    E = egress_cap
+    W = mask_words(S)
     shapes = {
-        "egress_idx": (R, E), "egress_sn": (R, E), "egress_ts": (R, E),
-        "egress_pid": (R, E), "egress_tl0": (R, E), "egress_keyidx": (R, E),
-        "egress_overflow": (R,),
+        "send_bits": (R, T, K, W),
+        "drop_bits": (R, T, K, W),
+        "switch_bits": (R, T, K, W),
         "need_keyframe": (R, T, S),
         "speaker_levels": (R, SPEAKER_TOP_K),
         "speaker_tracks": (R, SPEAKER_TOP_K),
@@ -782,9 +766,6 @@ def unpack_tick_outputs(
         "track_loss_pct": (R, T),
         "track_jitter_ms": (R, T),
         "track_bps": (R, T),
-        "pad_sn": (R, S, PAD_MAX),
-        "pad_ts": (R, S, PAD_MAX),
-        "pad_valid": (R, S, PAD_MAX),
         "committed_bps": (R, S),
         "pacer_allowed": (R, S),
         "deficient": (R, S),
@@ -794,7 +775,7 @@ def unpack_tick_outputs(
     }
     floats = {"speaker_levels", "track_mos", "track_loss_pct", "track_jitter_ms",
               "track_bps", "committed_bps", "pacer_allowed", "layer_fps"}
-    bools = {"need_keyframe", "congested", "pad_valid", "deficient", "red_ok"}
+    bools = {"need_keyframe", "congested", "deficient", "red_ok"}
     buf = np.asarray(buf)
     pieces, off = {}, 0
     for name in TickOutputs._fields:
@@ -809,31 +790,12 @@ def unpack_tick_outputs(
     return TickOutputs(**pieces)
 
 
-def egress_to_dense(out: TickOutputs, dims: PlaneDims):
-    """Reconstruct dense [R,T,K,S] grids from compacted egress (test/debug
-    helper; production consumers iterate the compact form directly)."""
-    import numpy as np
-
-    R, T, K, S = dims
-    send = np.zeros((R, T, K, S), bool)
-    grids = {
-        name: np.zeros((R, T, K, S), np.int32)
-        for name in ("sn", "ts", "pid", "tl0", "keyidx")
-    }
-    idx = np.asarray(out.egress_idx)
-    fields = {
-        "sn": np.asarray(out.egress_sn),
-        "ts": np.asarray(out.egress_ts),
-        "pid": np.asarray(out.egress_pid),
-        "tl0": np.asarray(out.egress_tl0),
-        "keyidx": np.asarray(out.egress_keyidx),
-    }
-    for r in range(R):
-        valid = idx[r] >= 0
-        flat = idx[r][valid]
-        t, rem = np.divmod(flat, K * S)
-        k, s = np.divmod(rem, S)
-        send[r, t, k, s] = True
-        for name in grids:
-            grids[name][r, t, k, s] = fields[name][r][valid]
-    return send, grids["sn"], grids["ts"], grids["pid"], grids["tl0"], grids["keyidx"]
+def masks_to_dense(out: TickOutputs, dims: PlaneDims):
+    """Unpack the bit-packed egress masks to dense [R,T,K,S] bools
+    (host/test helper; the runtime's fan-out uses the same expansion)."""
+    S = dims.subs
+    return (
+        unpack_bits(out.send_bits, S),
+        unpack_bits(out.drop_bits, S),
+        unpack_bits(out.switch_bits, S),
+    )

@@ -43,19 +43,27 @@ PARSED_DTYPE = np.dtype(
 )
 
 
-def _build() -> Path | None:
+def _compile(src: Path, so_name: str, extra_flags: tuple[str, ...] = ()) -> Path | None:
+    """Build (or reuse) one cached shared library; None on any failure —
+    including a missing source next to a stale cache — so callers fall
+    back to their pure-Python paths instead of dying at import."""
     _CACHE.mkdir(exist_ok=True)
-    so = _CACHE / "librtp_parser.so"
-    if so.exists() and so.stat().st_mtime >= _SRC.stat().st_mtime:
-        return so
+    so = _CACHE / so_name
     try:
+        if so.exists() and so.stat().st_mtime >= src.stat().st_mtime:
+            return so
         subprocess.run(
-            ["g++", "-O2", "-shared", "-fPIC", "-o", str(so), str(_SRC)],
+            ["g++", "-O2", "-shared", "-fPIC", "-o", str(so), str(src),
+             *extra_flags],
             check=True, capture_output=True, timeout=120,
         )
         return so
-    except (subprocess.SubprocessError, FileNotFoundError):
+    except (subprocess.SubprocessError, FileNotFoundError, OSError):
         return None
+
+
+def _build() -> Path | None:
+    return _compile(_SRC, "librtp_parser.so")
 
 
 class _NativeRTP:
@@ -420,19 +428,9 @@ class _PythonRTP:
 
 
 def _build_egress() -> Path | None:
-    _CACHE.mkdir(exist_ok=True)
-    so = _CACHE / "libegress.so"
-    if so.exists() and so.stat().st_mtime >= _EGRESS_SRC.stat().st_mtime:
-        return so
-    try:
-        subprocess.run(
-            ["g++", "-O2", "-shared", "-fPIC", "-pthread", "-o", str(so),
-             str(_EGRESS_SRC), "-l:libcrypto.so.3"],
-            check=True, capture_output=True, timeout=120,
-        )
-        return so
-    except (subprocess.SubprocessError, FileNotFoundError):
-        return None
+    return _compile(
+        _EGRESS_SRC, "libegress.so", ("-pthread", "-l:libcrypto.so.3")
+    )
 
 
 class NativeEgress:
@@ -614,6 +612,69 @@ class NativeEgress:
         ))
 
 
+_MUNGE_SRC = Path(__file__).resolve().parents[2] / "native" / "munge.cpp"
+
+
+def _build_munge() -> Path | None:
+    return _compile(_MUNGE_SRC, "libmunge.so")
+
+
+class NativeMunge:
+    """One-call-per-tick munge walk: expand bit-packed send/drop/switch
+    masks and apply the SN/TS/VP8 rewrites (rtpmunger.go UpdateAndGetSnTs +
+    codecmunger/vp8.go UpdateAndGet) with host-owned state — the rewrite
+    half of DownTrack.WriteRTP. Semantics pinned to runtime/munge.py's
+    numpy spec by tests/test_host_munge.py."""
+
+    def __init__(self, so: Path):
+        self.lib = ctypes.CDLL(str(so))
+        self.lib.munge_walk.restype = ctypes.c_int64
+        self.lib.munge_walk.argtypes = (
+            [ctypes.c_int32] * 5 + [ctypes.c_void_p] * 11
+            + [ctypes.c_void_p] * 13 + [ctypes.c_void_p] * 9
+            + [ctypes.c_int64]
+        )
+
+    def walk(self, sn, ts, ts_jump, pid, tl0, keyidx, begin_pic, valid,
+             send_bits, drop_bits, switch_bits, state, cap: int):
+        """Returns column arrays (rooms, tracks, ks, subs, sn, ts, pid,
+        tl0, keyidx) of the `cap`-bounded walk; None if cap overflowed
+        (caller falls back). `state` is the HostMunger — its arrays are
+        updated in place."""
+        R, T, K = sn.shape
+        S = state.sn_offset.shape[-1]
+        W = send_bits.shape[-1]
+        c32 = lambda x: np.ascontiguousarray(x, np.int32)  # noqa: E731
+        cw = lambda x: np.ascontiguousarray(x).view(np.uint32)  # noqa: E731
+        cu8 = lambda x: np.ascontiguousarray(x, np.uint8)  # noqa: E731
+        sn_c, ts_c, tj_c = c32(sn), c32(ts), c32(ts_jump)
+        pid_c, tl0_c, ki_c = c32(pid), c32(tl0), c32(keyidx)
+        bp_c, v_c = cu8(begin_pic), cu8(valid)
+        sb, db, wb = cw(c32(send_bits)), cw(c32(drop_bits)), cw(c32(switch_bits))
+        outs = [np.empty(cap, np.int32) for _ in range(9)]
+        st_ptrs = [
+            getattr(state, f).ctypes.data for f in (
+                "sn_offset", "ts_offset", "last_sn", "last_ts",
+                "started", "aligned",
+                "pid_offset", "tl0_offset", "ki_offset",
+                "last_pid", "last_tl0", "last_ki", "v_started",
+            )
+        ]
+        n = self.lib.munge_walk(
+            R, T, K, S, W,
+            sb.ctypes.data, db.ctypes.data, wb.ctypes.data,
+            sn_c.ctypes.data, ts_c.ctypes.data, tj_c.ctypes.data,
+            pid_c.ctypes.data, tl0_c.ctypes.data, ki_c.ctypes.data,
+            bp_c.ctypes.data, v_c.ctypes.data,
+            *st_ptrs,
+            *[o.ctypes.data for o in outs],
+            cap,
+        )
+        if n < 0:
+            return None
+        return tuple(o[:n] for o in outs)
+
+
 def _load():
     so = _build()
     if so is not None:
@@ -634,5 +695,16 @@ def _load_egress():
     return None
 
 
+def _load_munge():
+    so = _build_munge()
+    if so is not None:
+        try:
+            return NativeMunge(so)
+        except OSError:
+            return None
+    return None
+
+
 rtp = _load()
 egress = _load_egress()
+munge = _load_munge()

@@ -67,7 +67,9 @@ def encode_plan_tick(
     # gathers over the K axis instead of the per-packet scan the original
     # used: the scan's per-step shift chain dominated the cfg4 tick.
     valid_i = valid.astype(jnp.int32)
-    rank = jnp.cumsum(valid_i, axis=-1) - valid_i           # [T, K] excl.
+    from livekit_server_tpu.ops import scanops
+
+    rank = scanops.cumsum_small(valid_i, axis=-1) - valid_i  # [T, K] excl.
     js = jnp.arange(D, dtype=jnp.int32)                     # [D]
     cand_rank = rank[:, :, None] - 1 - js[None, None, :]    # [T, K, D]
     from_tick = cand_rank >= 0

@@ -1,0 +1,31 @@
+"""Small-axis prefix ops that stay elementwise.
+
+`jnp.cumsum` lowers to `reduce-window` on TPU; at the media plane's tiny
+static axes (4 spatial layers, K ≤ 16 packet slots) that lowering measured
+~2.7 ms of an 8 ms cfg4 tick — three orders slower than the work it does.
+These helpers express the same prefix sums as log₂(n) shift-adds, which
+XLA fuses into the surrounding elementwise graph for free.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+
+def cumsum_small(x: jnp.ndarray, axis: int) -> jnp.ndarray:
+    """Inclusive prefix sum along a SMALL static axis via log-shift adds.
+
+    Bit-exact with jnp.cumsum for ints; for floats the summation order
+    differs (pairwise vs serial) — fine for the EMA/bitrate uses here.
+    """
+    n = x.shape[axis]
+    axis = axis % x.ndim
+    shift = 1
+    while shift < n:
+        pad = [(0, 0)] * x.ndim
+        pad[axis] = (shift, 0)
+        sl = [slice(None)] * x.ndim
+        sl[axis] = slice(0, n - shift)
+        x = x + jnp.pad(x[tuple(sl)], pad)
+        shift *= 2
+    return x

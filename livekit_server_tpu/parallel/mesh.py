@@ -69,7 +69,6 @@ def make_sharded_tick(
     audio_params: Any | None = None,
     bwe_params: Any | None = None,
     donate: bool = True,
-    egress_cap: int | None = None,
     red_enabled: bool = True,
 ):
     """jit of the full media-plane tick with room-axis in/out shardings.
@@ -83,9 +82,7 @@ def make_sharded_tick(
     bp = bwe_params or bwe_ops.BWEParams()
 
     def tick(state, inp):
-        return plane.media_plane_tick(
-            state, inp, ap, bp, egress_cap=egress_cap, red_enabled=red_enabled
-        )
+        return plane.media_plane_tick(state, inp, ap, bp, red_enabled=red_enabled)
 
     rs = room_sharding(mesh)
     rep = replicated(mesh)

@@ -35,6 +35,7 @@ import numpy as np
 
 from livekit_server_tpu.models import plane
 from livekit_server_tpu.runtime.ingest import IngestBuffer
+from livekit_server_tpu.runtime.munge import HostMunger
 from livekit_server_tpu.runtime.probe import PAD_BYTES, ProbeController
 from livekit_server_tpu.runtime.slots import SlotAllocator
 
@@ -231,15 +232,14 @@ class TickResult:
 
 
 @functools.lru_cache(maxsize=None)
-def _build_step(audio_params, bwe_params, egress_cap, red_enabled=True):
+def _build_step(audio_params, bwe_params, red_enabled=True):
     """Packed-wire step: ONE input upload, ONE output fetch per tick
     (plane.pack_tick_inputs / pack_tick_outputs)."""
 
     def tick(state, pkt, fb, tf, tick_ms, roll_quality):
         inp = plane.unpack_tick_inputs(pkt, fb, tf, tick_ms, roll_quality)
         state, out = plane.media_plane_tick(
-            state, inp, audio_params, bwe_params, egress_cap=egress_cap,
-            red_enabled=red_enabled,
+            state, inp, audio_params, bwe_params, red_enabled=red_enabled,
         )
         return state, plane.pack_tick_outputs(out)
 
@@ -256,15 +256,12 @@ class PlaneRuntime:
         mesh=None,
         audio_params=None,
         bwe_params=None,
-        egress_cap: int | None = None,
         red_enabled: bool = True,
     ):
         from livekit_server_tpu.ops import audio as audio_ops, bwe as bwe_ops
 
         self.dims = dims
         self.tick_ms = tick_ms
-        self.egress_cap = egress_cap or plane.default_egress_cap(dims)
-        self._want_cap = self.egress_cap  # grows on overflow (auto-widen)
         self.red_enabled = red_enabled
         self.slots = SlotAllocator(dims.rooms, dims.tracks, dims.subs)
         self.ingest = IngestBuffer(dims, tick_ms)
@@ -290,20 +287,22 @@ class PlaneRuntime:
         self._ctrl_dirty = True
 
         self.state = plane.init_state(dims)
+        # Host-owned SN/TS/VP8 rewrite state (the round-5 decide-on-
+        # device / rewrite-on-host split; see runtime/munge.py).
+        self.munger = HostMunger(dims)
         self._mesh = mesh
         if mesh is not None:
             from livekit_server_tpu.parallel import make_sharded_tick, shard_tree
 
             self.state = shard_tree(self.state, mesh)
             self._step = make_sharded_tick(
-                mesh, self._ap, self._bp, donate=True, egress_cap=self.egress_cap,
-                red_enabled=red_enabled,
+                mesh, self._ap, self._bp, donate=True, red_enabled=red_enabled,
             )
         else:
             # Shared across PlaneRuntime instances with identical params so
             # repeated construction (tests, restarts) reuses the XLA
             # compilation cache instead of re-tracing a fresh closure.
-            self._step = _build_step(self._ap, self._bp, self.egress_cap, red_enabled)
+            self._step = _build_step(self._ap, self._bp, red_enabled)
 
         # Rolling payload history for NACK replay (slab keys reference slot
         # tick % SLAB_WINDOW; resolve_nacks age-gates so a recycled slot is
@@ -374,30 +373,16 @@ class PlaneRuntime:
         # room's NACK aliasing an old slot would retransmit the PREVIOUS
         # room's media bytes (cross-room leak).
         self.host_seq.clear_room(room)
+        # Munger offsets likewise: the next tenant's streams must anchor
+        # fresh, not continue a dead room's SN/TS spaces.
+        self.munger.clear_room(room)
         self._ctrl_dirty = True
 
     def on_tick(self, cb: Callable[[TickResult], Awaitable[None] | None]) -> None:
         self._on_tick.append(cb)
 
-    def _widen_egress_cap(self, new_cap: int) -> None:
-        """Swap in a step compiled with a larger egress cap (a static
-        compile arg) at a tick boundary. Pays one recompile — caps double,
-        so a room-burst costs at most log2(grid/cap) recompiles ever."""
-        self.egress_cap = new_cap
-        if self._mesh is not None:
-            from livekit_server_tpu.parallel import make_sharded_tick
-
-            self._step = make_sharded_tick(
-                self._mesh, self._ap, self._bp, donate=True,
-                egress_cap=new_cap, red_enabled=self.red_enabled,
-            )
-        else:
-            self._step = _build_step(
-                self._ap, self._bp, new_cap, self.red_enabled
-            )
-        self.stats["egress_cap_widened"] = (
-            self.stats.get("egress_cap_widened", 0) + 1
-        )
+    # (The r4 egress-cap auto-widening machinery is gone: the bit-packed
+    # mask egress has no capacity to overflow — every send is one bit.)
 
     # -- tick ------------------------------------------------------------
     def _upload_ctrl(self) -> None:
@@ -424,15 +409,13 @@ class PlaneRuntime:
         packed = plane.pack_tick_inputs(inp)
         self.state, buf = self._step(self.state, *packed)
         return plane.unpack_tick_outputs(
-            np.asarray(buf), self.dims, self.egress_cap, self.red_enabled
+            np.asarray(buf), self.dims, self.red_enabled
         )
 
     def _stage(self):
         """Host pre-step: ctrl upload, probe scheduling, ingest drain.
         Claims this tick's index; returns (inp, payloads, idx, roll, t0)."""
         t0 = time.perf_counter()
-        if self._want_cap > self.egress_cap:
-            self._widen_egress_cap(self._want_cap)
         if self._ctrl_dirty:
             self._upload_ctrl()
         idx = self.tick_index
@@ -570,72 +553,42 @@ class PlaneRuntime:
             self.stats["rtx_packets"] = self.stats.get("rtx_packets", 0) + len(replays)
         return replays
 
-    def _assemble_padding(self, out, inp) -> list[EgressPacket]:
-        """Device-synthesized probe padding → EgressPackets (the host half
-        of WritePaddingRTP; cold path — probing windows only)."""
-        pv = np.asarray(out.pad_valid)
-        hits = np.nonzero(pv)
-        if not len(hits[0]):
-            return []
-        psn, pts = np.asarray(out.pad_sn), np.asarray(out.pad_ts)
+    def _assemble_padding(self, inp) -> list[EgressPacket]:
+        """Probe padding synthesis (the host half of WritePaddingRTP;
+        cold path — probing windows only). Advances the host munger's SN
+        lanes after this tick's real sends, exactly like the former
+        device-side rtpmunger.padding_tick."""
+        pads = self.munger.padding(
+            inp.pad_num, inp.pad_track, ts_advance=self.tick_ms * 90
+        )
         return [
             EgressPacket(
-                room=int(r), track=int(inp.pad_track[r, s]), sub=int(s),
-                sn=int(psn[r, s, j]) & 0xFFFF,
-                ts=int(pts[r, s, j]) & 0xFFFFFFFF,
+                room=r, track=t, sub=s, sn=sn, ts=ts,
                 pid=0, tl0=0, keyidx=0,
                 size=PAD_BYTES, payload=b"", padding=True,
             )
-            for r, s, j in zip(*hits)
+            for (r, t, s, sn, ts) in pads
         ]
 
     def _fan_out(self, out, payloads, inp, tick_s: float, tick_idx: int | None = None) -> TickResult:
-        # Compacted egress: [R, E] index lists (see plane.TickOutputs) →
-        # column arrays. No per-packet Python objects here; the wire path
-        # consumes the batch arrays directly (DownTrackSpreader's fan-out
-        # loop became pure array math).
-        K, S = self.dims.pkts, self.dims.subs
-        idx = out.egress_idx
-        E = idx.shape[1]
-        rr, ee = np.nonzero(idx >= 0)
-        # Shared flat index for the six field gathers.
-        fidx = rr * E + ee
-        flat = idx.reshape(-1)[fidx]
-        tt, rem = np.divmod(flat, K * S)
-        kk, ss = np.divmod(rem, S)
+        # Bit-packed egress masks → host munge (runtime/munge.py) →
+        # column arrays. The device ships one bit per (track, pkt, sub)
+        # send; the SN/TS/VP8 value rewrites run here with host-owned
+        # offset state (the rewrite half of DownTrack.WriteRTP,
+        # rtpmunger.go + codecmunger/vp8.go) — via the native C++ walker
+        # when built, numpy otherwise.
+        rr, tt, kk, ss, b_sn, b_ts, b_pid, b_tl0, b_ki = (
+            self.munger.apply_columns(
+                inp.sn, inp.ts, inp.ts_jump, inp.pid, inp.tl0, inp.keyidx,
+                inp.begin_pic, inp.valid,
+                out.send_bits, out.drop_bits, out.switch_bits,
+            )
+        )
         batch = EgressBatch(
-            rooms=rr.astype(np.int32),
-            tracks=tt.astype(np.int32),
-            ks=kk.astype(np.int32),
-            subs=ss.astype(np.int32),
-            sn=out.egress_sn.reshape(-1)[fidx],
-            ts=out.egress_ts.reshape(-1)[fidx],
-            pid=out.egress_pid.reshape(-1)[fidx],
-            tl0=out.egress_tl0.reshape(-1)[fidx],
-            keyidx=out.egress_keyidx.reshape(-1)[fidx],
+            rooms=rr, tracks=tt, ks=kk, subs=ss,
+            sn=b_sn, ts=b_ts, pid=b_pid, tl0=b_tl0, keyidx=b_ki,
             payloads=payloads,
         )
-        overflow = int(out.egress_overflow.sum())
-        if overflow:
-            self.stats["egress_overflow"] = self.stats.get("egress_overflow", 0) + overflow
-            # Honor plane.py's contract: widen the cap instead of silently
-            # dropping every burst tick until a human reads /debug. The
-            # recompile lands at the next stage() boundary (reference
-            # analog: pacer queues are bounded but DRAIN —
-            # pacer/leaky_bucket.go:47-200; sustained overflow there is
-            # backpressure, not permanent loss). The cap is PER ROOM, so
-            # size from the worst single room's overflow — summing across
-            # rooms would overshoot a multi-room burst straight to the
-            # full grid.
-            worst = int(out.egress_overflow.max())
-            self._want_cap = max(
-                self._want_cap,
-                min(
-                    self.dims.tracks * self.dims.pkts * self.dims.subs,
-                    max(2 * self.egress_cap,
-                        -(-(self.egress_cap + worst) // 128) * 128),
-                ),
-            )
         speakers: dict[int, list[tuple[int, float]]] = {}
         lv, tr = out.speaker_levels, out.speaker_tracks
         for r in range(lv.shape[0]):
@@ -656,7 +609,7 @@ class PlaneRuntime:
         # Feed the host replay ring from this tick's sends (the push half
         # of the sequencer, now host-side — NACKs resolve at RTCP time).
         self.host_seq.record(batch, self.tick_index if tick_idx is None else tick_idx)
-        padding = self._assemble_padding(out, inp)
+        padding = self._assemble_padding(inp)
         if padding:
             self.stats["pad_packets"] = self.stats.get("pad_packets", 0) + len(padding)
         return TickResult(
@@ -762,11 +715,13 @@ class PlaneRuntime:
 
     # -- checkpoint / resume (§5.4) --------------------------------------
     def snapshot(self) -> dict[str, Any]:
-        """Serializable device-state snapshot (migration seeding analog)."""
+        """Serializable plane snapshot: device decision state + the
+        host-side munger offsets (migration seeding analog)."""
         flat, treedef = jax.tree.flatten(self.state)
         return {
             "tick_index": self.tick_index,
             "arrays": [np.asarray(x) for x in flat],
+            "munger": self.munger.snapshot(),
         }
 
     def snapshot_room(self, row: int) -> dict[str, Any]:
@@ -776,7 +731,9 @@ class PlaneRuntime:
 
         Control tensors come from the HOST mirrors (authoritative: they may
         hold un-uploaded mutations newer than the device copy); everything
-        else slices on device first so only one row crosses HBM→host."""
+        else slices on device first so only one row crosses HBM→host. The
+        host munger's row (SN/TS/VP8 offsets — RTPMungerState seeding,
+        rtpmunger.go:53-69) rides along after the device leaves."""
         flat, treedef = jax.tree.flatten(self.state)
         arrays = [np.asarray(x[row]) for x in flat]
         tree = jax.tree.unflatten(treedef, arrays)
@@ -784,7 +741,10 @@ class PlaneRuntime:
             meta=plane.TrackMeta(*[np.array(m[row]) for m in self.meta]),
             ctrl=plane.SubControl(*[np.array(c[row]) for c in self.ctrl]),
         )
-        return {"arrays": jax.tree.flatten(tree)[0]}
+        return {
+            "arrays": jax.tree.flatten(tree)[0]
+            + self.munger.snapshot_room(row)
+        }
 
     @staticmethod
     def encode_room_snapshot(snap: dict[str, Any]) -> str:
@@ -826,14 +786,18 @@ class PlaneRuntime:
         # and must not retain entries from whatever used the row before.
         self.host_seq.clear_room(row)
         flat, treedef = jax.tree.flatten(self.state)
-        if len(flat) != len(snap["arrays"]):
+        n_munger = len(HostMunger.FIELDS)
+        if len(snap["arrays"]) != len(flat) + n_munger:
             raise ValueError(
                 f"snapshot has {len(snap['arrays'])} leaves, plane has "
-                f"{len(flat)} — source/destination plane versions differ"
+                f"{len(flat)} + {n_munger} munger fields — "
+                f"source/destination plane versions differ"
             )
+        dev_arrays = snap["arrays"][: len(flat)]
+        self.munger.restore_room(row, snap["arrays"][len(flat):])
         new_flat = [
             leaf.at[row].set(jnp.asarray(a, leaf.dtype))
-            for leaf, a in zip(flat, snap["arrays"])
+            for leaf, a in zip(flat, dev_arrays)
         ]
         self.state = jax.tree.unflatten(treedef, new_flat)
         if self._mesh is not None:
@@ -842,7 +806,7 @@ class PlaneRuntime:
             self.state = shard_tree(self.state, self._mesh)
         # Mirror the migrated row's track metadata back to the host copies
         # (other rows' possibly-dirty host state stays untouched)…
-        snap_tree = jax.tree.unflatten(treedef, snap["arrays"])
+        snap_tree = jax.tree.unflatten(treedef, dev_arrays)
         for host_arr, snap_arr in zip(self.meta, snap_tree.meta):
             host_arr[row] = snap_arr
         # …but clear the subscriber-facing control masks (see docstring);
@@ -861,5 +825,7 @@ class PlaneRuntime:
             from livekit_server_tpu.parallel import shard_tree
 
             self.state = shard_tree(self.state, self._mesh)
+        if "munger" in snap:
+            self.munger.restore(snap["munger"])
         self.tick_index = snap["tick_index"]
         self._ctrl_dirty = True

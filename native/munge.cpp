@@ -1,0 +1,186 @@
+// Host-side munge walker: expand bit-packed send/drop/switch masks and
+// apply the SN/TS/VP8 offset rewrites in one pass.
+//
+// Reference parity: pkg/sfu/rtpmunger.go UpdateAndGetSnTs/PacketDropped and
+// pkg/sfu/codecmunger/vp8.go UpdateAndGet — the per-packet CPU work the
+// reference does in DownTrack.WriteRTP. Semantics are pinned bit-identical
+// to ops/rtpmunger.py + ops/vp8.py (the jax scan spec) by
+// tests/test_host_munge.py; the numpy implementation in runtime/munge.py
+// is the readable fallback.
+//
+// Layout contract (see runtime/munge.py HostMunger):
+//   packet fields  int32  [R*T*K]          (row-major r, t, k)
+//   mask words     uint32 [R*T*K*W]        (bit s%32 of word s/32)
+//   state arrays   int64  [R*T*S], bools uint8 [R*T*S] (updated in place)
+//   outputs        int32 column arrays, capacity >= popcount(send&valid)
+// Walk order matches np.nonzero: ascending (r, t, k, s).
+
+#include <cstdint>
+
+namespace {
+
+constexpr int64_t M16 = 0xFFFF;
+constexpr int64_t M32 = 0xFFFFFFFFll;
+constexpr int64_t M15 = 0x7FFF;
+constexpr int64_t M8 = 0xFF;
+constexpr int64_t M5 = 0x1F;
+constexpr int64_t REANCHOR_TS_THRESH = 900000;  // ops/rtpmunger.py
+constexpr int64_t FALLBACK_TS_JUMP = 3000;
+
+inline int64_t sdiff32(int64_t a, int64_t b) {
+  int64_t d = (a - b + (1ll << 31)) & M32;
+  return d - (1ll << 31);
+}
+
+}  // namespace
+
+extern "C" {
+
+// Returns the number of egress entries written, or -1 if cap would
+// overflow. The capacity check happens in a COUNTING pre-pass before any
+// state mutation: a mid-walk bailout would leave the munger offsets
+// half-advanced, and the caller's fallback would then double-apply the
+// tick (state corruption on every walked lane).
+int64_t munge_walk(
+    int32_t R, int32_t T, int32_t K, int32_t S, int32_t W,
+    const uint32_t* send_bits, const uint32_t* drop_bits,
+    const uint32_t* switch_bits,
+    const int32_t* sn, const int32_t* ts, const int32_t* ts_jump,
+    const int32_t* pid, const int32_t* tl0, const int32_t* ki,
+    const uint8_t* begin_pic, const uint8_t* valid,
+    int64_t* st_sn_off, int64_t* st_ts_off, int64_t* st_last_sn,
+    int64_t* st_last_ts, uint8_t* st_started, uint8_t* st_aligned,
+    int64_t* st_pid_off, int64_t* st_tl0_off, int64_t* st_ki_off,
+    int64_t* st_last_pid, int64_t* st_last_tl0, int64_t* st_last_ki,
+    uint8_t* st_v_started,
+    int32_t* out_rooms, int32_t* out_tracks, int32_t* out_ks,
+    int32_t* out_subs, int32_t* out_sn, int32_t* out_ts, int32_t* out_pid,
+    int32_t* out_tl0, int32_t* out_ki, int64_t cap) {
+  int64_t need = 0;
+  const int64_t words = (int64_t)R * T * K * W;
+  for (int64_t rtk = 0; rtk < words / W; ++rtk) {
+    if (!valid[rtk]) continue;
+    for (int32_t w = 0; w < W; ++w) {
+      need += __builtin_popcount(send_bits[rtk * W + w]);
+    }
+    if (need > cap) return -1;  // nothing mutated yet
+  }
+  int64_t n = 0;
+  for (int32_t r = 0; r < R; ++r) {
+    for (int32_t t = 0; t < T; ++t) {
+      const int64_t rt = (int64_t)r * T + t;
+      const int64_t pk_base = rt * K;
+      const int64_t st_base = rt * S;
+      for (int32_t k = 0; k < K; ++k) {
+        if (!valid[pk_base + k]) continue;
+        const int64_t wb = (pk_base + k) * W;
+        // Visit only lanes with a send or drop bit (switch ⊆ send).
+        bool any = false;
+        for (int32_t w = 0; w < W; ++w) {
+          if (send_bits[wb + w] | drop_bits[wb + w]) { any = true; break; }
+        }
+        if (!any) continue;
+        const int64_t p_sn = (int64_t)(uint32_t)sn[pk_base + k] & M16;
+        const int64_t p_ts = (int64_t)(uint32_t)ts[pk_base + k] & M32;
+        const int64_t p_jump = ts_jump[pk_base + k];
+        const bool pkt_aligned = p_jump < 0;
+        const int64_t jump_eff = pkt_aligned ? FALLBACK_TS_JUMP : p_jump;
+        const int64_t p_pid = (int64_t)(uint32_t)pid[pk_base + k] & M15;
+        const int64_t p_tl0 = (int64_t)(uint32_t)tl0[pk_base + k] & M8;
+        const int64_t p_ki = (int64_t)(uint32_t)ki[pk_base + k] & M5;
+        const bool bp = begin_pic[pk_base + k] != 0;
+        for (int32_t w = 0; w < W; ++w) {
+          uint32_t bits = send_bits[wb + w] | drop_bits[wb + w];
+          while (bits) {
+            const int32_t b = __builtin_ctz(bits);
+            bits &= bits - 1;
+            const int32_t s = w * 32 + b;
+            if (s >= S) break;
+            const uint32_t m = 1u << b;
+            const bool fwd = (send_bits[wb + w] & m) != 0;
+            const bool drp = !fwd && (drop_bits[wb + w] & m) != 0;
+            const bool sw = fwd && (switch_bits[wb + w] & m) != 0;
+            const int64_t i = st_base + s;
+
+            // ---- rtpmunger step (runtime/munge.py apply_dense) --------
+            const bool fresh = fwd && !st_started[i];
+            const bool resync = sw && st_started[i];
+            if (resync) {
+              st_sn_off[i] = (p_sn - ((st_last_sn[i] + 1) & M16)) & M16;
+              int64_t sw_ts_off =
+                  (p_ts - ((st_last_ts[i] + jump_eff) & M32)) & M32;
+              if (pkt_aligned && st_aligned[i]) sw_ts_off = st_ts_off[i];
+              st_ts_off[i] = sw_ts_off;
+              st_aligned[i] = pkt_aligned;
+            } else if (fresh) {
+              st_sn_off[i] = 0;
+              st_ts_off[i] = 0;
+              st_aligned[i] = pkt_aligned;
+            } else if (fwd && st_started[i]) {
+              // Timeline shear guard (continuing forward only).
+              const int64_t cur_out_ts = (p_ts - st_ts_off[i]) & M32;
+              const int64_t shear = sdiff32(cur_out_ts, st_last_ts[i]);
+              if (shear > REANCHOR_TS_THRESH || shear < -REANCHOR_TS_THRESH) {
+                st_ts_off[i] =
+                    (p_ts - ((st_last_ts[i] + FALLBACK_TS_JUMP) & M32)) & M32;
+                st_aligned[i] = pkt_aligned;
+              }
+            }
+            const int64_t o_sn = (p_sn - st_sn_off[i]) & M16;
+            const int64_t o_ts = (p_ts - st_ts_off[i]) & M32;
+            if (fwd) {
+              st_last_sn[i] = o_sn;
+              st_last_ts[i] = o_ts;
+            }
+            if (drp && st_started[i]) {
+              st_sn_off[i] = (st_sn_off[i] + 1) & M16;
+            }
+            if (fwd) st_started[i] = 1;
+
+            // ---- vp8 step ---------------------------------------------
+            const bool v_fresh = fwd && !st_v_started[i];
+            const bool v_resync = sw && st_v_started[i];
+            if (v_resync) {
+              st_pid_off[i] = (p_pid - ((st_last_pid[i] + 1) & M15)) & M15;
+              st_tl0_off[i] = (p_tl0 - st_last_tl0[i] - 1) & M8;
+              st_ki_off[i] = (p_ki - st_last_ki[i] - 1) & M5;
+            } else if (v_fresh) {
+              st_pid_off[i] = 0;
+              st_tl0_off[i] = 0;
+              st_ki_off[i] = 0;
+            }
+            const int64_t o_pid = (p_pid - st_pid_off[i]) & M15;
+            const int64_t o_tl0 = (p_tl0 - st_tl0_off[i]) & M8;
+            const int64_t o_ki = (p_ki - st_ki_off[i]) & M5;
+            if (fwd && bp) {
+              st_last_pid[i] = o_pid;
+              st_last_tl0[i] = o_tl0;
+              st_last_ki[i] = o_ki;
+            }
+            if (drp && bp && st_v_started[i]) {
+              st_pid_off[i] = (st_pid_off[i] + 1) & M15;
+            }
+            if (fwd) st_v_started[i] = 1;
+
+            if (fwd) {
+              if (n >= cap) return -1;
+              out_rooms[n] = r;
+              out_tracks[n] = t;
+              out_ks[n] = k;
+              out_subs[n] = s;
+              out_sn[n] = (int32_t)o_sn;
+              out_ts[n] = (int32_t)(uint32_t)o_ts;
+              out_pid[n] = (int32_t)o_pid;
+              out_tl0[n] = (int32_t)o_tl0;
+              out_ki[n] = (int32_t)o_ki;
+              ++n;
+            }
+          }
+        }
+      }
+    }
+  }
+  return n;
+}
+
+}  // extern "C"

@@ -1,0 +1,205 @@
+"""HostMunger (runtime/munge.py) vs the golden scan formulations.
+
+ops.rtpmunger / ops.vp8 define the munging semantics (and remain the
+device-checkpointable spec, tested by test_rtpmunger.py / test_vp8.py).
+The production rewrite path runs host-side since the round-5
+decide-on-device/rewrite-on-host split — these tests pin the two
+implementations bit-identical on randomized multi-tick streams, including
+switches, drops, padding, and migration snapshot/restore.
+"""
+
+import jax
+import numpy as np
+
+from livekit_server_tpu.models import plane
+from livekit_server_tpu.ops import rtpmunger, vp8
+from livekit_server_tpu.runtime.munge import HostMunger
+
+
+def _random_tick(rng, R, T, K, S):
+    sn = rng.integers(0, 1 << 16, (R, T, K))
+    ts = rng.integers(0, 1 << 32, (R, T, K))
+    pid = rng.integers(0, 1 << 15, (R, T, K))
+    tl0 = rng.integers(0, 256, (R, T, K))
+    ki = rng.integers(0, 32, (R, T, K))
+    begin = rng.random((R, T, K)) < 0.5
+    valid = rng.random((R, T, K)) < 0.85
+    ts_jump = np.where(rng.random((R, T, K)) < 0.3, -1, 3000)
+    fwd = rng.random((R, T, K, S)) < 0.6
+    drop = (rng.random((R, T, K, S)) < 0.2) & ~fwd
+    switch = (rng.random((R, T, K, S)) < 0.15) & fwd
+    return sn, ts, ts_jump, pid, tl0, ki, begin, valid, fwd, drop, switch
+
+
+def _ops_reference(ticks, R, T, K, S):
+    """Run the same stream through the jax scan modules (vmapped R×T)."""
+    tile = lambda tree: jax.tree.map(  # noqa: E731
+        lambda x: np.broadcast_to(np.asarray(x), (R, T) + x.shape).copy(), tree
+    )
+    mstate = rtpmunger.MungerState(*tile(rtpmunger.init_state(S)))
+    vstate = vp8.VP8State(*tile(vp8.init_state(S)))
+    munge = jax.jit(jax.vmap(jax.vmap(rtpmunger.munge_tick)))
+    vmunge = jax.jit(jax.vmap(jax.vmap(vp8.munge_tick)))
+    outs = []
+    for (sn, ts, ts_jump, pid, tl0, ki, begin, valid, fwd, drop, switch) in ticks:
+        i32 = lambda x: np.asarray(x, np.int64).astype(np.uint32).astype(np.int64).astype(np.int32, casting="unsafe")  # noqa: E731
+        mstate, out_sn, out_ts, send = munge(
+            mstate, i32(sn), i32(ts), valid, fwd, drop, switch, i32(ts_jump)
+        )
+        vstate, out_pid, out_tl0, out_ki = vmunge(
+            vstate, i32(pid), i32(tl0), i32(ki), begin, valid, fwd, drop, switch
+        )
+        outs.append((
+            np.asarray(send),
+            np.asarray(out_sn) & 0xFFFF,
+            np.asarray(out_ts).astype(np.int64) & 0xFFFFFFFF,
+            np.asarray(out_pid) & 0x7FFF,
+            np.asarray(out_tl0) & 0xFF,
+            np.asarray(out_ki) & 0x1F,
+        ))
+    return mstate, vstate, outs
+
+
+def test_host_munger_matches_ops_scans():
+    R, T, K, S = 2, 3, 4, 5
+    rng = np.random.default_rng(42)
+    ticks = [_random_tick(rng, R, T, K, S) for _ in range(6)]
+
+    mstate, vstate, ref = _ops_reference(ticks, R, T, K, S)
+
+    host = HostMunger(plane.PlaneDims(R, T, K, S))
+    for tick, (send_ref, r_sn, r_ts, r_pid, r_tl0, r_ki) in zip(ticks, ref):
+        sn, ts, ts_jump, pid, tl0, ki, begin, valid, fwd, drop, switch = tick
+        h_sn, h_ts, h_pid, h_tl0, h_ki = host.apply_dense(
+            sn, ts, ts_jump, pid, tl0, ki, begin, valid, fwd, drop, switch
+        )
+        send = fwd & valid[..., None]
+        assert (send == send_ref).all()
+        np.testing.assert_array_equal(h_sn[send], r_sn[send])
+        np.testing.assert_array_equal(h_ts[send], r_ts[send])
+        np.testing.assert_array_equal(h_pid[send], r_pid[send])
+        np.testing.assert_array_equal(h_tl0[send], r_tl0[send])
+        np.testing.assert_array_equal(h_ki[send], r_ki[send])
+
+    # Final state agrees too (migration seeds from this).
+    np.testing.assert_array_equal(
+        host.sn_offset, np.asarray(mstate.sn_offset).astype(np.int64) & 0xFFFF
+    )
+    np.testing.assert_array_equal(
+        host.last_sn, np.asarray(mstate.last_sn).astype(np.int64) & 0xFFFF
+    )
+    np.testing.assert_array_equal(host.started, np.asarray(mstate.started))
+    np.testing.assert_array_equal(
+        host.last_ts, np.asarray(mstate.last_ts).astype(np.int64) & 0xFFFFFFFF
+    )
+    np.testing.assert_array_equal(
+        host.pid_offset, np.asarray(vstate.pid_offset).astype(np.int64) & 0x7FFF
+    )
+    np.testing.assert_array_equal(host.v_started, np.asarray(vstate.started))
+
+
+def test_host_padding_matches_ops_padding_tick():
+    R, T, K, S = 1, 2, 3, 4
+    rng = np.random.default_rng(7)
+    host = HostMunger(plane.PlaneDims(R, T, K, S))
+    # Start lanes with one forwarded tick.
+    tick = _random_tick(rng, R, T, K, S)
+    sn, ts, ts_jump, pid, tl0, ki, begin, valid, fwd, drop, switch = tick
+    valid[:] = True
+    fwd[:] = True
+    drop[:] = False
+    switch[:] = False
+    host.apply_dense(sn, ts, ts_jump, pid, tl0, ki, begin, valid, fwd, drop, switch)
+
+    before_sn = host.last_sn.copy()
+    before_off = host.sn_offset.copy()
+    pad_num = np.zeros((R, S), np.int32)
+    pad_track = np.full((R, S), -1, np.int32)
+    pad_num[0, 1] = 3
+    pad_track[0, 1] = 1
+    pads = host.padding(pad_num, pad_track, ts_advance=900)
+    assert len(pads) == 3
+    sns = [p[3] for p in pads]
+    assert sns == [
+        (int(before_sn[0, 1, 1]) + j + 1) & 0xFFFF for j in range(3)
+    ]
+    # SN space advanced: offset -= n, last_sn += n (rtpmunger.go padding).
+    assert host.sn_offset[0, 1, 1] == (before_off[0, 1, 1] - 3) & 0xFFFF
+    assert host.last_sn[0, 1, 1] == (before_sn[0, 1, 1] + 3) & 0xFFFF
+    # Other lanes untouched.
+    assert (host.last_sn[0, 0] == before_sn[0, 0]).all()
+
+
+def test_native_walk_matches_numpy_dense():
+    """The C++ walker (native/munge.cpp) must be bit-identical to the
+    numpy spec, including state evolution across ticks."""
+    from livekit_server_tpu import native
+
+    if native.munge is None:
+        import pytest
+
+        pytest.skip("native munge walker unavailable (no toolchain)")
+    R, T, K, S = 2, 3, 4, 37  # S > 32: exercises the multi-word mask path
+    dims = plane.PlaneDims(R, T, K, S)
+    rng = np.random.default_rng(11)
+    h_np = HostMunger(dims)
+    h_cc = HostMunger(dims)
+    import jax.numpy as jnp
+
+    from livekit_server_tpu.models.plane import _pack_bits
+
+    for i in range(5):
+        sn, ts, ts_jump, pid, tl0, ki, begin, valid, fwd, drop, switch = (
+            _random_tick(rng, R, T, K, S)
+        )
+        # Device contract: send ⊆ valid (selection folds validity in).
+        fwd &= valid[..., None]
+        drop &= valid[..., None] & ~fwd
+        switch &= fwd
+        bits = [
+            np.asarray(_pack_bits(jnp.asarray(m))) for m in (fwd, drop, switch)
+        ]
+        # numpy lane: the spec path, bypassing the native walker.
+        o = h_np.apply_dense(sn, ts, ts_jump, pid, tl0, ki, begin, valid,
+                             fwd, drop, switch)
+        rr, tt, kk, ss = np.nonzero(fwd)
+        cols_cc = native.munge.walk(
+            sn, ts, ts_jump, pid, tl0, ki, begin, valid,
+            *bits, h_cc, cap=int(fwd.sum()),
+        )
+        assert cols_cc is not None
+        np.testing.assert_array_equal(cols_cc[0], rr)
+        np.testing.assert_array_equal(cols_cc[1], tt)
+        np.testing.assert_array_equal(cols_cc[2], kk)
+        np.testing.assert_array_equal(cols_cc[3], ss)
+        np.testing.assert_array_equal(
+            cols_cc[4], o[0][rr, tt, kk, ss].astype(np.int32))
+        np.testing.assert_array_equal(
+            cols_cc[5].view(np.uint32).astype(np.int64),
+            o[1][rr, tt, kk, ss] & 0xFFFFFFFF)
+        np.testing.assert_array_equal(
+            cols_cc[6], o[2][rr, tt, kk, ss].astype(np.int32))
+        np.testing.assert_array_equal(
+            cols_cc[7], o[3][rr, tt, kk, ss].astype(np.int32))
+        np.testing.assert_array_equal(
+            cols_cc[8], o[4][rr, tt, kk, ss].astype(np.int32))
+    # State evolved identically through five ticks.
+    for f in HostMunger.FIELDS:
+        np.testing.assert_array_equal(
+            getattr(h_np, f), getattr(h_cc, f), err_msg=f
+        )
+
+
+def test_host_munger_snapshot_roundtrip():
+    R, T, K, S = 2, 2, 2, 3
+    rng = np.random.default_rng(3)
+    host = HostMunger(plane.PlaneDims(R, T, K, S))
+    for i in range(3):
+        host.apply_dense(*_random_tick(rng, R, T, K, S))
+    snap = host.snapshot_room(1)
+    other = HostMunger(plane.PlaneDims(R, T, K, S))
+    other.restore_room(0, snap)
+    np.testing.assert_array_equal(other.last_sn[0], host.last_sn[1])
+    np.testing.assert_array_equal(other.ts_offset[0], host.ts_offset[1])
+    np.testing.assert_array_equal(other.started[0], host.started[1])
+    np.testing.assert_array_equal(other.pid_offset[0], host.pid_offset[1])

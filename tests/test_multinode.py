@@ -238,11 +238,8 @@ async def test_room_handoff_over_bus():
             room_b = await srv_b.room_manager.get_or_create_room("mig")
             rt_b = srv_b.room_manager.runtime
             # Munger state for (track 0, sub 1) migrated: last outgoing SN
-            # survives the hop. (Lock: rt_b's tick loop donates state.)
-            async with rt_b.state_lock:
-                last_sn = int(
-                    np.asarray(rt_b.state.munger.last_sn)[room_b.slots.row, 0, 1]
-                )
+            # survives the hop (host-side state since the round-5 split).
+            last_sn = int(rt_b.munger.last_sn[room_b.slots.row, 0, 1])
             assert last_sn == 102
     finally:
         for srv in (srv_a, srv_b):

@@ -11,25 +11,40 @@ import numpy as np
 
 from livekit_server_tpu.models import plane
 from livekit_server_tpu.ops import audio
+from livekit_server_tpu.runtime.munge import HostMunger
 
 
 class DenseOut:
-    """Adapter: view compacted egress as the dense grids the assertions use."""
+    """Adapter: device decision masks + host munger → the dense grids the
+    assertions use (the production split: decide on device, rewrite on
+    host — runtime/munge.py)."""
 
-    def __init__(self, out, dims):
+    def __init__(self, out, dims, munger, inp):
         self.raw = out
-        (self.send, self.out_sn, self.out_ts, self.out_pid, self.out_tl0,
-         self.out_keyidx) = plane.egress_to_dense(out, dims)
+        self.send, drop, switch = plane.masks_to_dense(
+            jax.tree.map(np.asarray, out), dims
+        )
+        self.out_sn, self.out_ts, self.out_pid, self.out_tl0, self.out_keyidx = (
+            munger.apply_dense(
+                np.asarray(inp.sn), np.asarray(inp.ts), np.asarray(inp.ts_jump),
+                np.asarray(inp.pid), np.asarray(inp.tl0), np.asarray(inp.keyidx),
+                np.asarray(inp.begin_pic), np.asarray(inp.valid),
+                self.send, drop, switch,
+            )
+        )
         for f in ("need_keyframe", "speaker_levels", "speaker_tracks",
-                  "congested", "target_layers", "fwd_packets", "fwd_bytes",
-                  "egress_overflow"):
+                  "congested", "target_layers", "fwd_packets", "fwd_bytes"):
             setattr(self, f, getattr(out, f))
 
 
 def dense_step(step, dims):
+    """Stateful step wrapper: carries the host munger across ticks exactly
+    like PlaneRuntime does."""
+    munger = HostMunger(dims)
+
     def run(st, inp):
         st, out = step(st, inp)
-        return st, DenseOut(out, dims)
+        return st, DenseOut(out, dims, munger, inp)
     return run
 
 
