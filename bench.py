@@ -133,14 +133,16 @@ def device_bench(dims, spec, ticks: int, warmup: int) -> dict:
     state = synth.make_state(dims, spec)
     traffic = synth.init_traffic(dims, spec)
 
-    # Host-built input pool, ONE upload. Capped at ~128 MB of HBM; the
-    # scan cursor wraps, so windows beyond the pool replay traffic with
-    # live state (SN replays read as late packets — selection/allocation
-    # work, the measured quantity, is unaffected).
+    # Host-built input pool, ONE upload. Target cap ~128 MB of HBM with a
+    # floor of min(ticks, 8) distinct ticks — the floor dominates at very
+    # large shapes (north-star: ~85 MB/tick ⇒ ~425 MB pool). The scan
+    # cursor wraps, so windows beyond the pool replay traffic with live
+    # state (SN replays read as late packets — selection/allocation work,
+    # the measured quantity, is unaffected). A modest wrapped pool beats a
+    # full distinct-tick pool: the axon client's per-call cost grows with
+    # threaded-buffer payload.
     per_tick = (len(plane.PKT_FIELDS) * R * T * K + 8 * R * S + R * T) * 4
     n_want = warmup + 5 * ticks
-    # Pool cap: the axon client's per-call cost grows with threaded-buffer
-    # payload, so a modest wrapped pool beats a full distinct-tick pool.
     pool_n = max(min(ticks, 8), min(n_want, int(128e6 // max(per_tick, 1))))
     pks, fbs, tfs = [], [], []
     for i in range(pool_n):
@@ -208,8 +210,9 @@ def device_bench(dims, spec, ticks: int, warmup: int) -> dict:
         int(chk)
         return state, fwd, ev, time.perf_counter() - t0
 
-    # Warmup call pays the compile + first-touch.
-    state, _, _, _ = window(state, 1, 0)
+    # Warmup pays the compile + first-touch; `warmup` asks for at least
+    # that many ticks of settling (rounded up to whole window calls).
+    state, _, _, _ = window(state, max(1, -(-warmup // ticks)), 0)
     # Window A: 1 call (N ticks); window B: 3 calls (3N ticks).
     # t(c) = c·(D + N·τ) ⇒ τ_eff = (t_B − t_A)/2N = τ + D/N, with the
     # per-dispatch D (~15 ms on this rig, ~µs locally) diluted by N.

@@ -346,14 +346,19 @@ def _room_tick(
     # fps.go).
     true_layer = jnp.clip(inp.layer, 0, L - 1)
     t_lane = true_layer[:, :, None] == lanes                        # [T,K,L]
-    def to_tracker(x, pred):
-        routed = jnp.where(t_lane & pred[:, :, None], x[:, :, None], 0)
-        return jnp.sum(routed, axis=1).reshape(T * L)               # [T*L]
-
+    # One stacked routed-sum for (pkts, bytes, frames) — three separate
+    # reduces cost ~0.9 ms/tick at cfg4; stacked they share the routing
+    # select and fuse into one pass.
     ones_k = jnp.ones((T, K), jnp.int32)
-    st_pkts = to_tracker(ones_k, inp.valid)                           # [T*L]
-    st_bytes = to_tracker(inp.size, inp.valid)
-    st_frames = to_tracker(ones_k, inp.valid & inp.begin_pic)
+    tr_vals = jnp.stack([ones_k, inp.size, ones_k])                 # [3,T,K]
+    tr_pred = jnp.stack(
+        [inp.valid, inp.valid, inp.valid & inp.begin_pic]
+    )                                                               # [3,T,K]
+    routed = jnp.where(
+        t_lane[None] & tr_pred[:, :, :, None], tr_vals[:, :, :, None], 0
+    )                                                               # [3,T,K,L]
+    tr_sums = jnp.sum(routed, axis=2).reshape(3, T * L)
+    st_pkts, st_bytes, st_frames = tr_sums[0], tr_sums[1], tr_sums[2]
     tracker, layer_status, _status_changed, tracker_bps, layer_fps = (
         streamtracker.update_tick(
             state.tracker, streamtracker.TrackerParams(), st_pkts, st_bytes,
@@ -592,10 +597,13 @@ def _room_tick(
         red_state=red_state,
         temporal_bytes=temporal_bytes,
     )
+    # One stacked pack for the three masks: they share the bit-weight
+    # reduction, so packing together fuses into a single pass.
+    packed_masks = _pack_bits(jnp.stack([send, drop, switch]))
     outputs = TickOutputs(
-        send_bits=_pack_bits(send),
-        drop_bits=_pack_bits(drop),
-        switch_bits=_pack_bits(switch),
+        send_bits=packed_masks[0],
+        drop_bits=packed_masks[1],
+        switch_bits=packed_masks[2],
         need_keyframe=need_kf,
         speaker_levels=spk_levels,
         speaker_tracks=spk_tracks,

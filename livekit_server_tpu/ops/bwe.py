@@ -101,11 +101,22 @@ def update_tick(
     ring_pos = jnp.where(estimate_valid, state.ring_pos + 1, state.ring_pos)
     last_estimate = jnp.where(estimate_valid, estimate, state.last_estimate)
 
-    # --- trend: slope of time-ordered ring (batched matvec) ---
-    order = (pos[..., None] + 1 + jnp.arange(WINDOW, dtype=jnp.int32)) % WINDOW
-    ordered = jnp.take_along_axis(ring, order, axis=-1)
-    slope = ordered @ _trend_weights()  # [S]
-    mean = jnp.mean(ordered, axis=-1)
+    # --- trend: slope of time-ordered ring ---
+    # Rotation moved onto the WEIGHTS instead of the data: gathering the
+    # ring per subscriber (take_along_axis) lowered to a TPU gather that
+    # measured ~0.8 ms/tick at cfg4; rotating the constant 8-tap weight
+    # vector via one-hot keeps everything elementwise and fused. The mean
+    # is rotation-invariant.
+    ranks = (
+        jnp.arange(WINDOW, dtype=jnp.int32) - pos[..., None] - 1
+    ) % WINDOW                                                   # [S, W]
+    w_rot = jnp.sum(
+        jax.nn.one_hot(ranks, WINDOW, dtype=jnp.float32)
+        * _trend_weights()[None, :],
+        axis=-1,
+    )                                                            # [S, W]
+    slope = jnp.sum(ring * w_rot, axis=-1)  # [S]
+    mean = jnp.mean(ring, axis=-1)
     rel_slope = slope / jnp.maximum(mean, 1.0)
     trend = jnp.where(rel_slope < -0.02, -1, jnp.where(rel_slope > 0.02, 1, 0)).astype(jnp.int32)
 

@@ -22,10 +22,14 @@ def cumsum_small(x: jnp.ndarray, axis: int) -> jnp.ndarray:
     axis = axis % x.ndim
     shift = 1
     while shift < n:
-        pad = [(0, 0)] * x.ndim
-        pad[axis] = (shift, 0)
         sl = [slice(None)] * x.ndim
         sl[axis] = slice(0, n - shift)
-        x = x + jnp.pad(x[tuple(sl)], pad)
+        zshape = list(x.shape)
+        zshape[axis] = shift
+        # concatenate, not jnp.pad: pad lowers to a dynamic-update-slice
+        # that measured ~0.3 ms/tick at cfg4; concat fuses.
+        x = x + jnp.concatenate(
+            [jnp.zeros(zshape, x.dtype), x[tuple(sl)]], axis=axis
+        )
         shift *= 2
     return x

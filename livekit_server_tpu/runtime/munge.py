@@ -48,6 +48,15 @@ def _sdiff(a, b, mask, half):
     return ((a - b + half) & mask) - half
 
 
+def _popcount_u32(x: np.ndarray) -> np.ndarray:
+    """Per-element popcount of uint32 words (np.bitwise_count needs
+    numpy>=2.0 and this package pins no numpy version)."""
+    x = x - ((x >> 1) & np.uint32(0x55555555))
+    x = (x & np.uint32(0x33333333)) + ((x >> 2) & np.uint32(0x33333333))
+    x = (x + (x >> 4)) & np.uint32(0x0F0F0F0F)
+    return (x * np.uint32(0x01010101)) >> 24
+
+
 class HostMunger:
     """Per-(room, track, subscriber) SN/TS + VP8 rewrite state.
 
@@ -213,9 +222,7 @@ class HostMunger:
 
         send_bits = np.asarray(send_bits)
         if native.munge is not None:
-            cap = int(
-                np.bitwise_count(send_bits.astype(np.uint32)).sum(dtype=np.int64)
-            )
+            cap = int(_popcount_u32(send_bits.astype(np.uint32)).sum(dtype=np.int64))
             res = native.munge.walk(
                 np.asarray(sn), np.asarray(ts), np.asarray(ts_jump),
                 np.asarray(pid), np.asarray(tl0), np.asarray(keyidx),
