@@ -267,43 +267,34 @@ def init_state(dims: PlaneDims) -> PlaneState:
     )
 
 
-def mask_words(num_subscribers: int) -> int:
-    """Words on the bit-packed mask minor axis: ⌈S/32⌉."""
-    return (num_subscribers + 31) // 32
-
-
-def _pack_bits(mask: jax.Array) -> jax.Array:
-    """[..., S] bool → [..., W] int32 bit words (bit s%32 of word s//32)."""
-    S = mask.shape[-1]
-    W = mask_words(S)
-    pad = W * 32 - S
-    if pad:
-        mask = jnp.pad(mask, [(0, 0)] * (mask.ndim - 1) + [(0, pad)])
-    w = mask.reshape(*mask.shape[:-1], W, 32).astype(jnp.uint32)
-    weights = jnp.left_shift(
-        jnp.uint32(1), jnp.arange(32, dtype=jnp.uint32)
-    )
-    packed = jnp.sum(w * weights, axis=-1, dtype=jnp.uint32)
-    return jax.lax.bitcast_convert_type(packed, jnp.int32)
-
-
-def unpack_bits(words, num_subscribers: int):
-    """Host-side inverse of `_pack_bits`: [..., W] int32 → [..., S] bool."""
-    import numpy as np
-
-    w = np.asarray(words).astype(np.uint32)
-    bits = (w[..., None] >> np.arange(32, dtype=np.uint32)) & 1
-    return bits.reshape(*w.shape[:-1], -1)[..., :num_subscribers].astype(bool)
+# Bit-mask helpers live in ops/bits.py (shared with the decision kernel's
+# CPU fallback); re-exported here for the runtime and tests.
+from livekit_server_tpu.ops.bits import (  # noqa: E402
+    mask_words,
+    pack_bits as _pack_bits,
+    unpack_bits,
+)
 
 
 def _room_tick(
     state: PlaneState,
     inp: TickInputs,
+    send_bits: jax.Array,    # [T, K, W] — phase-0 decision kernel outputs
+    drop_bits: jax.Array,
+    switch_bits: jax.Array,
+    need_kf: jax.Array,      # [T, S] bool, base-merged
+    pkts_sent_i: jax.Array,  # [S] int32
+    sent_bytes_i: jax.Array, # [S] int32 (wire overhead included)
+    fwd_packets_i: jax.Array,  # [] int32
+    fwd_bytes_i: jax.Array,    # [] int32
     audio_params: audio.AudioLevelParams,
     bwe_params: bwe.BWEParams,
     red_enabled: bool = True,
 ):
-    """Tick for ONE room; every field has its leading R axis stripped."""
+    """Phase-1 core tick for ONE room; every field has its leading R axis
+    stripped. The forward decision (phase 0) and allocation (phase 2) run
+    room-batched in `media_plane_tick`; this returns `bitrates` for phase
+    2 and placeholder zeros for the allocation-derived output fields."""
     T, K = inp.sn.shape
     S = state.ctrl.subscribed.shape[-1]
     L = MAX_LAYERS
@@ -408,40 +399,14 @@ def _room_tick(
     # Audio has a single "layer": zero the matrix so allocation skips it.
     bitrates = jnp.where(state.meta.is_video[:, None, None], bitrates, 0.0)
 
-    # ---- 3. per-packet layer selection with last tick's targets --------
-    # (the reference's allocator also lags forwarding: StreamAllocator ticks
-    # at 100 ms while WriteRTP runs continuously)
-    # Simulcast and SVC-onion selection run per track and merge by is_svc
-    # (videolayerselector/vp9.go:43 vs simulcast.go:42); both variants
-    # share the selector state tuple. On TPU this is ONE fused Pallas
-    # kernel replacing the tick's two longest packet-axis scan chains.
-    sel_state, v_fwd, v_drop, v_switch, need_kf = selector.select_both_tick(
-        state.sel, state.meta.is_svc, inp.layer, inp.temporal, inp.keyframe,
-        inp.layer_sync, inp.end_frame, inp.valid,
-    )  # masks [T, K, S]
-
-    # Audio path: forward to every subscribed, unmuted subscriber.
-    base = (
-        state.ctrl.subscribed
-        & ~state.ctrl.sub_muted
-        & (state.meta.published & ~state.meta.pub_muted)[:, None]
-    )  # [T, S]
-    a_fwd = inp.valid[:, :, None] & base[:, None, :]  # [T, K, S]
-    is_video = state.meta.is_video[:, None, None]
-    fwd = jnp.where(is_video, v_fwd & base[:, None, :], a_fwd)
-    drop = jnp.where(is_video, v_drop & base[:, None, :], False)
-    switch = jnp.where(is_video, v_switch & base[:, None, :], False)
-    need_kf = need_kf & base & state.meta.is_video[:, None]
-
-    # ---- 6. egress decision finalized --------------------------------
-    # `fwd` IS the send mask: selection already folded in validity, the
-    # subscription/mute base, and the video/audio merge. The SN/TS/VP8
-    # value rewrites happen host-side (runtime/munge.py) from the
+    # ---- 3+6. forward decision: computed in media_plane_tick's phase 0
+    # as ONE room-batched Pallas kernel (selection + subscription/mute
+    # base merge + audio path + egress bit packing + send sums) and
+    # passed in — the dense [T,K,S] masks never materialize. The SN/TS/
+    # VP8 value rewrites happen host-side (runtime/munge.py) from the
     # send/drop/switch bits + host-owned offset state; NACK/RTX replay is
-    # likewise host-side (runtime/plane_runtime.py HostSequencer), and
-    # probe padding synthesis (WritePaddingRTP, downtrack.go:764) rides
-    # the same host state (HostMunger.padding).
-    send = fwd
+    # likewise host-side (HostSequencer), and probe padding synthesis
+    # (WritePaddingRTP, downtrack.go:764) rides the same host state.
 
     # ---- BWE per subscriber (uses this tick's actual send counts) ------
     # Released slots reset their per-sub state first: the next occupant
@@ -459,7 +424,7 @@ def _room_tick(
     pacer_prev = _reset_rows(
         state.pacer_state, pacer.init_state(S), inp.sub_reset
     )
-    pkts_sent = jnp.sum(send, axis=(0, 1)).astype(jnp.float32)  # [S]
+    pkts_sent = pkts_sent_i.astype(jnp.float32)                 # [S]
     bwe_state, congested, trend, budget = bwe.update_tick(
         bwe_prev, bwe_params, inp.estimate, inp.estimate_valid,
         pkts_sent, inp.nacks,
@@ -479,35 +444,13 @@ def _room_tick(
     # Budgets from the allocator's committed rate gate the HOST egress
     # (runtime/udp.py _pacer_gate) when rtc.pacer == "leaky-bucket"; in
     # other modes the output is simply unused.
-    sent_bytes = jnp.sum(
-        jnp.where(send, inp.size[:, :, None] + pacer.WIRE_OVERHEAD_BYTES, 0),
-        axis=(0, 1),
-    ).astype(jnp.float32)                                            # [S]
     pacer_state, pacer_allowed, _pacer_backlog = pacer.update_tick(
-        pacer_prev, pacer.PacerParams(), sent_bytes, budget, inp.tick_ms
+        pacer_prev, pacer.PacerParams(), sent_bytes_i.astype(jnp.float32),
+        budget, inp.tick_ms,
     )
 
-    # ---- allocation across tracks per subscriber → targets for next tick
-    video_active = state.meta.is_video & state.meta.published & ~state.meta.pub_muted
-    alloc_muted = ~(
-        state.ctrl.subscribed & video_active[:, None] & ~state.ctrl.sub_muted
-    ).transpose(1, 0)  # [S, T]
-    # On TPU this is the fused Pallas budget kernel (subscribers on lanes,
-    # track loop unrolled in VMEM): ~13x the scan formulation standalone,
-    # identical outputs. The room vmap lifts it to a grid. CPU
-    # (tests/dryrun) takes the scan path.
-    target_flat, used, deficient = allocation.allocate_budget_batch(
-        bitrates,
-        state.ctrl.max_spatial.transpose(1, 0),
-        state.ctrl.max_temporal.transpose(1, 0),
-        alloc_muted,
-        budget,
-    )  # [S, T]
-    sel_state = selector.set_target(
-        sel_state,
-        jnp.clip(allocation.spatial_of(target_flat.transpose(1, 0)), -1, L - 1),
-        allocation.temporal_of(target_flat.transpose(1, 0)),
-    )
+    # (Cross-track allocation happens in media_plane_tick's phase 2 as one
+    # room-batched Pallas kernel; this core returns `bitrates` for it.)
 
     # ---- connection quality (scorer.go E-model; room.go:1318 worker) ----
     # Scored every tick over the accumulating stats window; the host rolls
@@ -574,22 +517,13 @@ def _room_tick(
         spk_levels = jnp.pad(spk_levels, (0, pad))
         spk_tracks = jnp.pad(spk_tracks, (0, pad), constant_values=-1)
 
-    # Subscriber-side quality: congestion ⇒ POOR, deficient allocation ⇒
-    # GOOD, else EXCELLENT (the layer-distance penalty half of
-    # connectionstats.go, from this tick's allocation).
-    any_deficient = jnp.any(deficient, axis=-1)                        # [S]
-    sub_q = jnp.where(
-        congested,
-        quality.QUALITY_POOR,
-        jnp.where(any_deficient, quality.QUALITY_GOOD, quality.QUALITY_EXCELLENT),
-    ).astype(jnp.int32)
-
     new_state = PlaneState(
         meta=state.meta,
         ctrl=state.ctrl,
         stats=stats,
         audio_state=audio_state,
-        sel=sel_state,
+        sel=state.sel,  # phase 2 installs the post-selection, re-targeted
+                        # selector state (this leaf is replaced there)
         bwe_state=bwe_state,
         delay_bwe=delay_bwe,
         tracker=tracker,
@@ -597,23 +531,21 @@ def _room_tick(
         red_state=red_state,
         temporal_bytes=temporal_bytes,
     )
-    # One stacked pack for the three masks: they share the bit-weight
-    # reduction, so packing together fuses into a single pass.
-    packed_masks = _pack_bits(jnp.stack([send, drop, switch]))
+    zero_s = jnp.zeros((S,), jnp.int32)
     outputs = TickOutputs(
-        send_bits=packed_masks[0],
-        drop_bits=packed_masks[1],
-        switch_bits=packed_masks[2],
+        send_bits=send_bits,
+        drop_bits=drop_bits,
+        switch_bits=switch_bits,
         need_keyframe=need_kf,
         speaker_levels=spk_levels,
         speaker_tracks=spk_tracks,
         congested=congested,
-        target_layers=target_flat,
-        fwd_packets=jnp.sum(send.astype(jnp.int32)),
-        fwd_bytes=jnp.sum(jnp.where(send, inp.size[:, :, None], 0)),
+        target_layers=jnp.zeros((S, T), jnp.int32),  # phase 2
+        fwd_packets=fwd_packets_i,
+        fwd_bytes=fwd_bytes_i,
         track_mos=track_mos,
         track_quality=track_q,
-        sub_quality=sub_q,
+        sub_quality=zero_s,                          # phase 2
         layer_live=layer_status.reshape(T, L),
         layer_fps=layer_fps.reshape(T, L),
         track_loss_pct=loss_pct,
@@ -621,12 +553,12 @@ def _room_tick(
         track_bps=jnp.sum(layer_bps, axis=-1),
         committed_bps=budget,
         pacer_allowed=pacer_allowed,
-        deficient=any_deficient,
+        deficient=zero_s.astype(bool),               # phase 2
         red_sn=red_sn.astype(jnp.int32),
         red_off=red_off.astype(jnp.int32),
         red_ok=red_ok,
     )
-    return new_state, outputs
+    return new_state, outputs, bitrates
 
 
 def media_plane_tick(
@@ -636,20 +568,87 @@ def media_plane_tick(
     bwe_params: bwe.BWEParams = bwe.BWEParams(),
     red_enabled: bool = True,
 ):
-    """One tick of the full media plane, vmapped over the room axis.
+    """One tick of the full media plane.
+
+    Three phases: (0) room-BATCHED layer selection (Pallas kernel, rooms
+    on the vector lanes — a vmapped per-room kernel pays per-grid-step
+    fixed costs ×R); (1) the per-room core, vmapped; (2) room-BATCHED
+    cross-track allocation, whose targets feed the NEXT tick's selection
+    (the reference's allocator lags forwarding the same way —
+    streamallocator.go ticks at 100 ms).
 
     jit this (donating `state`) and step it from the runtime loop;
     `red_enabled` is static per compile. The [R] axis is the mesh-sharded
-    axis (see livekit_server_tpu.parallel.mesh).
+    axis (see livekit_server_tpu.parallel.mesh — sharded via shard_map,
+    so the Pallas grids stay shard-local).
     """
-    # Scalars (tick_ms) broadcast; everything else has a leading R axis.
-    def tick_one(st, i):
-        return _room_tick(st, i, audio_params, bwe_params, red_enabled)
+    L = MAX_LAYERS
+
+    # ---- phase 0: forward decision over all rooms ----------------------
+    # ONE room-batched Pallas kernel: selection, subscription/mute base
+    # merge, audio path, egress bit packing, and the per-subscriber send
+    # sums — dense [R,T,K,S] masks never exist in HBM.
+    base = (
+        state.ctrl.subscribed
+        & ~state.ctrl.sub_muted
+        & (state.meta.published & ~state.meta.pub_muted)[:, :, None]
+    )                                                           # [R, T, S]
+    (sel_state, send_bits, drop_bits, switch_bits, need_kf,
+     pkts_sent, sent_bytes, fwd_packets, fwd_bytes) = selector.decide_rooms(
+        state.sel, state.meta.is_svc, state.meta.is_video, base,
+        inp.layer, inp.temporal, inp.keyframe, inp.layer_sync,
+        inp.end_frame, inp.valid, inp.size,
+        wire_overhead=pacer.WIRE_OVERHEAD_BYTES,
+    )
+
+    # ---- phase 1: per-room core (vmapped) ------------------------------
+    def tick_one(st, i, sb, db, wb, nk, ps, sby, fp, fby):
+        return _room_tick(st, i, sb, db, wb, nk, ps, sby, fp, fby,
+                          audio_params, bwe_params, red_enabled)
 
     inp_axes = TickInputs(**{f: 0 for f in TickInputs._fields})._replace(
         tick_ms=None, roll_quality=None
     )
-    return jax.vmap(tick_one, in_axes=(0, inp_axes))(state, inp)
+    new_state, outputs, bitrates = jax.vmap(
+        tick_one, in_axes=(0, inp_axes, 0, 0, 0, 0, 0, 0, 0, 0)
+    )(state, inp, send_bits, drop_bits, switch_bits, need_kf,
+      pkts_sent, sent_bytes, fwd_packets, fwd_bytes)
+
+    # ---- phase 2: allocation over all rooms → next tick's targets ------
+    video_active = (
+        state.meta.is_video & state.meta.published & ~state.meta.pub_muted
+    )
+    alloc_muted = ~(
+        state.ctrl.subscribed & video_active[:, :, None]
+        & ~state.ctrl.sub_muted
+    ).transpose(0, 2, 1)                                        # [R, S, T]
+    target_flat, _used, deficient = allocation.allocate_budget_rooms(
+        bitrates,
+        state.ctrl.max_spatial.transpose(0, 2, 1),
+        state.ctrl.max_temporal.transpose(0, 2, 1),
+        alloc_muted,
+        outputs.committed_bps,
+    )                                                           # [R, S, T]
+    tgt_ts = target_flat.transpose(0, 2, 1)                     # [R, T, S]
+    sel_state = selector.set_target(
+        sel_state,
+        jnp.clip(allocation.spatial_of(tgt_ts), -1, L - 1),
+        allocation.temporal_of(tgt_ts),
+    )
+    any_deficient = jnp.any(deficient, axis=-1)                 # [R, S]
+    sub_q = jnp.where(
+        outputs.congested,
+        quality.QUALITY_POOR,
+        jnp.where(any_deficient, quality.QUALITY_GOOD,
+                  quality.QUALITY_EXCELLENT),
+    ).astype(jnp.int32)
+    new_state = new_state._replace(sel=sel_state)
+    outputs = outputs._replace(
+        target_layers=target_flat,
+        deficient=any_deficient,
+        sub_quality=sub_q,
+    )
+    return new_state, outputs
 
 
 # ---------------------------------------------------------------------------

@@ -146,63 +146,11 @@ def allocate_budget(bitrates, max_spatial, max_temporal, muted, budget):
     return target, used, deficient
 
 
-def _budget_kernel(bit_ref, ms_ref, mt_ref, muted_ref, budget_ref,
-                   target_ref, used_ref, defc_ref):
-    """Pallas TPU kernel: the full two-pass cooperative allocation for one
-    room, subscribers on lanes, the serial track loop unrolled in VMEM.
-
-    XLA compiles the scan formulation of `allocate_budget` into ~2·T
-    dependent steps whose per-step vector work is tiny; here the entire
-    budget chain stays in registers/VMEM — one launch, T statically
-    unrolled vector steps. Standalone the kernel is ~13x the scan
-    formulation; inside the full tick (which is dominated by
-    input-dependent stats/ingest work) the end-to-end gain is small but
-    real, and the kernel removes the tick's longest serial dependency.
-    """
-    T, L = bit_ref.shape
-    S = ms_ref.shape[1]
-    l_sp = jax.lax.broadcasted_iota(jnp.int32, (L, S), 0) // MAX_TEMPORAL
-    l_tp = jax.lax.broadcasted_iota(jnp.int32, (L, S), 0) % MAX_TEMPORAL
-    l_ix = jax.lax.broadcasted_iota(jnp.int32, (L, S), 0)
-
-    allowed, lo, hi, locost = [], [], [], []
-    for t in range(T):
-        bt = bit_ref[t, :]                                          # [L]
-        a = (
-            (bt[:, None] > 0.0)
-            & (l_sp <= ms_ref[t, :][None, :])
-            & (l_tp <= mt_ref[t, :][None, :])
-            & (muted_ref[t, :][None, :] == 0)
-        )                                                           # [L, S]
-        lo_t = jnp.min(jnp.where(a, l_ix, L), axis=0)               # [S]
-        lo_t = jnp.where(lo_t >= L, -1, lo_t)
-        hi_t = jnp.max(jnp.where(a, l_ix, -1), axis=0)
-        lc = jnp.sum(jnp.where(l_ix == lo_t[None, :], bt[:, None], 0.0), axis=0)
-        allowed.append(a); lo.append(lo_t); hi.append(hi_t); locost.append(lc)
-
-    bl = budget_ref[0, :]                                           # [S]
-    got = []
-    for t in range(T):                                              # pass 1
-        take = (lo[t] >= 0) & (locost[t] <= bl)
-        bl = jnp.where(take, bl - locost[t], bl)
-        got.append(take)
-    for t in range(T):                                              # pass 2
-        bt = bit_ref[t, :]
-        avail = jnp.where(got[t], bl + locost[t], 0.0)
-        fits = allowed[t] & (bt[:, None] <= avail[None, :])
-        best = jnp.max(jnp.where(fits, l_ix, -1), axis=0)
-        best = jnp.where(got[t], jnp.maximum(best, lo[t]), -1)
-        cost = jnp.sum(jnp.where(l_ix == best[None, :], bt[:, None], 0.0), axis=0)
-        cost = jnp.where(best >= 0, cost, 0.0)
-        bl = jnp.where(got[t], avail - cost, bl)
-        target_ref[t, :] = best
-        defc_ref[t, :] = ((hi[t] >= 0) & (best < hi[t])).astype(jnp.int32)
-    used_ref[0, :] = budget_ref[0, :] - bl
-
-
-def allocate_budget_batch(bitrates, max_spatial, max_temporal, muted, budget,
-                          use_pallas: bool | None = None, interpret: bool = False):
-    """One room's allocation for ALL subscribers at once.
+def allocate_budget_batch(bitrates, max_spatial, max_temporal, muted, budget):
+    """One room's allocation for ALL subscribers at once — the scan
+    formulation (the spec). The production TPU path is the room-batched
+    `allocate_budget_rooms` kernel, pinned bit-identical to this by
+    tests/test_allocation.py.
 
     Args:
       bitrates      [T, 4, 4] float32
@@ -210,43 +158,129 @@ def allocate_budget_batch(bitrates, max_spatial, max_temporal, muted, budget,
       muted         [S, T] bool
       budget        [S] float32
     Returns (target [S, T] int32, used [S] float32, deficient [S, T] bool).
+    """
+    return jax.vmap(
+        lambda m1, m2, m3, b: allocate_budget(bitrates, m1, m2, m3, b)
+    )(max_spatial, max_temporal, muted, budget)
 
-    On TPU this runs the fused Pallas kernel (vmap over rooms lifts it to a
-    grid); elsewhere — and under `interpret=True` in tests — it falls back
-    to / checks against the pure-JAX scan formulation.
+
+# ---------------------------------------------------------------------------
+# Room-batched kernel: rooms on the vector lanes (see ops/selector.py's
+# room-batched twin for the rationale — the vmapped per-room grid pays
+# per-step fixed costs at ~8% lane occupancy).
+# ---------------------------------------------------------------------------
+
+
+def _budget_rooms_kernel(bit_ref, ms_ref, mt_ref, muted_ref, budget_ref,
+                         target_ref, used_ref, defc_ref):
+    """Two-pass cooperative allocation for a ROOM BLOCK: bit_ref
+    [T, L, RB]; ms/mt/muted [T, S, RB]; budget [1, S, RB]; outputs
+    target/defc [T, S, RB], used [1, S, RB]."""
+    T, L, RB = bit_ref.shape
+    S = ms_ref.shape[1]
+    l_sp = jax.lax.broadcasted_iota(jnp.int32, (L, S, RB), 0) // MAX_TEMPORAL
+    l_tp = jax.lax.broadcasted_iota(jnp.int32, (L, S, RB), 0) % MAX_TEMPORAL
+    l_ix = jax.lax.broadcasted_iota(jnp.int32, (L, S, RB), 0)
+
+    allowed, lo, hi, locost = [], [], [], []
+    for t in range(T):
+        bt = bit_ref[t, :, :][:, None, :]                           # [L,1,RB]
+        a = (
+            (bt > 0.0)
+            & (l_sp <= ms_ref[t, :, :][None, :, :])
+            & (l_tp <= mt_ref[t, :, :][None, :, :])
+            & (muted_ref[t, :, :][None, :, :] == 0)
+        )                                                           # [L,S,RB]
+        lo_t = jnp.min(jnp.where(a, l_ix, L), axis=0)               # [S,RB]
+        lo_t = jnp.where(lo_t >= L, -1, lo_t)
+        hi_t = jnp.max(jnp.where(a, l_ix, -1), axis=0)
+        lc = jnp.sum(jnp.where(l_ix == lo_t[None, :, :], bt, 0.0), axis=0)
+        allowed.append(a); lo.append(lo_t); hi.append(hi_t); locost.append(lc)
+
+    bl = budget_ref[0, :, :]                                        # [S,RB]
+    got = []
+    for t in range(T):                                              # pass 1
+        take = (lo[t] >= 0) & (locost[t] <= bl)
+        bl = jnp.where(take, bl - locost[t], bl)
+        got.append(take)
+    for t in range(T):                                              # pass 2
+        bt = bit_ref[t, :, :][:, None, :]
+        avail = jnp.where(got[t], bl + locost[t], 0.0)
+        fits = allowed[t] & (bt <= avail[None, :, :])
+        best = jnp.max(jnp.where(fits, l_ix, -1), axis=0)
+        best = jnp.where(got[t], jnp.maximum(best, lo[t]), -1)
+        cost = jnp.sum(jnp.where(l_ix == best[None, :, :], bt, 0.0), axis=0)
+        cost = jnp.where(best >= 0, cost, 0.0)
+        bl = jnp.where(got[t], avail - cost, bl)
+        target_ref[t, :, :] = best
+        defc_ref[t, :, :] = ((hi[t] >= 0) & (best < hi[t])).astype(jnp.int32)
+    used_ref[0, :, :] = budget_ref[0, :, :] - bl
+
+
+def allocate_budget_rooms(bitrates, max_spatial, max_temporal, muted, budget,
+                          use_pallas: bool | None = None,
+                          interpret: bool = False):
+    """All rooms' allocation at once.
+
+    Args:
+      bitrates      [R, T, 4, 4] float32
+      max_spatial   [R, S, T] int32, max_temporal [R, S, T] int32
+      muted         [R, S, T] bool
+      budget        [R, S] float32
+    Returns (target [R, S, T] int32, used [R, S] float32,
+    deficient [R, S, T] bool).
     """
     if use_pallas is None:
         use_pallas = jax.default_backend() == "tpu"
     if not (use_pallas or interpret):
-        target, used, defc = jax.vmap(
-            lambda m1, m2, m3, b: allocate_budget(bitrates, m1, m2, m3, b)
-        )(max_spatial, max_temporal, muted, budget)
-        return target, used, defc
+        return jax.vmap(allocate_budget_batch)(
+            bitrates, max_spatial, max_temporal, muted, budget
+        )
 
     from jax.experimental import pallas as pl
     from jax.experimental.pallas import tpu as pltpu
 
-    T = bitrates.shape[0]
-    S = budget.shape[0]
-    spec = pl.BlockSpec(memory_space=pltpu.VMEM)
+    R, T = bitrates.shape[:2]
+    S = budget.shape[-1]
+    from livekit_server_tpu.ops.selector import pick_room_block
+
+    # Working set: bitrates [T,L,RB] + five [T,S,RB] blocks + two [1,S,RB].
+    RB = pick_room_block(R, 4 * (T * NUM_LAYERS + 5 * T * S + 2 * S))
+    f32 = lambda x: jnp.asarray(x, jnp.float32)  # noqa: E731
+    i32 = lambda x: jnp.asarray(x, jnp.int32)    # noqa: E731
+
+    bit_spec = pl.BlockSpec((T, NUM_LAYERS, RB), lambda i: (0, 0, i),
+                            memory_space=pltpu.VMEM)
+    st_spec = pl.BlockSpec((T, S, RB), lambda i: (0, 0, i),
+                           memory_space=pltpu.VMEM)
+    bud_spec = pl.BlockSpec((1, S, RB), lambda i: (0, 0, i),
+                            memory_space=pltpu.VMEM)
     target, used, defc = pl.pallas_call(
-        _budget_kernel,
+        _budget_rooms_kernel,
+        grid=(R // RB,),
         out_shape=(
-            jax.ShapeDtypeStruct((T, S), jnp.int32),
-            jax.ShapeDtypeStruct((1, S), jnp.float32),
-            jax.ShapeDtypeStruct((T, S), jnp.int32),
+            jax.ShapeDtypeStruct((T, S, R), jnp.int32),
+            jax.ShapeDtypeStruct((1, S, R), jnp.float32),
+            jax.ShapeDtypeStruct((T, S, R), jnp.int32),
         ),
-        in_specs=[spec] * 5,
-        out_specs=(spec, spec, spec),
+        in_specs=[bit_spec, st_spec, st_spec, st_spec, bud_spec],
+        out_specs=(st_spec, bud_spec, st_spec),
+        compiler_params=pltpu.CompilerParams(
+            vmem_limit_bytes=48 * 1024 * 1024
+        ),
         interpret=interpret,
     )(
-        bitrates.reshape(T, NUM_LAYERS).astype(jnp.float32),
-        max_spatial.astype(jnp.int32).transpose(1, 0),
-        max_temporal.astype(jnp.int32).transpose(1, 0),
-        muted.astype(jnp.int32).transpose(1, 0),
-        budget.astype(jnp.float32).reshape(1, S),
+        f32(bitrates).reshape(R, T, NUM_LAYERS).transpose(1, 2, 0),
+        i32(max_spatial).transpose(2, 1, 0),
+        i32(max_temporal).transpose(2, 1, 0),
+        i32(muted).transpose(2, 1, 0),
+        f32(budget).transpose(1, 0)[None],
     )
-    return target.transpose(1, 0), used[0], defc.transpose(1, 0).astype(bool)
+    return (
+        target.transpose(2, 1, 0),
+        used[0].transpose(1, 0),
+        defc.transpose(2, 1, 0).astype(bool),
+    )
 
 
 def next_higher(bitrates, max_spatial, max_temporal, current_flat):

@@ -1,0 +1,41 @@
+"""Bit-packed (track, packet, subscriber) mask helpers.
+
+The egress masks travel as ⌈S/32⌉ int32 words per (track, packet) — one
+bit per subscriber (see models/plane.py's decide-on-device/rewrite-on-host
+design note). Shared by the device tick, the room-batched decision kernel's
+CPU fallback, and host-side consumers.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+
+def mask_words(num_subscribers: int) -> int:
+    """Words on the bit-packed mask minor axis: ⌈S/32⌉."""
+    return (num_subscribers + 31) // 32
+
+
+def pack_bits(mask: jax.Array) -> jax.Array:
+    """[..., S] bool → [..., W] int32 bit words (bit s%32 of word s//32)."""
+    S = mask.shape[-1]
+    W = mask_words(S)
+    pad = W * 32 - S
+    if pad:
+        mask = jnp.pad(mask, [(0, 0)] * (mask.ndim - 1) + [(0, pad)])
+    w = mask.reshape(*mask.shape[:-1], W, 32).astype(jnp.uint32)
+    weights = jnp.left_shift(
+        jnp.uint32(1), jnp.arange(32, dtype=jnp.uint32)
+    )
+    packed = jnp.sum(w * weights, axis=-1, dtype=jnp.uint32)
+    return jax.lax.bitcast_convert_type(packed, jnp.int32)
+
+
+def unpack_bits(words, num_subscribers: int):
+    """Host-side inverse of `pack_bits`: [..., W] int32 → [..., S] bool."""
+    import numpy as np
+
+    w = np.asarray(words).astype(np.uint32)
+    bits = (w[..., None] >> np.arange(32, dtype=np.uint32)) & 1
+    return bits.reshape(*w.shape[:-1], -1)[..., :num_subscribers].astype(bool)

@@ -84,25 +84,34 @@ def make_sharded_tick(
     def tick(state, inp):
         return plane.media_plane_tick(state, inp, ap, bp, red_enabled=red_enabled)
 
-    rs = room_sharding(mesh)
-    rep = replicated(mesh)
+    def pspecs(tree):
+        return jax.tree.map(
+            lambda x: P() if jnp.asarray(x).ndim == 0 else P(ROOM_AXIS), tree
+        )
 
-    def specs(tree):
-        return jax.tree.map(lambda x: rep if jnp.asarray(x).ndim == 0 else rs, tree)
-
-    # Shardings are resolved lazily at first call (the caller's state/input
-    # NamedTuples define the tree structure), then the jitted fn is cached so
-    # subsequent ticks hit the compilation cache.
+    # shard_map, not bare GSPMD jit: the tick's hot kernels are Pallas
+    # custom calls with a grid over the room axis, which the GSPMD
+    # partitioner cannot split. shard_map traces the tick PER SHARD
+    # (local room count), so the Pallas grids are shard-local by
+    # construction and no collectives exist on the hot path (rooms are
+    # embarrassingly parallel — roomallocator.go's one-node-per-room
+    # insight, mapped to chips).
     cache: dict[str, Any] = {}
 
     @functools.wraps(tick)
     def compiled(state, inp):
         if "fn" not in cache:
+            in_specs = (pspecs(state), pspecs(inp))
+            out_shapes = jax.eval_shape(tick, state, inp)
+            out_specs = jax.tree.map(
+                lambda x: P() if x.ndim == 0 else P(ROOM_AXIS), out_shapes
+            )
+            smapped = jax.shard_map(
+                tick, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
+                check_vma=False,
+            )
             cache["fn"] = jax.jit(
-                tick,
-                in_shardings=(specs(state), specs(inp)),
-                out_shardings=(specs(state), None),
-                donate_argnums=(0,) if donate else (),
+                smapped, donate_argnums=(0,) if donate else ()
             )
         return cache["fn"](state, inp)
 

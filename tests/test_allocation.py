@@ -95,23 +95,20 @@ def test_vmap_over_subscribers():
     assert not bool(deficient[0, 0]) and bool(deficient[1, 0])
 
 
-def test_pallas_budget_kernel_matches_scan():
-    """The fused Pallas allocation kernel (TPU hot path) is bit-equivalent
-    to the scan formulation across random caps/mutes/budgets — run here in
-    interpreter mode on CPU."""
-    rng = np.random.default_rng(7)
-    # Fixed shape set (small/asymmetric/large): interpret-mode Pallas pays
-    # a full retrace per distinct shape, so random shapes made this the
-    # slowest test in the suite (~4 min) for no extra kernel coverage.
-    for T, S in ((4, 4), (8, 32), (16, 4)):
-        bit = (rng.random((T, 4, 4)) * 2e5 * (rng.random((T, 4, 4)) > 0.3)).astype(np.float32)
-        ms = rng.integers(-1, 4, (S, T)).astype(np.int32)
-        mt = rng.integers(-1, 4, (S, T)).astype(np.int32)
-        mu = rng.random((S, T)) < 0.2
-        bud = (rng.random(S) * 3e6).astype(np.float32)
+def test_pallas_rooms_budget_matches_per_room():
+    """The room-batched allocation kernel (production TPU path since the
+    phase-2 hoist) is bit-equivalent to the per-room fallback."""
+    rng = np.random.default_rng(13)
+    for R, T, S in ((4, 5, 7), (6, 4, 33)):
+        bit = (rng.random((R, T, 4, 4)) * 2e6
+               * (rng.random((R, T, 4, 4)) > 0.3)).astype(np.float32)
+        ms = rng.integers(-1, 4, (R, S, T)).astype(np.int32)
+        mt = rng.integers(-1, 4, (R, S, T)).astype(np.int32)
+        mu = rng.random((R, S, T)) < 0.2
+        bud = (rng.random((R, S)) * 8e6).astype(np.float32)
         args = tuple(jnp.asarray(x) for x in (bit, ms, mt, mu, bud))
-        t0, u0, d0 = al.allocate_budget_batch(*args, use_pallas=False)
-        t1, u1, d1 = al.allocate_budget_batch(*args, interpret=True)
+        t0, u0, d0 = al.allocate_budget_rooms(*args, use_pallas=False)
+        t1, u1, d1 = al.allocate_budget_rooms(*args, interpret=True)
         assert np.array_equal(np.asarray(t0), np.asarray(t1))
         assert np.allclose(np.asarray(u0), np.asarray(u1), rtol=1e-5)
         assert np.array_equal(np.asarray(d0), np.asarray(d1))

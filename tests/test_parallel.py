@@ -109,14 +109,17 @@ def _interpreted_pallas_body() -> None:
     sh_inp = shard_tree(inp, mesh)
     ref_state, ref_out = make_sharded_tick(mesh, donate=False)(sh_state, sh_inp)
 
-    orig_a, orig_s = allocation.allocate_budget_batch, selector.select_both_tick
-    allocation.allocate_budget_batch = functools.partial(orig_a, interpret=True)
-    selector.select_both_tick = functools.partial(orig_s, interpret=True)
+    # Force the PRODUCTION TPU kernels (the fused phase-0 decision kernel
+    # + the room-batched phase-2 allocation) in interpret mode inside the
+    # sharded tick.
+    orig_ar, orig_dr = allocation.allocate_budget_rooms, selector.decide_rooms
+    allocation.allocate_budget_rooms = functools.partial(orig_ar, interpret=True)
+    selector.decide_rooms = functools.partial(orig_dr, interpret=True)
     try:
         p_state, p_out = make_sharded_tick(mesh, donate=False)(sh_state, sh_inp)
     finally:
-        allocation.allocate_budget_batch = orig_a
-        selector.select_both_tick = orig_s
+        allocation.allocate_budget_rooms = orig_ar
+        selector.decide_rooms = orig_dr
 
     jax.tree.map(
         lambda a, b: np.testing.assert_array_equal(np.asarray(a), np.asarray(b)),

@@ -101,33 +101,36 @@ def test_vmap_over_subscribers():
     assert not f[:, 2].any()                     # sub2 paused
 
 
-def test_pallas_dual_selector_matches_scan():
-    """The fused Pallas simulcast+SVC selection kernel (TPU hot path) is
-    bit-equivalent to the two scan formulations + where-merge — run here
-    in interpreter mode on CPU."""
+def test_pallas_decide_rooms_matches_fallback():
+    """The fused forward-decision kernel (selection + base merge + audio
+    path + bit packing + send sums — the production TPU phase 0) is
+    bit-equivalent to the composed per-room fallback."""
     import numpy as np
 
     from livekit_server_tpu.ops import selector as sel
 
-    rng = np.random.default_rng(3)
-    # Fixed shape set — see test_allocation.py: interpret-mode Pallas
-    # retraces per shape; random shapes only multiplied compile time.
-    for T, K, S in ((4, 4, 4), (16, 16, 32), (4, 16, 4)):
+    rng = np.random.default_rng(17)
+    for R, T, K, S in ((4, 3, 5, 7), (6, 4, 4, 33)):
         st = sel.SelectorState(
-            current_spatial=jnp.asarray(rng.integers(-1, 3, (T, S)), jnp.int32),
-            current_temporal=jnp.asarray(rng.integers(-1, 4, (T, S)), jnp.int32),
-            target_spatial=jnp.asarray(rng.integers(-1, 3, (T, S)), jnp.int32),
-            target_temporal=jnp.asarray(rng.integers(0, 4, (T, S)), jnp.int32),
+            current_spatial=jnp.asarray(rng.integers(-1, 3, (R, T, S)), jnp.int32),
+            current_temporal=jnp.asarray(rng.integers(-1, 4, (R, T, S)), jnp.int32),
+            target_spatial=jnp.asarray(rng.integers(-1, 3, (R, T, S)), jnp.int32),
+            target_temporal=jnp.asarray(rng.integers(0, 4, (R, T, S)), jnp.int32),
         )
-        is_svc = jnp.asarray(rng.random(T) < 0.5)
-        args = [jnp.asarray(rng.integers(0, 3, (T, K)), jnp.int32),
-                jnp.asarray(rng.integers(0, 4, (T, K)), jnp.int32),
-                jnp.asarray(rng.random((T, K)) < 0.2),
-                jnp.asarray(rng.random((T, K)) < 0.3),
-                jnp.asarray(rng.random((T, K)) < 0.3),
-                jnp.asarray(rng.random((T, K)) < 0.8)]
-        a = sel.select_both_tick(st, is_svc, *args, use_pallas=False)
-        b = sel.select_both_tick(st, is_svc, *args, interpret=True)
+        is_svc = jnp.asarray(rng.random((R, T)) < 0.5)
+        is_video = jnp.asarray(rng.random((R, T)) < 0.6)
+        base = jnp.asarray(rng.random((R, T, S)) < 0.7)
+        args = [jnp.asarray(rng.integers(0, 3, (R, T, K)), jnp.int32),
+                jnp.asarray(rng.integers(0, 4, (R, T, K)), jnp.int32),
+                jnp.asarray(rng.random((R, T, K)) < 0.3),
+                jnp.asarray(rng.random((R, T, K)) < 0.5),
+                jnp.asarray(rng.random((R, T, K)) < 0.4),
+                jnp.asarray(rng.random((R, T, K)) < 0.9),
+                jnp.asarray(rng.integers(40, 1300, (R, T, K)), jnp.int32)]
+        a = sel.decide_rooms(st, is_svc, is_video, base, *args,
+                             wire_overhead=46, use_pallas=False)
+        b = sel.decide_rooms(st, is_svc, is_video, base, *args,
+                             wire_overhead=46, interpret=True)
         for xv, yv in zip(a[0], b[0]):
             assert np.array_equal(np.asarray(xv), np.asarray(yv))
         for x, y in zip(a[1:], b[1:]):

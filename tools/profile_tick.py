@@ -154,15 +154,23 @@ def main():
            (state.tracker, inp.layer, inp.valid, inp.size, inp.begin_pic,
             inp.tick_ms), n, "2. streamtracker (+routing)")
 
-    # ---- 3. selector (pallas, vmapped) -----------------------------------
+    # ---- 3. fused forward-decision kernel (production phase 0) -----------
+    base_m = (np.asarray(state.ctrl.subscribed)
+              & ~np.asarray(state.ctrl.sub_muted)
+              & (np.asarray(state.meta.published)
+                 & ~np.asarray(state.meta.pub_muted))[:, :, None])
+
     @jax.jit
-    def sel_block(sel, is_svc, layer, temporal, kf, sync, eof, valid):
-        return jax.vmap(selector.select_both_tick)(
-            sel, is_svc, layer, temporal, kf, sync, eof, valid)
+    def sel_block(sel, is_svc, is_video, base, layer, temporal, kf, sync, eof,
+                  valid, size):
+        return selector.decide_rooms(
+            sel, is_svc, is_video, base, layer, temporal, kf, sync, eof,
+            valid, size, wire_overhead=46)
     timeit(lambda *a: sel_block(*a),
-           (state.sel, state.meta.is_svc, inp.layer, inp.temporal,
-            inp.keyframe, inp.layer_sync, inp.end_frame, inp.valid),
-           n, "3. selector.select_both_tick (pallas)")
+           (state.sel, state.meta.is_svc, state.meta.is_video,
+            jnp.asarray(base_m), inp.layer, inp.temporal,
+            inp.keyframe, inp.layer_sync, inp.end_frame, inp.valid, inp.size),
+           n, "3. selector.decide_rooms (fused kernel)")
 
     # ---- 4. munger + vp8 -------------------------------------------------
     fwd = jnp.ones((R, T, K, S), bool)
@@ -196,8 +204,7 @@ def main():
 
     @jax.jit
     def alloc_block(bitrates, ms, mt, muted, budget):
-        return jax.vmap(allocation.allocate_budget_batch)(
-            bitrates, ms, mt, muted, budget)
+        return allocation.allocate_budget_rooms(bitrates, ms, mt, muted, budget)
     timeit(lambda *a: alloc_block(*a),
            (bitrates, state.ctrl.max_spatial.transpose(0, 2, 1),
             state.ctrl.max_temporal.transpose(0, 2, 1),
