@@ -364,6 +364,7 @@ async def wire_bench(
     ack_ms: float = 25.0,
     n_slices: int = 4,
     warm_timeout_s: float = 120.0,
+    low_latency: bool = False,
 ) -> dict:
     """Real-time serving-loop measurement (see module-section comment).
 
@@ -401,7 +402,7 @@ async def wire_bench(
         rtts.append(time.perf_counter() - t0)
     tunnel_rtt_ms = round(float(np.median(rtts)) * 1000.0, 2)
 
-    runtime = PlaneRuntime(dims, tick_ms=tick_ms)
+    runtime = PlaneRuntime(dims, tick_ms=tick_ms, low_latency=low_latency)
     reg = MediaCryptoRegistry()
     udp = await start_udp_transport(
         runtime.ingest, host="127.0.0.1", port=0, crypto=reg
@@ -762,6 +763,8 @@ def main() -> None:
                          "multiple variants (--wire-only mode)")
     ap.add_argument("--wire-rooms", type=int, default=32)
     ap.add_argument("--wire-kbps", type=float, default=3000.0)
+    ap.add_argument("--wire-low-latency", action="store_true",
+                    help="complete egress in-tick (PlaneRuntime low_latency)")
     args = ap.parse_args()
     if args.budget is not None:
         _BUDGET[0] = args.budget
@@ -786,7 +789,8 @@ def main() -> None:
             key = "wire" if t == wire_ticks[0] else f"wire_tick{t}"
             _SECTION[0] = key
             _run_wire(key, plane.PlaneDims(args.wire_rooms, 8, 8, 6), t,
-                      args.wire_seconds, video_kbps=args.wire_kbps)
+                      args.wire_seconds, video_kbps=args.wire_kbps,
+                      low_latency=args.wire_low_latency)
             emit()
         return
 
@@ -864,7 +868,8 @@ def main() -> None:
                 [sys.executable, __file__, "--wire-only", "--cpu",
                  "--wire-seconds", str(args.wire_seconds),
                  "--wire-tick-ms", f"{wire_ticks[0]},2",
-                 "--wire-rooms", "8", "--wire-kbps", "1500"],
+                 "--wire-rooms", "8", "--wire-kbps", "1500",
+                 "--wire-low-latency"],
                 capture_output=True, text=True, timeout=max(twin_budget, 45),
             )
             _absorb_twin(cp.stdout)

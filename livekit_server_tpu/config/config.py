@@ -139,6 +139,11 @@ class PlaneConfig:
     subs_per_room: int = 32
     mesh_devices: int = 0        # 0 = all local devices
     donate_state: bool = True
+    # Complete each tick's egress before starting the next tick instead of
+    # overlapping it with the next device step: ~1 tick lower forward
+    # latency, at the cost of the wall budget being the SUM of device +
+    # host egress instead of their max. Worth it when both fit the tick.
+    low_latency: bool = False
 
 
 @dataclass

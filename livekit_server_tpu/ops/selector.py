@@ -179,7 +179,13 @@ def pick_room_block(R: int, per_room_bytes: int) -> int:
     for cand in (512, 256, 128):
         if cand <= cap and R % cand == 0:
             return cand
-    return R
+    if R % 128 == 0:
+        # Over-budget but lane-valid: 128 is the SMALLEST legal block, so
+        # it is the best effort when even that exceeds the cap (returning
+        # R here would request the largest block exactly when the budget
+        # is tightest). The per-kernel vmem_limit gives real headroom.
+        return 128
+    return R  # no 128-multiple divisor (small or odd R): whole array
 
 
 def _decide_rooms_kernel(sp_ref, tp_ref, kf_ref, sync_ref, eof_ref, valid_ref,

@@ -56,6 +56,7 @@ class RoomManager:
             plane.PlaneDims(p.rooms, p.tracks_per_room, p.pkts_per_track, p.subs_per_room),
             tick_ms=p.tick_ms,
             mesh=mesh,
+            low_latency=p.low_latency,
             red_enabled="audio/red" in config.room.enabled_codecs,
             audio_params=audio_ops.AudioLevelParams(
                 active_level=config.audio.active_level,

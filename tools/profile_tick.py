@@ -165,7 +165,7 @@ def main():
                   valid, size):
         return selector.decide_rooms(
             sel, is_svc, is_video, base, layer, temporal, kf, sync, eof,
-            valid, size, wire_overhead=46)
+            valid, size, wire_overhead=pacer.WIRE_OVERHEAD_BYTES)
     timeit(lambda *a: sel_block(*a),
            (state.sel, state.meta.is_svc, state.meta.is_video,
             jnp.asarray(base_m), inp.layer, inp.temporal,
