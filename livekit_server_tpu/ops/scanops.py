@@ -1,35 +1,38 @@
-"""Small-axis prefix ops that stay elementwise.
+"""Small-axis prefix ops that avoid TPU's pathological scan lowerings.
 
-`jnp.cumsum` lowers to `reduce-window` on TPU; at the media plane's tiny
-static axes (4 spatial layers, K ≤ 16 packet slots) that lowering measured
-~2.7 ms of an 8 ms cfg4 tick — three orders slower than the work it does.
-These helpers express the same prefix sums as log₂(n) shift-adds, which
-XLA fuses into the surrounding elementwise graph for free.
+`jnp.cumsum` lowers to `reduce-window` on TPU, and shift-add prefix sums
+(via jnp.pad or concatenate) lower to pad/dynamic-update-slice chains —
+at the media plane's tiny static axes (4 spatial layers, K ≤ 16 packet
+slots) each measured milliseconds per tick for microseconds of work.
+A contraction against an n×n triangular matrix fuses cleanly instead.
 """
 
 from __future__ import annotations
 
+import jax
 import jax.numpy as jnp
 
 
 def cumsum_small(x: jnp.ndarray, axis: int) -> jnp.ndarray:
-    """Inclusive prefix sum along a SMALL static axis via log-shift adds.
+    """Inclusive prefix sum along a SMALL static axis as a triangular-
+    matrix contraction: out_i = Σ_{j≤i} x_j.
 
-    Bit-exact with jnp.cumsum for ints; for floats the summation order
-    differs (pairwise vs serial) — fine for the EMA/bitrate uses here.
+    Exact on both paths: integer inputs contract in their own dtype;
+    float inputs use Precision.HIGHEST (TPU's default matmul precision
+    truncates float32 operands to bfloat16, which would corrupt the
+    byte-count/bitrate sums this serves).
     """
     n = x.shape[axis]
     axis = axis % x.ndim
-    shift = 1
-    while shift < n:
-        sl = [slice(None)] * x.ndim
-        sl[axis] = slice(0, n - shift)
-        zshape = list(x.shape)
-        zshape[axis] = shift
-        # concatenate, not jnp.pad: pad lowers to a dynamic-update-slice
-        # that measured ~0.3 ms/tick at cfg4; concat fuses.
-        x = x + jnp.concatenate(
-            [jnp.zeros(zshape, x.dtype), x[tuple(sl)]], axis=axis
+    if n == 1:
+        return x
+    xm = jnp.moveaxis(x, axis, -1)
+    tri = jnp.tril(jnp.ones((n, n), x.dtype))          # [i, j≤i]
+    if jnp.issubdtype(x.dtype, jnp.integer):
+        ym = jnp.einsum("...j,ij->...i", xm, tri)
+    else:
+        ym = jnp.einsum(
+            "...j,ij->...i", xm, tri,
+            precision=jax.lax.Precision.HIGHEST,
         )
-        shift *= 2
-    return x
+    return jnp.moveaxis(ym, -1, axis)
