@@ -155,6 +155,53 @@ class KeyValueConfig:
     kind: str = "memory"         # memory | tcp (in-repo BusServer)
     address: str = ""            # host:port for kind=tcp
     auth_token: str = ""         # shared secret for the tcp bus (Redis AUTH seat)
+    # Node liveness lease (routing/router.py): refreshed with each stats
+    # heartbeat; expiry marks the node dead far faster than the 30 s
+    # registry staleness window, triggering room failover.
+    lease_ttl_s: float = 6.0
+    # Cadence of the surviving nodes' dead-pin scan (room failover).
+    failover_interval_s: float = 2.0
+
+
+@dataclass
+class SupervisorConfig:
+    """Media-plane supervision (runtime/supervisor.py): tick watchdog +
+    bounded restart-from-snapshot. Enabled by default — the failure story
+    must hold on the default config path."""
+
+    enabled: bool = True
+    # Watchdog stall deadline: no tick progress for this long while the
+    # serving loop runs ⇒ restart from the last checkpoint.
+    tick_deadline_ms: int = 1000
+    # Relaxed deadline until the FIRST tick after a (re)start completes:
+    # a cold XLA compile can block that tick for many seconds, and
+    # restarting mid-compile both loses the in-flight tick and abandons
+    # a worker thread mid-compilation. Tradeoff: a dispatch that hangs at
+    # startup takes this long to catch.
+    warmup_deadline_s: float = 30.0
+    check_interval_ms: int = 100
+    # Full-plane + per-room checkpoint cadence (restart/failover rewind
+    # is bounded by this).
+    checkpoint_interval_s: float = 2.0
+    max_restarts: int = 5            # consecutive, without regaining health
+    restart_backoff_base_s: float = 0.1
+    restart_backoff_max_s: float = 5.0
+
+
+@dataclass
+class FaultInjectConfig:
+    """Deterministic fault injection (runtime/faultinject.py). OFF by
+    default: the default config path constructs no injector — these knobs
+    exist so chaos tests and soak runs share one seeded mechanism."""
+
+    enabled: bool = False
+    seed: int = 0
+    drop_pct: float = 0.0        # P(drop) per ingest packet
+    dup_pct: float = 0.0         # P(duplicate) per ingest packet
+    delay_pct: float = 0.0       # P(delay) per ingest packet
+    delay_ticks: int = 2         # delayed packets re-enter after N ticks
+    stall_every: int = 0         # every Nth device step stalls (0 = never)
+    stall_s: float = 0.0
 
 
 @dataclass
@@ -198,6 +245,8 @@ class Config:
     kv: KeyValueConfig = field(default_factory=KeyValueConfig)
     relay: RelayConfig = field(default_factory=RelayConfig)
     webhook: WebHookConfig = field(default_factory=WebHookConfig)
+    supervisor: SupervisorConfig = field(default_factory=SupervisorConfig)
+    faults: FaultInjectConfig = field(default_factory=FaultInjectConfig)
 
 
 _SCALARS = (int, float, str, bool)
@@ -330,3 +379,14 @@ def _validate(cfg: Config) -> None:
     for name in ("tick_ms", "rooms", "tracks_per_room", "pkts_per_track", "subs_per_room"):
         if getattr(p, name) <= 0:
             raise ConfigError(f"plane.{name} must be positive")
+    f = cfg.faults
+    for name in ("drop_pct", "dup_pct", "delay_pct"):
+        v = getattr(f, name)
+        if not 0.0 <= v <= 1.0:
+            raise ConfigError(f"faults.{name} must be in [0, 1], got {v}")
+    if f.drop_pct + f.dup_pct + f.delay_pct > 1.0:
+        raise ConfigError("faults.drop_pct + dup_pct + delay_pct must be <= 1")
+    if cfg.supervisor.tick_deadline_ms <= 0:
+        raise ConfigError("supervisor.tick_deadline_ms must be positive")
+    if cfg.kv.lease_ttl_s <= 0:
+        raise ConfigError("kv.lease_ttl_s must be positive")

@@ -496,8 +496,10 @@ class NativeEgress:
         frame = bytes(out[: out_len[0]])
         if sent != 1 or frame[0] != 0x01 or len(frame) != 14 + 12 + len(slab) + 16:
             raise OSError("egress self-test failed")
-        from livekit_server_tpu.runtime.crypto import MediaCryptoClient
+        from livekit_server_tpu.runtime.crypto import HAVE_AEAD, MediaCryptoClient
 
+        if not HAVE_AEAD:
+            return  # frame shape validated above; no Python AEAD to open with
         inner = MediaCryptoClient(42, bytes(16)).open(frame)
         # VP8 descriptor patched: 15-bit pid=5, tl0=6, keyidx=2 in T/K byte.
         if inner is None or inner[12:19] != bytes(
@@ -639,8 +641,12 @@ class NativeMunge:
              send_bits, drop_bits, switch_bits, state, cap: int):
         """Returns column arrays (rooms, tracks, ks, subs, sn, ts, pid,
         tl0, keyidx) of the `cap`-bounded walk; None if cap overflowed
-        (caller falls back). `state` is the HostMunger — its arrays are
-        updated in place."""
+        in the counting pre-pass (nothing mutated — caller falls back to
+        the dense path). Raises RuntimeError on the -2 invariant code:
+        the overflow guard fired mid-walk, AFTER state mutation began, so
+        a fallback would re-apply the tick on top of half-advanced
+        offsets (double-apply corruption on every walked lane). `state`
+        is the HostMunger — its arrays are updated in place."""
         R, T, K = sn.shape
         S = state.sn_offset.shape[-1]
         W = send_bits.shape[-1]
@@ -670,8 +676,14 @@ class NativeMunge:
             *[o.ctypes.data for o in outs],
             cap,
         )
-        if n < 0:
-            return None
+        if n == -1:
+            return None  # pre-pass overflow: state untouched, safe fallback
+        if n < -1:
+            raise RuntimeError(
+                f"munge_walk invariant violation (code {n}): capacity "
+                "overflow after state mutation; dense fallback would "
+                "double-apply this tick"
+            )
         return tuple(o[:n] for o in outs)
 
 

@@ -240,6 +240,11 @@ def allocate_budget_rooms(bitrates, max_spatial, max_temporal, muted, budget,
     from jax.experimental import pallas as pl
     from jax.experimental.pallas import tpu as pltpu
 
+    # Renamed upstream: TPUCompilerParams (<=0.4.x) -> CompilerParams.
+    _CompilerParams = getattr(pltpu, "CompilerParams", None) or (
+        pltpu.TPUCompilerParams
+    )
+
     R, T = bitrates.shape[:2]
     S = budget.shape[-1]
     from livekit_server_tpu.ops.selector import pick_room_block
@@ -265,7 +270,7 @@ def allocate_budget_rooms(bitrates, max_spatial, max_temporal, muted, budget,
         ),
         in_specs=[bit_spec, st_spec, st_spec, st_spec, bud_spec],
         out_specs=(st_spec, bud_spec, st_spec),
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=_CompilerParams(
             vmem_limit_bytes=48 * 1024 * 1024
         ),
         interpret=interpret,

@@ -17,10 +17,15 @@ def cumsum_small(x: jnp.ndarray, axis: int) -> jnp.ndarray:
     """Inclusive prefix sum along a SMALL static axis as a triangular-
     matrix contraction: out_i = Σ_{j≤i} x_j.
 
-    Exact on both paths: integer inputs contract in their own dtype;
-    float inputs use Precision.HIGHEST (TPU's default matmul precision
-    truncates float32 operands to bfloat16, which would corrupt the
-    byte-count/bitrate sums this serves).
+    Precision: integer inputs contract in their own dtype — exact, and
+    that covers the byte-count/packet-count sums this serves. Float
+    inputs use Precision.HIGHEST (TPU's default matmul precision
+    truncates float32 operands to bfloat16, which would visibly corrupt
+    these sums) — but a matmul accumulates each prefix in one reduction
+    order while `jnp.cumsum` folds sequentially, so general float
+    results only match a sequential cumsum to within a few ulps, not
+    bit-exactly. Float values exactly representable with headroom (e.g.
+    byte counts cast to f32 below 2^24) still come out exact.
     """
     n = x.shape[axis]
     axis = axis % x.ndim

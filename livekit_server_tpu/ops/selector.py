@@ -175,6 +175,8 @@ def pick_room_block(R: int, per_room_bytes: int) -> int:
     unrolled-loop live ranges in scoped VMEM, so actual use runs a small
     multiple of this against the raised per-kernel limit), or the whole
     array when R has no suitable 128-multiple divisor."""
+    from livekit_server_tpu.utils.logger import log
+
     cap = max(1, (4 << 20) // max(per_room_bytes, 1))
     for cand in (512, 256, 128):
         if cand <= cap and R % cand == 0:
@@ -184,8 +186,25 @@ def pick_room_block(R: int, per_room_bytes: int) -> int:
         # it is the best effort when even that exceeds the cap (returning
         # R here would request the largest block exactly when the budget
         # is tightest). The per-kernel vmem_limit gives real headroom.
+        log.warn(
+            "pick_room_block over VMEM budget: smallest legal block "
+            "exceeds the ~4MB working-set cap; relying on the raised "
+            "per-kernel vmem_limit",
+            R=R, per_room_bytes=per_room_bytes, block=128, cap_rooms=cap,
+        )
         return 128
-    return R  # no 128-multiple divisor (small or odd R): whole array
+    # No 128-multiple divisor (small or odd R): whole array. Legal only
+    # because Mosaic pads a sub-128 lane dim; a LARGE R landing here means
+    # a dims misconfiguration (e.g. R=384+1) and a likely OOM, not a
+    # deliberate small-plane shape.
+    if R > 128:
+        log.warn(
+            "pick_room_block whole-array fallback for large R: no "
+            "128-multiple divisor; check plane dims",
+            R=R, per_room_bytes=per_room_bytes,
+        )
+    assert R % 128 != 0, "divisible R must take a 128-multiple block above"
+    return R
 
 
 def _decide_rooms_kernel(sp_ref, tp_ref, kf_ref, sync_ref, eof_ref, valid_ref,
@@ -369,6 +388,11 @@ def decide_rooms(state: SelectorState, is_svc, is_video, base, pkt_spatial,
     from jax.experimental import pallas as pl
     from jax.experimental.pallas import tpu as pltpu
 
+    # Renamed upstream: TPUCompilerParams (<=0.4.x) -> CompilerParams.
+    _CompilerParams = getattr(pltpu, "CompilerParams", None) or (
+        pltpu.TPUCompilerParams
+    )
+
     R, T, K = pkt_spatial.shape
     W = bits.mask_words(S)
     # Word-sized outputs keep this kernel's block footprint ~32× smaller
@@ -417,7 +441,7 @@ def decide_rooms(state: SelectorState, is_svc, is_video, base, pkt_spatial,
             + (sub_spec,) * 2 + (tot_spec,) * 2,
             # v5e has 128 MB of VMEM; Mosaic's default 16 MB scoped limit
             # under-counts this kernel's unrolled-loop live ranges.
-            compiler_params=pltpu.CompilerParams(
+            compiler_params=_CompilerParams(
                 vmem_limit_bytes=64 * 1024 * 1024
             ),
             interpret=interpret,

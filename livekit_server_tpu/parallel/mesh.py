@@ -22,6 +22,16 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from livekit_server_tpu.models import plane
 
+# jax.shard_map (with check_vma) landed after 0.4.x; older versions ship
+# it under jax.experimental with the check_rep spelling of the same knob.
+if hasattr(jax, "shard_map"):
+    _shard_map = jax.shard_map
+    _SHARD_MAP_KW = {"check_vma": False}
+else:  # pragma: no cover — exercised on older jax only
+    from jax.experimental.shard_map import shard_map as _shard_map
+
+    _SHARD_MAP_KW = {"check_rep": False}
+
 ROOM_AXIS = "rooms"
 
 
@@ -106,9 +116,9 @@ def make_sharded_tick(
             out_specs = jax.tree.map(
                 lambda x: P() if x.ndim == 0 else P(ROOM_AXIS), out_shapes
             )
-            smapped = jax.shard_map(
+            smapped = _shard_map(
                 tick, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
-                check_vma=False,
+                **_SHARD_MAP_KW,
             )
             cache["fn"] = jax.jit(
                 smapped, donate_argnums=(0,) if donate else ()

@@ -31,6 +31,10 @@ from livekit_server_tpu.utils import ids
 NODES_KEY = "nodes"            # redisrouter.go NodesKey hash
 NODE_ROOM_KEY = "room_node_map"  # NodeRoomKey hash
 STATS_MAX_AGE = 30.0
+# Liveness lease: a TTL key refreshed with every stats heartbeat. Expiry
+# marks a node dead within lease_ttl (~3 heartbeats) instead of the 30 s
+# registry staleness window — the signal room failover keys off.
+NODE_LEASE_PREFIX = "node_lease:"
 
 # handler(room_name, participant_init, request_source, response_sink)
 SessionHandler = Callable[[str, dict, MessageChannel, MessageChannel], Awaitable[None]]
@@ -73,6 +77,7 @@ class Router(Protocol):
     async def clear_room_state(self, room_name: str) -> None: ...
     async def try_takeover(self, room_name: str, dead_node_id: str = "") -> str: ...
     async def is_node_alive(self, node_id: str) -> bool: ...
+    async def dead_room_pins(self) -> list[tuple[str, str]]: ...
     def on_new_session(self, handler: SessionHandler) -> None: ...
     async def start_participant_signal(
         self, room_name: str, init: ParticipantInit
@@ -124,6 +129,11 @@ class LocalRouter:
     async def is_node_alive(self, node_id: str) -> bool:
         return node_id == self.local_node.node_id
 
+    async def dead_room_pins(self) -> list[tuple[str, str]]:
+        """(room, node_id) pairs pinned to nodes that are no longer alive.
+        Single-node: every pin is ours, so never any."""
+        return []
+
     def on_new_session(self, handler: SessionHandler) -> None:
         self._handler = handler
 
@@ -153,18 +163,29 @@ class KVRouter(LocalRouter):
     errors rather than silently reordered).
     """
 
-    def __init__(self, local_node: LocalNode, bus: MessageBus, stats_interval: float = 2.0):
+    def __init__(
+        self,
+        local_node: LocalNode,
+        bus: MessageBus,
+        stats_interval: float = 2.0,
+        lease_ttl: float = 6.0,
+    ):
         super().__init__(local_node)
         self.bus = bus
         self.stats_interval = stats_interval
+        self.lease_ttl = lease_ttl
         self._stats_task: asyncio.Task | None = None
         self._session_task: asyncio.Task | None = None
         self._session_sub = None
+
+    def _lease_key(self, node_id: str) -> str:
+        return NODE_LEASE_PREFIX + node_id
 
     # -- node registry --------------------------------------------------
     async def register_node(self) -> None:
         self.local_node.stats.updated_at = time.time()
         await self.bus.hset(NODES_KEY, self.local_node.node_id, json.dumps(self.local_node.to_dict()))
+        await self.bus.set(self._lease_key(self.local_node.node_id), "1", self.lease_ttl)
         self._session_sub = self.bus.subscribe(f"node_session:{self.local_node.node_id}")
         self._stats_task = self._track(asyncio.ensure_future(self._stats_worker()))
         self._session_task = self._track(asyncio.ensure_future(self._session_worker()))
@@ -176,6 +197,7 @@ class KVRouter(LocalRouter):
             self._session_task.cancel()
         if self._session_sub is not None:
             self._session_sub.close()
+        await self.bus.delete(self._lease_key(self.local_node.node_id))
         await self.bus.hdel(NODES_KEY, self.local_node.node_id)
 
     async def remove_dead_nodes(self) -> None:
@@ -191,6 +213,7 @@ class KVRouter(LocalRouter):
             await self.bus.hset(
                 NODES_KEY, self.local_node.node_id, json.dumps(self.local_node.to_dict())
             )
+            await self.bus.set(self._lease_key(self.local_node.node_id), "1", self.lease_ttl)
 
     async def list_nodes(self) -> list[LocalNode]:
         raw = await self.bus.hgetall(NODES_KEY)
@@ -239,13 +262,38 @@ class KVRouter(LocalRouter):
 
     async def is_node_alive(self, node_id: str) -> bool:
         """One-field liveness probe for the join hot path (vs. fetching
-        and parsing the whole registry)."""
+        and parsing the whole registry).
+
+        A node is alive when its registry entry exists AND either its
+        lease key is live or its heartbeat is fresh within lease_ttl.
+        The lease is the fast-death signal (expires ~3 missed heartbeats
+        after a crash); the heartbeat fallback keeps one lost lease write
+        from marking a healthy node dead, since both are rewritten on the
+        same cadence."""
         if node_id == self.local_node.node_id:
             return True
         raw = await self.bus.hget(NODES_KEY, node_id)
         if not raw:
             return False
-        return LocalNode.from_dict(json.loads(raw)).is_available(STATS_MAX_AGE)
+        if await self.bus.get(self._lease_key(node_id)) is not None:
+            return True
+        return LocalNode.from_dict(json.loads(raw)).is_available(self.lease_ttl)
+
+    async def dead_room_pins(self) -> list[tuple[str, str]]:
+        """(room, node_id) pairs whose pinned node's lease has lapsed —
+        the failover worker's scan (see service/roommanager.py). Local
+        pins are excluded: we cannot adjudicate our own death."""
+        pins = await self.bus.hgetall(NODE_ROOM_KEY)
+        alive_cache: dict[str, bool] = {}
+        dead: list[tuple[str, str]] = []
+        for room, node_id in pins.items():
+            if not node_id or node_id == self.local_node.node_id:
+                continue
+            if node_id not in alive_cache:
+                alive_cache[node_id] = await self.is_node_alive(node_id)
+            if not alive_cache[node_id]:
+                dead.append((room, node_id))
+        return dead
 
     # -- signal relay ---------------------------------------------------
     async def start_participant_signal(
@@ -368,8 +416,10 @@ class KVRouter(LocalRouter):
         await self.bus.hset(NODES_KEY, self.local_node.node_id, json.dumps(self.local_node.to_dict()))
 
 
-def create_router(local_node: LocalNode, bus: MessageBus | None) -> Router:
+def create_router(
+    local_node: LocalNode, bus: MessageBus | None, lease_ttl: float = 6.0
+) -> Router:
     """interfaces.go:116 CreateRouter — bus present ⇒ distributed."""
     if bus is None:
         return LocalRouter(local_node)
-    return KVRouter(local_node, bus)
+    return KVRouter(local_node, bus, lease_ttl=lease_ttl)

@@ -31,6 +31,11 @@ import json
 from typing import Any
 
 from livekit_server_tpu.routing.kv import MemoryBus, Subscription
+from livekit_server_tpu.utils.backoff import (
+    BackoffPolicy,
+    CircuitBreaker,
+    retry_async,
+)
 
 MAX_FRAME = 8 * 1024 * 1024  # room snapshots ride the bus; give them room
 MAX_BUFFERED = 4 * 1024 * 1024  # per-subscriber write backlog before drops
@@ -164,16 +169,19 @@ class BusServer:
 class TCPBusClient:
     """MessageBus over one TCP connection (the Redis-client seat).
 
-    Reconnects automatically with backoff when the connection drops (the
-    go-redis behavior the node registry depends on — a blip must not
-    permanently sever a node from the cluster): in-flight calls fail with
-    ConnectionError (callers like the 2 s heartbeat retry naturally), and
-    every live subscription is re-issued on the fresh connection. Pushes
-    published during the outage are lost — exactly Redis pub/sub
-    semantics, which every consumer (heartbeats, signal relay seq-resume)
-    already tolerates."""
+    Reconnects automatically when the connection drops (the go-redis
+    behavior the node registry depends on — a blip must not permanently
+    sever a node from the cluster), under the uniform BackoffPolicy
+    (exponential, full jitter) with a circuit breaker capping the dial
+    rate when the bus is hard-down. Calls ride out short blips with a
+    bounded retry of their own (counted in `retries`) before surfacing
+    ConnectionError; every live subscription is re-issued on the fresh
+    connection. Pushes published during the outage are lost — exactly
+    Redis pub/sub semantics, which every consumer (heartbeats, signal
+    relay seq-resume) already tolerates."""
 
     RECONNECT_MAX_S = 5.0
+    CALL_TIMEOUT_S = 10.0  # per-attempt; a bus that accepts but never answers
 
     def __init__(self, reader: asyncio.StreamReader, writer: asyncio.StreamWriter,
                  host: str = "", port: int = 0, token: str = ""):
@@ -187,6 +195,14 @@ class TCPBusClient:
         self.closed = False
         self._connected = True
         self.reconnects = 0
+        self.retries = 0  # call-level retry count (telemetry gauge feed)
+        self._dial_backoff = BackoffPolicy(base=0.05, max_delay=self.RECONNECT_MAX_S)
+        # Hard-down bus: after 8 straight failed dials, stop hammering and
+        # probe once per cooldown instead.
+        self._dial_breaker = CircuitBreaker(threshold=8, cooldown_s=self.RECONNECT_MAX_S)
+        # Call retries stay short and bounded: they exist to ride out the
+        # reconnect window, not to mask a real outage from callers.
+        self._call_policy = BackoffPolicy(base=0.05, max_delay=0.5, max_attempts=4)
 
     @classmethod
     async def connect(cls, host: str, port: int, token: str = "") -> "TCPBusClient":
@@ -241,10 +257,16 @@ class TCPBusClient:
                 return
 
     async def _reconnect(self) -> bool:
-        """Dial until the bus answers (bounded backoff), then re-auth and
-        re-subscribe every live channel. Returns False only on close()."""
-        delay = 0.05
+        """Dial until the bus answers (jittered backoff, breaker-capped
+        dial rate), then re-auth and re-subscribe every live channel.
+        Returns False only on close()."""
+        attempt = 0
         while not self.closed:
+            if not self._dial_breaker.allow():
+                # Open breaker: one probe per cooldown instead of a dial
+                # per backoff step against a hard-down bus.
+                await asyncio.sleep(self._dial_breaker.cooldown_s)
+                continue
             try:
                 reader, writer = await asyncio.open_connection(self._host, self._port)
                 try:
@@ -266,10 +288,12 @@ class TCPBusClient:
                         lambda f: f.exception()
                     )
                 self.reconnects += 1
+                self._dial_breaker.record_success()
                 return True
             except OSError:
-                await asyncio.sleep(delay)
-                delay = min(delay * 2, self.RECONNECT_MAX_S)
+                self._dial_breaker.record_failure()
+                await asyncio.sleep(self._dial_backoff.delay(attempt))
+                attempt += 1
         return False
 
     def _send(self, op: str, *args) -> asyncio.Future:
@@ -284,7 +308,21 @@ class TCPBusClient:
         return fut
 
     async def _call(self, op: str, *args):
-        return await self._send(op, *args)
+        """One bus op under the uniform retry policy: a call that lands in
+        the reconnect window retries (briefly, with jittered backoff)
+        instead of failing on the first dead-transport write. Server-side
+        errors (RuntimeError) never retry — only transport loss does."""
+
+        def _on_retry(_attempt: int, _exc: BaseException) -> None:
+            self.retries += 1
+
+        return await retry_async(
+            lambda: self._send(op, *args),
+            self._call_policy,
+            retry_on=(ConnectionError,),
+            timeout=self.CALL_TIMEOUT_S,
+            on_retry=_on_retry,
+        )
 
     # -- MessageBus -----------------------------------------------------
     async def hset(self, key, field, value):

@@ -16,5 +16,15 @@ and drains it:
 from livekit_server_tpu.runtime.slots import CapacityError, SlotAllocator
 from livekit_server_tpu.runtime.ingest import IngestBuffer
 from livekit_server_tpu.runtime.plane_runtime import PlaneRuntime
+from livekit_server_tpu.runtime.supervisor import PlaneSupervisor
+from livekit_server_tpu.runtime.faultinject import FaultInjector, FaultSpec
 
-__all__ = ["CapacityError", "IngestBuffer", "PlaneRuntime", "SlotAllocator"]
+__all__ = [
+    "CapacityError",
+    "FaultInjector",
+    "FaultSpec",
+    "IngestBuffer",
+    "PlaneRuntime",
+    "PlaneSupervisor",
+    "SlotAllocator",
+]

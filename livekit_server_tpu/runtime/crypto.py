@@ -25,8 +25,20 @@ from __future__ import annotations
 
 import secrets
 
-from cryptography.exceptions import InvalidTag
-from cryptography.hazmat.primitives.ciphers.aead import AESGCM
+try:
+    from cryptography.exceptions import InvalidTag
+    from cryptography.hazmat.primitives.ciphers.aead import AESGCM
+except ImportError:  # optional dependency: a node without the package
+    # still runs — cleartext media only (RoomManager skips registry
+    # creation, join responses omit media_crypto). Constructing any
+    # session/endpoint without it raises RuntimeError instead.
+    AESGCM = None
+
+    class InvalidTag(Exception):
+        pass
+
+
+HAVE_AEAD = AESGCM is not None
 
 MAGIC = 0x01
 DIR_C2S = 0
@@ -96,6 +108,8 @@ class _Endpoint:
     opposite direction with authentication + replay rejection."""
 
     def __init__(self, key_id: int, key: bytes, tx_dir: int) -> None:
+        if AESGCM is None:
+            raise RuntimeError("media crypto requires the 'cryptography' package")
         self.key_id = key_id
         self.key = key
         self.aead = AESGCM(key)

@@ -1,0 +1,157 @@
+"""Deterministic, seedable fault injection for chaos tests and soak runs.
+
+Faults thread in at well-defined seams so the SAME mechanism drives unit
+chaos tests (tests/test_faultinject.py, tests/test_failover.py) and
+future on-TPU soak runs:
+
+  - packet faults (drop / delay / duplicate) at the ingest boundary —
+    IngestBuffer.push consults an attached injector before staging, so
+    faulted traffic exercises the identical tick path real loss would
+  - tick stalls — PlaneRuntime._device_step calls maybe_stall() on the
+    worker thread, wedging the tick exactly where a pathological XLA
+    dispatch or driver hang would (what the PlaneSupervisor watchdog
+    exists to catch)
+  - bus severing — abort a TCPBusClient's transport mid-conversation
+    (exercises the retry/backoff/reconnect path in routing/tcpbus.py)
+  - node kill — abrupt, non-graceful teardown of a server's cluster
+    presence: heartbeats stop, the lease expires, the pin is left behind
+    (exactly what a crashed host looks like to the survivors)
+
+Determinism: every probabilistic decision draws from one seeded
+numpy Generator in arrival order, so a given (seed, packet sequence)
+replays the identical fault pattern — the property the reproducibility
+tests pin. All faults default OFF; config (config.faults.*) gates them
+and the default config path never constructs an injector.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Any
+
+import numpy as np
+
+
+@dataclass
+class FaultSpec:
+    """Injection plan (mirrors config.FaultInjectConfig)."""
+
+    seed: int = 0
+    drop_pct: float = 0.0     # P(drop) per ingest packet
+    dup_pct: float = 0.0      # P(duplicate) per ingest packet
+    delay_pct: float = 0.0    # P(delay) per ingest packet
+    delay_ticks: int = 2      # held-back packets re-enter after this many ticks
+    stall_every: int = 0      # every Nth device step stalls (0 = never)
+    stall_s: float = 0.0      # stall duration
+
+
+@dataclass
+class FaultStats:
+    dropped: int = 0
+    duplicated: int = 0
+    delayed: int = 0
+    stalls: int = 0
+    severed: int = 0
+    killed: int = 0
+
+
+class FaultInjector:
+    """One injector per runtime; attach via `runtime.fault` and
+    `runtime.ingest.fault` (RoomManager does both when config enables it)."""
+
+    def __init__(self, spec: FaultSpec | None = None, **overrides: Any):
+        spec = spec or FaultSpec()
+        if overrides:
+            spec = FaultSpec(**{**vars(spec), **overrides})
+        self.spec = spec
+        self.rng = np.random.default_rng(spec.seed)
+        self.stats = FaultStats()
+        # release_tick → [PacketIn]; drained by take_due() at tick edges.
+        self._held: dict[int, list] = {}
+        self._step_count = 0
+
+    @classmethod
+    def from_config(cls, cfg) -> "FaultInjector":
+        return cls(FaultSpec(
+            seed=cfg.seed, drop_pct=cfg.drop_pct, dup_pct=cfg.dup_pct,
+            delay_pct=cfg.delay_pct, delay_ticks=cfg.delay_ticks,
+            stall_every=cfg.stall_every, stall_s=cfg.stall_s,
+        ))
+
+    # -- ingest-boundary packet faults -----------------------------------
+    def on_packet(self, pkt, tick_index: int) -> str:
+        """Verdict for one arriving packet, drawn in arrival order:
+        'drop' (discard), 'delay' (held; re-enters at tick_index +
+        delay_ticks), 'dup' (stage twice), or 'pass'. One uniform draw
+        per packet keeps the sequence alignment-stable across verdicts."""
+        s = self.spec
+        u = float(self.rng.random())
+        if u < s.drop_pct:
+            self.stats.dropped += 1
+            return "drop"
+        if u < s.drop_pct + s.delay_pct:
+            self.stats.delayed += 1
+            self._held.setdefault(tick_index + max(1, s.delay_ticks), []).append(pkt)
+            return "delay"
+        if u < s.drop_pct + s.delay_pct + s.dup_pct:
+            self.stats.duplicated += 1
+            return "dup"
+        return "pass"
+
+    def take_due(self, tick_index: int) -> list:
+        """Delayed packets whose release tick has arrived (drained by
+        IngestBuffer right before each tick's drain)."""
+        due: list = []
+        for t in sorted(k for k in self._held if k <= tick_index):
+            due.extend(self._held.pop(t))
+        return due
+
+    # -- tick stalls ------------------------------------------------------
+    def maybe_stall(self) -> None:
+        """Called from the device-step worker thread: sleeping here wedges
+        the tick without blocking the event loop — the watchdog's view is
+        identical to a hung dispatch."""
+        self._step_count += 1
+        s = self.spec
+        if s.stall_every and s.stall_s > 0 and self._step_count % s.stall_every == 0:
+            import time
+
+            self.stats.stalls += 1
+            time.sleep(s.stall_s)
+
+    # -- infrastructure faults (chaos-test helpers) ----------------------
+    def sever_bus(self, client) -> None:
+        """Hard-drop a TCPBusClient's socket (no FIN handshake): in-flight
+        calls fail, the retry/backoff path re-dials."""
+        self.stats.severed += 1
+        transport = getattr(client._writer, "transport", None)
+        if transport is not None:
+            transport.abort()
+        else:  # non-asyncio writer (tests with fakes)
+            client._writer.close()
+
+    async def kill_node(self, server) -> None:
+        """Crash a server the way a dead host looks to the cluster:
+        heartbeats and the session worker stop, the runtime halts, the
+        bus socket drops — but NOTHING is cleaned up (no hdel, no lease
+        delete, no room unpin). Survivors must detect the expired lease
+        and take the rooms over."""
+        self.stats.killed += 1
+        router = server.router
+        for attr in ("_stats_task", "_session_task"):
+            task = getattr(router, attr, None)
+            if task is not None:
+                task.cancel()
+        if getattr(server, "_stats_task", None) is not None:
+            server._stats_task.cancel()
+        sup = getattr(server.room_manager, "supervisor", None)
+        if sup is not None:
+            await sup.stop()
+        await server.room_manager.runtime.stop()
+        failover = getattr(server.room_manager, "_failover_task", None)
+        if failover is not None:
+            failover.cancel()
+        bus = getattr(router, "bus", None)
+        if bus is not None and hasattr(bus, "_writer"):
+            bus.closed = True  # suppress the reconnect loop: the node is dead
+            self.sever_bus(bus)

@@ -125,6 +125,11 @@ class IngestBuffer:
         # taken, admitting more packets would advance munger offsets past
         # what the destination node restores (duplicate SNs on re-issue).
         self.frozen_rows: set[int] = set()
+        # Optional FaultInjector (runtime/faultinject.py) consulted by
+        # push()/push_batch(); None on the default config path. Delayed
+        # packets re-enter at the top of drain() for their release tick.
+        self.fault = None
+        self._fault_tick = 0
         self._i32 = lambda: np.zeros((R, T, K), np.int32)
         self._bool = lambda: np.zeros((R, T, K), bool)
         self._alloc_fields()
@@ -199,10 +204,16 @@ class IngestBuffer:
         self.ts_jump = np.full(self.sn.shape, 3000, np.int32)
         self.valid = self._bool()
 
-    def push(self, pkt: PacketIn, t_rx: float = 0.0) -> bool:
+    def push(self, pkt: PacketIn, t_rx: float = 0.0, _fault_ok: bool = False) -> bool:
         """Stage one packet; False (and counted) if the tick is full."""
         if pkt.room in self.frozen_rows:
             return False  # mid-migration: the row's state is already shipped
+        if self.fault is not None and not _fault_ok:
+            verdict = self.fault.on_packet(pkt, self._fault_tick)
+            if verdict in ("drop", "delay"):
+                return False  # delayed packets re-enter via drain()
+            if verdict == "dup":
+                self.push(pkt, t_rx, _fault_ok=True)
         self.rx_pkts[pkt.room, pkt.track] += 1
         self.rx_bytes[pkt.room, pkt.track] += pkt.size
         k = self._count[pkt.room, pkt.track]
@@ -251,6 +262,34 @@ class IngestBuffer:
         n = len(room)
         if n == 0:
             return 0
+        if self.fault is not None:
+            # Chaos path: route the batch through the per-packet seam so
+            # the seeded rng sees every packet in arrival order (the
+            # reproducibility contract). Slow is fine — fault runs are
+            # tests/soaks, never the default config. DD extension bytes
+            # are not re-staged on this path (chaos runs don't assert SVC
+            # descriptor passthrough).
+            staged = 0
+            for i in range(n):
+                ps, pl = int(pay_start[i]), int(pay_length[i])
+                staged += self.push(
+                    PacketIn(
+                        room=int(room[i]), track=int(track[i]),
+                        sn=int(sn[i]), ts=int(ts[i]), size=int(size[i]),
+                        payload=bytes(blob[ps:ps + pl]) if ps >= 0 else b"",
+                        marker=bool(marker[i]), layer=int(layer[i]),
+                        temporal=int(temporal[i]), keyframe=bool(keyframe[i]),
+                        layer_sync=bool(layer_sync[i]),
+                        begin_pic=bool(begin_pic[i]), pid=int(pid[i]),
+                        tl0=int(tl0[i]), keyidx=int(keyidx[i]),
+                        frame_ms=int(frame_ms[i]),
+                        audio_level=int(audio_level[i]),
+                        arrival_rtp=int(arrival_rtp[i]),
+                        ts_aligned=bool(ts_aligned[i]),
+                    ),
+                    t_rx,
+                )
+            return staged
         if dd_start is None:
             dd_start = np.full(n, -1, np.int64)
             dd_length = np.zeros(n, np.int32)
@@ -468,6 +507,12 @@ class IngestBuffer:
         pad_track=None,
     ) -> tuple[plane.TickInputs, PayloadSlab]:
         """Snapshot this tick's tensors and reset for the next tick."""
+        if self.fault is not None:
+            # Release held-back (delayed) packets whose tick has arrived:
+            # they stage now, so they ride THIS tick's tensors.
+            for pkt in self.fault.take_due(tick_index):
+                self.push(pkt, _fault_ok=True)
+            self._fault_tick = tick_index + 1
         self._reorder_dedup()
         R, T, K, S = self.dims
         if pad_num is None:

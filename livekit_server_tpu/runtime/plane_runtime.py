@@ -322,6 +322,14 @@ class PlaneRuntime:
         self._last_deficient = np.zeros((R, S), bool)
         self._task: asyncio.Task | None = None
         self._complete_task: asyncio.Task | None = None
+        # Bumped by PlaneSupervisor on restart: a device step that started
+        # before the bump must not commit its result over restored state
+        # (the stale step ran — or is still wedged — on the abandoned
+        # executor thread).
+        self.run_epoch = 0
+        # Optional FaultInjector (runtime/faultinject.py); None on the
+        # default config path — chaos tests and soak runs attach one.
+        self.fault = None
         # Guards self.state across the donated device step vs. host-side
         # snapshot/restore (room migration): donation deletes the old
         # buffers mid-step, so concurrent readers would see dead arrays.
@@ -407,15 +415,30 @@ class PlaneRuntime:
         self._ctrl_dirty = False
 
     def _device_step(self, inp):
-        """The blocking device round trip; runs off the event loop."""
+        """The blocking device round trip; runs off the event loop.
+
+        Returns None (instead of outputs) when a supervisor restart
+        abandoned this step mid-flight: the epoch check straddles the
+        injected stall so a woken stale thread never consumes — or
+        donates — state the restart already restored."""
+        epoch = self.run_epoch
+        if self.fault is not None:
+            self.fault.maybe_stall()
+        if epoch != self.run_epoch:
+            return None
         if self._mesh is not None:
-            self.state, out = self._step(self.state, inp)
-            return jax.tree.map(np.asarray, out)
-        packed = plane.pack_tick_inputs(inp)
-        self.state, buf = self._step(self.state, *packed)
-        return plane.unpack_tick_outputs(
-            np.asarray(buf), self.dims, self.red_enabled
-        )
+            state, out = self._step(self.state, inp)
+            out = jax.tree.map(np.asarray, out)
+        else:
+            packed = plane.pack_tick_inputs(inp)
+            state, buf = self._step(self.state, *packed)
+            out = plane.unpack_tick_outputs(
+                np.asarray(buf), self.dims, self.red_enabled
+            )
+        if epoch != self.run_epoch:
+            return None  # restarted mid-step: result belongs to a dead run
+        self.state = state
+        return out
 
     def _stage(self):
         """Host pre-step: ctrl upload, probe scheduling, ingest drain.
@@ -494,11 +517,23 @@ class PlaneRuntime:
         round trip runs in a worker thread so the event loop (signal
         sessions) never blocks on HBM/tunnel latency. The serving loop
         (`_run`) instead pipelines: egress fan-out of tick N overlaps tick
-        N+1's device step."""
-        inp, payloads, idx, roll, t0 = self._stage()
+        N+1's device step.
+
+        Do NOT interleave step_once with a RUNNING serving loop: the
+        device steps serialize safely under state_lock, but this path's
+        immediate fan-out can land before the loop's deferred fan-out of
+        an EARLIER tick, which then rewrites munger lanes backwards
+        (last-writer-wins) and emits egress out of wire order."""
         loop = asyncio.get_running_loop()
+        # Stage under the lock: _upload_ctrl replaces fields on self.state,
+        # and a concurrent serving-loop tick may have that state donated to
+        # an in-flight device step — staging against it reads deleted
+        # buffers (or the step's commit silently discards the upload).
         async with self.state_lock:
+            inp, payloads, idx, roll, t0 = self._stage()
             out = await loop.run_in_executor(self._executor, self._device_step, inp)
+        if out is None:
+            raise asyncio.CancelledError("device step abandoned by restart")
         self._mirror_probe_inputs(out)
         return await self._complete(out, inp, payloads, idx, roll, t0)
 
@@ -656,8 +691,10 @@ class PlaneRuntime:
         completion queue is bounded at 1: if host egress can't keep up,
         the loop degrades to sequential instead of queueing stale sends.
         self.state stays single-owner: staging (which touches the donated
-        state via ctrl uploads) only ever runs after the previous device
-        future resolved."""
+        state via ctrl uploads) runs under state_lock, so it can never
+        observe a state donated to an in-flight device step — not this
+        loop's, and not a concurrent step_once's (tests and warmup step
+        manually while the loop serves)."""
         period = self.tick_ms / 1000.0
         next_at = time.perf_counter() + period
         loop = asyncio.get_running_loop()
@@ -673,8 +710,8 @@ class PlaneRuntime:
                     pending_task = self._complete_task = None
                     if res.tick_s > period:
                         self.stats["late_ticks"] += 1
-                staged = self._stage()
                 await self.state_lock.acquire()
+                staged = self._stage()
                 fut = loop.run_in_executor(
                     self._executor, self._device_step, staged[0]
                 )
@@ -687,6 +724,10 @@ class PlaneRuntime:
                     out = await fut
                 finally:
                     self.state_lock.release()
+                if out is None:
+                    # Abandoned by a supervisor restart racing our cancel:
+                    # bail to the drain handler without touching state.
+                    raise asyncio.CancelledError("device step abandoned by restart")
                 self._mirror_probe_inputs(out)
                 pending = (out, staged, time.perf_counter() - staged[4])
                 if self.low_latency:
@@ -846,5 +887,12 @@ class PlaneRuntime:
             self.state = shard_tree(self.state, self._mesh)
         if "munger" in snap:
             self.munger.restore(snap["munger"])
+        else:
+            # A munger-less snapshot (pre-round-5 format, or a producer
+            # that stripped host state) must not pair restored device
+            # decisions with STALE SN/TS offsets — every lane would keep
+            # rewriting against the wrong anchor. Reset so lanes anchor
+            # fresh instead (a one-time stream reset, like a new room).
+            self.munger = HostMunger(self.dims)
         self.tick_index = snap["tick_index"]
         self._ctrl_dirty = True

@@ -74,6 +74,8 @@ import hmac
 import secrets
 import time
 
+from livekit_server_tpu.utils.backoff import BackoffPolicy, retry_async
+
 RELAY_MAGIC = b"LKRL"
 BIND_REQ = 0x01
 BIND_ACK = 0x02
@@ -176,6 +178,10 @@ class MediaRelay(asyncio.DatagramProtocol):
     """One UDP socket facing clients; one socket per allocation facing the
     SFU. Forwards datagrams verbatim — admission only, no inspection."""
 
+    # Upstream-bind retry budget: short, because the client is blocked on
+    # the BIND ACK and will retransmit anyway.
+    BIND_RETRY = BackoffPolicy(base=0.02, max_delay=0.2, max_attempts=3)
+
     def __init__(
         self,
         upstream_addr: tuple[str, int],
@@ -255,12 +261,20 @@ class MediaRelay(asyncio.DatagramProtocol):
             loop = asyncio.get_running_loop()
             self._pending.add(key_id)
             try:
-                await loop.create_datagram_endpoint(
-                    lambda: proto, remote_addr=self.upstream_addr
+                # Bounded retry (uniform BackoffPolicy): transient FD
+                # pressure or a momentarily exhausted ephemeral-port range
+                # clears within a few dozen ms, and one extra dial beats
+                # bouncing the client to its TCP fallback.
+                await retry_async(
+                    lambda: loop.create_datagram_endpoint(
+                        lambda: proto, remote_addr=self.upstream_addr
+                    ),
+                    self.BIND_RETRY,
+                    retry_on=(OSError,),
                 )
             except OSError:
-                # FD pressure / transient failure: tell the client now so
-                # it falls back to TCP instead of timing out.
+                # Still failing after the retry budget: tell the client now
+                # so it falls back to TCP instead of timing out.
                 self._reject(addr)
                 return
             finally:

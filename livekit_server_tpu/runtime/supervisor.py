@@ -1,0 +1,197 @@
+"""PlaneSupervisor: tick watchdog + restart-from-snapshot for the media plane.
+
+The reference SFU survives a wedged loop because every goroutine is
+independently restartable; this runtime concentrates the whole node in
+one jitted call per tick, so a single hung device dispatch takes every
+room down. The supervisor restores the reference's failure story at the
+plane level:
+
+  - tick watchdog — samples the runtime's tick counter; no progress for
+    `tick_deadline_s` while the loop is supposed to be running means the
+    plane is stalled (hung XLA dispatch, wedged worker thread, runaway
+    callback)
+  - bounded restart-from-snapshot — on stall (or a crashed serving loop)
+    the task is cancelled, the possibly-wedged executor thread is
+    ABANDONED (a fresh single-worker executor takes over; the run-epoch
+    guard in PlaneRuntime._device_step keeps a late-completing stale
+    step from overwriting restored state), device+munger state is
+    restored from the last periodic snapshot, and the loop starts again
+    — with exponential backoff between attempts and a hard cap, after
+    which the supervisor gives up loudly rather than flap forever
+  - periodic checkpoints — a full-plane snapshot on a cadence (the
+    restart seed), plus an optional per-room checkpoint callback the
+    RoomManager uses to publish room rows to the KV bus (the failover
+    seed surviving nodes restore from; see service/roommanager.py)
+
+Restart rewinds at most one checkpoint interval of munger advance:
+packets forwarded after the snapshot are re-issued with the same SNs
+(duplicates, which receivers tolerate), never skipped.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import time
+from typing import Any, Awaitable, Callable
+
+from livekit_server_tpu.utils.backoff import BackoffPolicy
+from livekit_server_tpu.utils.logger import Logger
+
+
+class PlaneSupervisor:
+    def __init__(
+        self,
+        runtime,
+        *,
+        tick_deadline_s: float = 1.0,
+        warmup_deadline_s: float = 30.0,
+        check_interval_s: float = 0.1,
+        checkpoint_interval_s: float = 2.0,
+        max_restarts: int = 5,
+        backoff: BackoffPolicy | None = None,
+        telemetry=None,
+        log: Logger | None = None,
+    ):
+        self.runtime = runtime
+        self.tick_deadline_s = tick_deadline_s
+        self.warmup_deadline_s = max(warmup_deadline_s, tick_deadline_s)
+        self.check_interval_s = check_interval_s
+        self.checkpoint_interval_s = checkpoint_interval_s
+        self.max_restarts = max_restarts
+        self.backoff = backoff or BackoffPolicy(base=0.1, max_delay=5.0)
+        self.telemetry = telemetry
+        self.log = log or Logger()
+        # Awaited on the checkpoint cadence; RoomManager points this at
+        # its per-room bus publisher.
+        self.room_checkpoint_cb: Callable[[], Awaitable[None]] | None = None
+        self.last_snapshot: dict[str, Any] | None = None
+        self.restarts = 0            # lifetime restart count (telemetry)
+        self.gave_up = False
+        self._attempts = 0           # consecutive restarts without health
+        self._watch_task: asyncio.Task | None = None
+        self._ckpt_task: asyncio.Task | None = None
+        self._ticks_seen = -1
+        self._progress_at = 0.0
+        self._baseline_ticks = -1
+
+    # -- lifecycle --------------------------------------------------------
+    def start(self) -> None:
+        if self._watch_task is None:
+            self._progress_at = time.monotonic()
+            self._baseline_ticks = self.runtime.stats.get("ticks", 0)
+            self._watch_task = asyncio.ensure_future(self._watchdog())
+        if self._ckpt_task is None:
+            self._ckpt_task = asyncio.ensure_future(self._checkpointer())
+
+    async def stop(self) -> None:
+        for attr in ("_watch_task", "_ckpt_task"):
+            task = getattr(self, attr)
+            if task is not None:
+                task.cancel()
+                try:
+                    await task
+                except asyncio.CancelledError:
+                    pass
+                setattr(self, attr, None)
+
+    # -- checkpoint cadence ----------------------------------------------
+    async def checkpoint_now(self) -> None:
+        """One full-plane snapshot (the restart seed), then the per-room
+        callback. Taken under state_lock so the donated device step never
+        has the arrays mid-flight."""
+        async with self.runtime.state_lock:
+            self.last_snapshot = self.runtime.snapshot()
+        if self.room_checkpoint_cb is not None:
+            await self.room_checkpoint_cb()
+
+    async def _checkpointer(self) -> None:
+        while True:
+            await asyncio.sleep(self.checkpoint_interval_s)
+            try:
+                await self.checkpoint_now()
+            except asyncio.CancelledError:
+                raise
+            except Exception as e:  # noqa: BLE001 — a failed checkpoint
+                # (bus outage mid-publish) must not kill the cadence; the
+                # next interval retries with fresher state anyway.
+                self.log.warn("plane checkpoint failed", error=str(e))
+
+    # -- watchdog ---------------------------------------------------------
+    def _stalled(self, now: float) -> str:
+        """Non-empty reason string when the plane needs a restart."""
+        task = self.runtime._task
+        if task is None:
+            return ""  # not started (or stopped on purpose): nothing to guard
+        if task.done():
+            if task.cancelled():
+                return ""  # deliberate stop between our samples
+            exc = task.exception()
+            return f"serving loop died: {exc!r}" if exc else "serving loop exited"
+        ticks = self.runtime.stats.get("ticks", 0)
+        if ticks != self._ticks_seen:
+            self._ticks_seen = ticks
+            self._progress_at = now
+            if self._attempts:
+                self.log.info("plane healthy after restart", restarts=self.restarts)
+            self._attempts = 0  # healthy: future failures start a fresh budget
+            return ""
+        # The first tick after a (re)start may legitimately block for many
+        # seconds in a cold XLA compile; restarting mid-compile loses the
+        # in-flight tick's packets AND abandons a worker thread that can
+        # die mid-cache-write at process exit (truncated persistent-cache
+        # entries load as silently-miscompiled executables later). Hold
+        # the relaxed warmup deadline until the first tick completes.
+        deadline = (
+            self.tick_deadline_s
+            if ticks > self._baseline_ticks
+            else self.warmup_deadline_s
+        )
+        if now - self._progress_at > deadline:
+            return f"tick watchdog: no progress in {now - self._progress_at:.2f}s"
+        return ""
+
+    async def _watchdog(self) -> None:
+        while True:
+            await asyncio.sleep(self.check_interval_s)
+            reason = self._stalled(time.monotonic())
+            if not reason:
+                continue
+            if self._attempts >= self.max_restarts:
+                self.gave_up = True
+                self.log.error(
+                    "plane restart budget exhausted; supervisor giving up",
+                    attempts=self._attempts, reason=reason,
+                )
+                return
+            await self._restart(reason)
+
+    async def _restart(self, reason: str) -> None:
+        from concurrent.futures import ThreadPoolExecutor
+
+        rt = self.runtime
+        attempt = self._attempts
+        self._attempts += 1
+        self.log.warn("restarting media plane", reason=reason,
+                      attempt=self._attempts, cap=self.max_restarts)
+        # Invalidate any in-flight device step FIRST: a stale step
+        # completing on the abandoned thread must not commit its state
+        # over the restore below.
+        rt.run_epoch += 1
+        await rt.stop()
+        # The old worker thread may be wedged inside the device call
+        # forever; hand the runtime a fresh executor and let the stale
+        # thread die with its daemon flag.
+        old = rt._executor
+        rt._executor = ThreadPoolExecutor(max_workers=1, thread_name_prefix="plane")
+        old.shutdown(wait=False)
+        if self.last_snapshot is not None:
+            async with rt.state_lock:
+                rt.restore(self.last_snapshot)
+        await asyncio.sleep(self.backoff.delay(attempt))
+        self._ticks_seen = rt.stats.get("ticks", 0)
+        self._baseline_ticks = self._ticks_seen
+        self._progress_at = time.monotonic()
+        rt.start()
+        self.restarts += 1
+        if self.telemetry is not None:
+            self.telemetry.add("livekit_plane_restarts_total")

@@ -37,6 +37,12 @@ from livekit_server_tpu.runtime.plane_runtime import TickResult
 from livekit_server_tpu.service.store import ObjectStore
 from livekit_server_tpu.utils import ids
 
+# Failover checkpoints outlive a node crash but not a forgotten room:
+# long enough for survivors to notice the lapsed lease (~lease_ttl) and
+# win the takeover race, short enough that a deliberately deleted room
+# cannot be resurrected much later from a stale row image.
+CHECKPOINT_TTL_S = 30.0
+
 
 class RoomManager:
     def __init__(
@@ -78,15 +84,53 @@ class RoomManager:
         # Media-wire key registry (the DTLS-SRTP key-exchange seat): one
         # AEAD session per participant, minted at join and delivered over
         # the authenticated signal channel.
-        from livekit_server_tpu.runtime.crypto import MediaCryptoRegistry
+        from livekit_server_tpu.runtime.crypto import HAVE_AEAD, MediaCryptoRegistry
 
-        self.crypto = MediaCryptoRegistry()
+        # No AEAD backend installed ⇒ run cleartext (room.py join path and
+        # the UDP transport both already branch on crypto being None).
+        self.crypto = MediaCryptoRegistry() if HAVE_AEAD else None
         from livekit_server_tpu.utils.logger import Logger
 
         self.log = Logger()  # server start replaces with a node-scoped one
         self.agents = None  # AgentService; room/publisher job dispatch
         self.runtime.on_tick(self._dispatch_tick)
         self._reaper_task: asyncio.Task | None = None
+        self._failover_task: asyncio.Task | None = None
+        # Serializes snapshot→publish in checkpoint_rooms: without it, a
+        # cadence-driven call that snapshotted, then yielded on the bus
+        # write, can land its STALE row over a fresher concurrent publish.
+        self._ckpt_lock = asyncio.Lock()
+        # Plane supervision: tick watchdog + restart-from-snapshot, with
+        # the per-room checkpoint publisher as its cadence callback.
+        self.supervisor = None
+        sup = config.supervisor
+        if sup.enabled:
+            from livekit_server_tpu.runtime.supervisor import PlaneSupervisor
+            from livekit_server_tpu.utils.backoff import BackoffPolicy
+
+            self.supervisor = PlaneSupervisor(
+                self.runtime,
+                tick_deadline_s=sup.tick_deadline_ms / 1000.0,
+                warmup_deadline_s=sup.warmup_deadline_s,
+                check_interval_s=sup.check_interval_ms / 1000.0,
+                checkpoint_interval_s=sup.checkpoint_interval_s,
+                max_restarts=sup.max_restarts,
+                backoff=BackoffPolicy(
+                    base=sup.restart_backoff_base_s, max_delay=sup.restart_backoff_max_s
+                ),
+                telemetry=telemetry,
+                log=self.log,
+            )
+            self.supervisor.room_checkpoint_cb = self.checkpoint_rooms
+        # Deterministic fault injection (chaos harness) — default-off; the
+        # injector only exists when config.faults.enabled is set.
+        self.fault = None
+        if config.faults.enabled:
+            from livekit_server_tpu.runtime.faultinject import FaultInjector
+
+            self.fault = FaultInjector.from_config(config.faults)
+            self.runtime.fault = self.fault
+            self.runtime.ingest.fault = self.fault
         router.on_new_session(self.start_session)
         self._update_node_stats()
 
@@ -145,6 +189,15 @@ class RoomManager:
             self._notify("room_finished", room=room.info.to_dict())
         await self.store.delete_room(name)
         await self.router.clear_room_state(name)
+        bus = getattr(self.router, "bus", None)
+        if bus is not None:
+            # A deliberate delete must also retire the failover checkpoint,
+            # or a same-name room created within CHECKPOINT_TTL_S would
+            # adopt the dead room's SN/TS lanes.
+            try:
+                await bus.delete(f"room_checkpoint:{name}")
+            except (ConnectionError, OSError):
+                pass
         self._update_node_stats()
 
     # -- session handling (roommanager.go StartSession) -------------------
@@ -361,23 +414,90 @@ class RoomManager:
 
     async def _maybe_restore_room(self, room: Room) -> None:
         """Adopt a migrated room's device state if a snapshot is waiting on
-        the bus (the receiving half of handoff_room)."""
+        the bus (the receiving half of handoff_room), falling back to the
+        latest failover checkpoint (the receiving half of
+        checkpoint_rooms) when no deliberate handoff is in flight."""
         bus = getattr(self.router, "bus", None)
         if bus is None:
             return
-        raw = await bus.get(f"room_snapshot:{room.name}")
+        key = f"room_snapshot:{room.name}"
+        raw = await bus.get(key)
+        if not raw:
+            key = f"room_checkpoint:{room.name}"
+            raw = await bus.get(key)
         if not raw:
             return
         try:
             snap = self.runtime.decode_room_snapshot(raw)
             async with self.runtime.state_lock:  # vs. the donated device step
                 self.runtime.restore_room(room.slots.row, snap)
-            self.log.info("room restored from migration snapshot", room=room.name)
+            self.log.info("room restored from snapshot", room=room.name, key=key)
         except Exception as e:  # noqa: BLE001 — a bad snapshot (version/
             # dims drift, corruption) must not poison room creation; the
             # room starts fresh instead (a stream reset, not an outage).
             self.log.warn("room snapshot rejected", room=room.name, error=str(e))
-        await bus.delete(f"room_snapshot:{room.name}")
+        await bus.delete(key)
+
+    # -- supervision & failover (tentpole of the supervised media plane) --
+    async def checkpoint_rooms(self) -> None:
+        """Publish every live room's row snapshot to the KV bus — the seed
+        a surviving node restores from if this node dies. Runs on the
+        PlaneSupervisor's checkpoint cadence."""
+        bus = getattr(self.router, "bus", None)
+        if bus is None:
+            return
+        async with self._ckpt_lock:
+            for name, room in list(self.rooms.items()):
+                row = room.slots.row
+                if row in self.runtime.ingest.frozen_rows:
+                    continue  # mid-handoff: handoff_room owns this row's snapshot
+                async with self.runtime.state_lock:  # vs. the donated device step
+                    snap = self.runtime.snapshot_room(row)
+                await bus.set(
+                    f"room_checkpoint:{name}",
+                    self.runtime.encode_room_snapshot(snap),
+                    CHECKPOINT_TTL_S,
+                )
+
+    async def _failover_worker(self) -> None:
+        """Scan for rooms pinned to dead nodes (lapsed liveness lease,
+        routing/router.py dead_room_pins) and adopt the ones we win the
+        takeover race for, restoring their media-plane rows from the dead
+        node's last checkpoint. Replaces the reference's join-triggered
+        takeover with a proactive one: rooms re-home within
+        ~lease_ttl + failover_interval even with no client knocking."""
+        interval = self.config.kv.failover_interval_s
+        while True:
+            await asyncio.sleep(interval)
+            try:
+                dead = await self.router.dead_room_pins()
+            except (ConnectionError, OSError):
+                continue  # bus outage: retry next interval
+            adopted_any = False
+            for name, dead_node in dead:
+                try:
+                    winner = await self.router.try_takeover(name, dead_node)
+                    if winner != self.router.local_node.node_id:
+                        continue  # another survivor won; it restores the room
+                    await self.get_or_create_room(name)
+                except (ConnectionError, OSError):
+                    continue
+                except CapacityError:
+                    # No free row here: release the pin so a survivor with
+                    # headroom can win the next scan's race.
+                    await self.router.clear_room_state(name)
+                    continue
+                adopted_any = True
+                self.log.info("room failed over", room=name, dead_node=dead_node[:12])
+                if self.telemetry is not None:
+                    self.telemetry.add("livekit_room_failovers_total")
+            if dead and hasattr(self.router, "remove_dead_nodes"):
+                try:
+                    await self.router.remove_dead_nodes()
+                except (ConnectionError, OSError):
+                    pass
+            if adopted_any:
+                self._update_node_stats()
 
     def handle_pli(self, row: int, track_col: int) -> None:
         """RTCP PLI from a UDP subscriber → keyframe request toward the
@@ -472,8 +592,14 @@ class RoomManager:
     # -- periodic reaping (server.go backgroundWorker) --------------------
     def start(self) -> None:
         self.runtime.start()
+        if self.supervisor is not None:
+            self.supervisor.start()
         if self._reaper_task is None:
             self._reaper_task = asyncio.ensure_future(self._reaper())
+        # Failover scan only makes sense with a shared bus to observe
+        # other nodes' leases (and to read their checkpoints from).
+        if self._failover_task is None and getattr(self.router, "bus", None) is not None:
+            self._failover_task = asyncio.ensure_future(self._failover_worker())
 
     async def _reaper(self) -> None:
         while True:
@@ -488,9 +614,13 @@ class RoomManager:
                     p.reap_stale_publications()
 
     async def stop(self) -> None:
-        if self._reaper_task is not None:
-            self._reaper_task.cancel()
-            self._reaper_task = None
+        if self.supervisor is not None:
+            await self.supervisor.stop()
+        for attr in ("_reaper_task", "_failover_task"):
+            task = getattr(self, attr)
+            if task is not None:
+                task.cancel()
+                setattr(self, attr, None)
         await self.runtime.stop()
         for name in list(self.rooms):
             await self.delete_room(name)

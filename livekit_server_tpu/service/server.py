@@ -183,6 +183,14 @@ class LivekitServer:
         return web.json_response(body)
 
     async def metrics(self, request: web.Request) -> web.Response:
+        # Recovery-machinery gauges sampled at scrape time: bus transport
+        # churn lives on the client object, plane restarts on the
+        # supervisor (livekit_plane_restarts_total / _room_failovers_total
+        # counters are emitted by their owners via telemetry.add).
+        bus = getattr(self.router, "bus", None)
+        if bus is not None and hasattr(bus, "retries"):
+            self.telemetry.set_gauge("livekit_bus_retries_total", bus.retries)
+            self.telemetry.set_gauge("livekit_bus_reconnects_total", bus.reconnects)
         return web.Response(
             text=self.telemetry.prometheus_text(), content_type="text/plain"
         )
@@ -262,8 +270,9 @@ class LivekitServer:
                 for room in self.room_manager.rooms.values():
                     room.udp = self.room_manager.udp
                 # TCP media fallback (transportmanager.go:73 ladder): same
-                # sealed frames, length-prefixed; always encrypted.
-                if self.config.rtc.tcp_port:
+                # sealed frames, length-prefixed; always encrypted — so it
+                # cannot exist on a node running without an AEAD backend.
+                if self.config.rtc.tcp_port and self.room_manager.crypto is not None:
                     from livekit_server_tpu.runtime.tcp import start_tcp_transport
 
                     try:
@@ -398,7 +407,7 @@ def create_server(config: Config, bus=None, mesh=None) -> LivekitServer:
         store = LocalStore()
     else:
         bus = bus if bus is not None else MemoryBus()
-        router = create_router(node, bus)
+        router = create_router(node, bus, lease_ttl=config.kv.lease_ttl_s)
         store = KVStore(bus)
     telemetry = TelemetryService(config)
     rm = RoomManager(config, router, store, mesh=mesh, telemetry=telemetry)

@@ -1,0 +1,135 @@
+"""Uniform retry/timeout/backoff policy for every re-dial path.
+
+One policy object replaces the ad-hoc sleep loops that used to live in
+each caller (tcpbus._reconnect's bare exponential, relay's one-shot
+bind): exponential backoff with full jitter (the AWS architecture-blog
+shape — deterministic under a seeded rng for chaos tests), an attempt
+cap, and a circuit breaker so a dependency that is hard-down stops
+consuming the caller's event loop with futile dials.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import random
+import time
+from dataclasses import dataclass
+from typing import Awaitable, Callable, TypeVar
+
+T = TypeVar("T")
+
+
+@dataclass(frozen=True)
+class BackoffPolicy:
+    """Exponential backoff with full jitter.
+
+    delay(n) ~ uniform(0, min(base * mult^n, max_delay)) — full jitter
+    decorrelates a fleet of clients re-dialing the same dead bus, where
+    the old deterministic ladder had every node land on the same beat.
+    """
+
+    base: float = 0.05
+    max_delay: float = 5.0
+    multiplier: float = 2.0
+    max_attempts: int = 0        # 0 = unbounded
+    jitter: bool = True
+
+    def delay(self, attempt: int, rng: random.Random | None = None) -> float:
+        cap = min(self.base * (self.multiplier ** attempt), self.max_delay)
+        if not self.jitter:
+            return cap
+        r = rng.random() if rng is not None else random.random()
+        # Floor at half the ceiling: pure full-jitter can draw ~0 and spin.
+        return cap * (0.5 + 0.5 * r)
+
+    def exhausted(self, attempt: int) -> bool:
+        return bool(self.max_attempts) and attempt >= self.max_attempts
+
+
+class CircuitBreaker:
+    """Failure-rate trip switch shared by retry loops.
+
+    closed → open after `threshold` consecutive failures; open rejects
+    instantly (no dial, no sleep) until `cooldown_s` elapses, then one
+    half-open probe is allowed through — success closes, failure re-opens.
+    """
+
+    def __init__(self, threshold: int = 8, cooldown_s: float = 10.0):
+        self.threshold = threshold
+        self.cooldown_s = cooldown_s
+        self.failures = 0
+        self.opened_at = 0.0
+        self.trips = 0
+
+    @property
+    def open(self) -> bool:
+        if self.failures < self.threshold:
+            return False
+        return (time.monotonic() - self.opened_at) < self.cooldown_s
+
+    def allow(self) -> bool:
+        """True if a call may proceed (closed, or half-open probe)."""
+        return not self.open
+
+    def record_success(self) -> None:
+        self.failures = 0
+
+    def record_failure(self) -> None:
+        self.failures += 1
+        if self.failures == self.threshold:
+            self.opened_at = time.monotonic()
+            self.trips += 1
+        elif self.failures > self.threshold:
+            # Half-open probe failed: restart the cooldown window.
+            self.opened_at = time.monotonic()
+
+
+class CircuitOpen(ConnectionError):
+    """Raised when the breaker rejects a call without attempting it."""
+
+
+async def retry_async(
+    fn: Callable[[], Awaitable[T]],
+    policy: BackoffPolicy,
+    *,
+    retry_on: tuple[type[BaseException], ...] = (ConnectionError, OSError),
+    timeout: float | None = None,
+    breaker: CircuitBreaker | None = None,
+    on_retry: Callable[[int, BaseException], None] | None = None,
+    rng: random.Random | None = None,
+) -> T:
+    """Run `fn` under the policy: per-attempt `timeout`, backoff between
+    attempts, breaker consulted before each. Raises the last error when
+    attempts are exhausted, or CircuitOpen when the breaker rejects."""
+    attempt = 0
+    while True:
+        if breaker is not None and not breaker.allow():
+            raise CircuitOpen("circuit breaker open")
+        try:
+            if timeout is not None:
+                result = await asyncio.wait_for(fn(), timeout)
+            else:
+                result = await fn()
+        except retry_on as e:  # noqa: PERF203 — retry loop by design
+            if breaker is not None:
+                breaker.record_failure()
+            if policy.exhausted(attempt + 1):
+                raise
+            if on_retry is not None:
+                on_retry(attempt, e)
+            await asyncio.sleep(policy.delay(attempt, rng))
+            attempt += 1
+            continue
+        except asyncio.TimeoutError:
+            if breaker is not None:
+                breaker.record_failure()
+            if policy.exhausted(attempt + 1):
+                raise
+            if on_retry is not None:
+                on_retry(attempt, asyncio.TimeoutError())
+            await asyncio.sleep(policy.delay(attempt, rng))
+            attempt += 1
+            continue
+        if breaker is not None:
+            breaker.record_success()
+        return result

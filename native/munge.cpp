@@ -41,6 +41,14 @@ extern "C" {
 // state mutation: a mid-walk bailout would leave the munger offsets
 // half-advanced, and the caller's fallback would then double-apply the
 // tick (state corruption on every walked lane).
+//
+// -2 is the invariant-violation code: the mid-walk overflow guard fired
+// AFTER mutation began (the pre-pass can only overcount — it includes
+// ghost bits at s >= S that the walk skips — so this should be
+// unreachable). It is distinct from -1 on purpose: -1 means "nothing
+// touched, fall back to the dense path", while -2 means "state already
+// half-advanced, a fallback would double-apply" — the Python wrapper
+// raises on it instead of falling back.
 int64_t munge_walk(
     int32_t R, int32_t T, int32_t K, int32_t S, int32_t W,
     const uint32_t* send_bits, const uint32_t* drop_bits,
@@ -163,7 +171,8 @@ int64_t munge_walk(
             if (fwd) st_v_started[i] = 1;
 
             if (fwd) {
-              if (n >= cap) return -1;
+              // Post-mutation guard: see -2 contract in the header comment.
+              if (n >= cap) return -2;
               out_rooms[n] = r;
               out_tracks[n] = t;
               out_ks[n] = k;

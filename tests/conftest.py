@@ -23,29 +23,18 @@ import jax  # noqa: E402
 
 jax.config.update("jax_platforms", "cpu")
 
-# Persistent compilation cache: the suite compiles the full media-plane
-# tick many times (sharded/unsharded/donated variants); identical
-# computations then hit the disk cache instead of recompiling. The dir is
-# keyed by the process's XLA/JAX environment fingerprint: XLA:CPU AOT
-# artifacts embed target-machine tuning flags, and loading an entry
-# compiled under a different configuration logs a machine-feature
-# mismatch and can abort outright.
-import hashlib  # noqa: E402
-
-_fp = hashlib.md5(
-    (
-        os.environ.get("XLA_FLAGS", "")
-        + "|" + os.environ.get("JAX_PLATFORMS", "")
-        + "|" + jax.__version__
-    ).encode()
-).hexdigest()[:10]
-jax.config.update(
-    "jax_compilation_cache_dir",
-    os.environ.get(
-        "JAX_COMPILATION_CACHE_DIR", f"/tmp/jax_cache_livekit_tpu_{_fp}"
-    ),
-)
-jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
+# The JAX persistent compilation cache is deliberately NOT enabled here.
+# It was, once, to amortize the media-plane tick's compile across runs —
+# and it produced the suite's nastiest flake family: on XLA:CPU, a cache
+# entry written by a clean PASSING run could deserialize into a silently
+# miscompiled executable on the next run. The bad executable scribbled
+# rate-like garbage into state.ctrl tensors (constants like max_spatial
+# read back as 163816.0) of rooms the test never touched; the cross-room
+# allocator reads those rows, so forwarding wedged for tens to hundreds
+# of ticks with bit-identical inputs, differently on every run. A cold
+# compile in each process is slower but correct. If someone re-enables
+# the cache (JAX_COMPILATION_CACHE_DIR), unexplained forwarding wedges
+# mean: delete the cache dir before debugging the model.
 
 # Minimal async-test support (pytest-asyncio isn't in this image): any
 # `async def test_*` runs under asyncio.run, `@pytest.mark.asyncio` or not.

@@ -458,3 +458,71 @@ def test_sub_reset_clears_per_sub_bwe_state():
     st, out = step(st, inp._replace(sub_reset=jnp.asarray([[True, False]])))
     assert float(st.delay_bwe.rate_bps[0, 0]) > 6_000_000.0
     assert not bool(st.delay_bwe.ever_fb[0, 0])
+
+
+async def test_watchdog_restarts_stalled_plane_from_snapshot():
+    """Supervision: a wedged device step (injected stall) trips the tick
+    watchdog; the supervisor abandons the stuck worker thread, restores
+    the last checkpoint, and the plane resumes ticking within the restart
+    budget — with munger state REWOUND to the snapshot (post-checkpoint
+    packets would be re-issued as duplicates, never skipped)."""
+    import asyncio
+
+    from livekit_server_tpu.runtime import (
+        FaultInjector,
+        PlaneRuntime,
+        PlaneSupervisor,
+    )
+    from livekit_server_tpu.runtime.faultinject import FaultSpec
+    from livekit_server_tpu.runtime.ingest import PacketIn
+    from livekit_server_tpu.utils.backoff import BackoffPolicy
+
+    dims = plane.PlaneDims(rooms=2, tracks=4, pkts=4, subs=4)
+    rt = PlaneRuntime(dims, tick_ms=10)
+    rt.set_track(0, 0, published=True, is_video=False)
+    rt.set_subscription(0, 0, 1, subscribed=True)
+    for i in range(3):
+        rt.ingest.push(PacketIn(room=0, track=0, sn=100 + i, ts=0,
+                                size=20, payload=b"x"))
+        await rt.step_once()
+
+    sup = PlaneSupervisor(
+        rt, tick_deadline_s=0.25, check_interval_s=0.02,
+        checkpoint_interval_s=60.0, max_restarts=5,
+        backoff=BackoffPolicy(base=0.02, max_delay=0.1),
+    )
+    await sup.checkpoint_now()
+    at_checkpoint = int(rt.munger.last_sn[0, 0, 1])
+    assert at_checkpoint == 102
+
+    # Advance PAST the checkpoint so the restore is observable as a
+    # rewind, not just "state unchanged".
+    for i in range(2):
+        rt.ingest.push(PacketIn(room=0, track=0, sn=103 + i, ts=0,
+                                size=20, payload=b"x"))
+        await rt.step_once()
+    assert int(rt.munger.last_sn[0, 0, 1]) > at_checkpoint
+
+    rt.fault = FaultInjector(FaultSpec(stall_every=1, stall_s=0.8))
+    rt.start()
+    sup.start()
+    try:
+        async def until(cond, timeout=30.0):
+            deadline = asyncio.get_running_loop().time() + timeout
+            while not cond():
+                assert asyncio.get_running_loop().time() < deadline, \
+                    "timed out waiting for supervisor"
+                await asyncio.sleep(0.01)
+
+        await until(lambda: sup.restarts >= 1)
+        stalls = rt.fault.stats.stalls
+        assert stalls >= 1
+        rt.fault = None  # the hang "clears"; the restarted plane runs clean
+        base = rt.stats["ticks"]
+        await until(lambda: rt.stats["ticks"] >= base + 5)
+        assert sup.restarts >= 1
+        assert not sup.gave_up
+        assert int(rt.munger.last_sn[0, 0, 1]) == at_checkpoint
+    finally:
+        await sup.stop()
+        await rt.stop()
