@@ -1,0 +1,50 @@
+"""graftcheck: AST-based invariant checker for the media plane.
+
+The single-jitted-tick design concentrates failure: one tracer impurity,
+donation misuse, lock-order inversion, or ad-hoc retry loop is a
+whole-plane defect, not a local one. The invariants the runtime relies
+on are all *statically visible*, so this package encodes them as AST
+analyzers wired into the tier-1 gate:
+
+  GC01 donation-safety — the donated device state (`PlaneRuntime.state`)
+       and its staging methods may only be touched under `state_lock`
+       (or from a function the config allowlists as lock-held).
+  GC02 tracer-purity — no host side effects (time, random, logging,
+       numpy materialization, threading, bus I/O) inside any function
+       reachable from a jax.jit / shard_map / pallas_call wrap site.
+  GC03 lock-discipline — the asyncio lock acquisition graph
+       (state_lock / _ckpt_lock / _create_locks) must be acyclic, and
+       no blocking sync call may run while an asyncio lock is held.
+  GC04 retry-policy — network dials/sends in routing/ and the media
+       relay must route through utils/backoff.retry_async; bare
+       while+sleep retry loops are findings.
+
+Suppressions: `# graftcheck: disable=GC01` on the finding's exact line
+(with a justification comment), `# graftcheck: disable-file=GC02` for a
+whole file, or a committed baseline for pre-existing findings — the
+baseline only shrinks (a stale entry fails the run).
+
+Entry point: `python -m tools.check` (see tools/check.py).
+"""
+
+from livekit_server_tpu.analysis.core import (
+    Config,
+    Finding,
+    Project,
+    diff_baseline,
+    load_baseline,
+    load_project,
+    run_all,
+    write_baseline,
+)
+
+__all__ = [
+    "Config",
+    "Finding",
+    "Project",
+    "diff_baseline",
+    "load_baseline",
+    "load_project",
+    "run_all",
+    "write_baseline",
+]

@@ -1,0 +1,355 @@
+"""graftcheck engine: finding model, source index, config, baseline.
+
+The engine is deliberately dependency-free (ast + stdlib only) so the
+checker can run in any environment the package imports in — including
+the tier-1 pytest gate, where tests/test_static_analysis.py runs the
+full suite over the real tree and asserts zero non-baselined findings.
+"""
+
+from __future__ import annotations
+
+import ast
+import fnmatch
+import json
+import re
+from dataclasses import dataclass, field
+from pathlib import Path
+from typing import Callable
+
+# -- finding model ----------------------------------------------------------
+
+RULES = ("GC01", "GC02", "GC03", "GC04")
+
+# Parse/config failures surface as findings too (rule GC00) so the runner
+# has one reporting path; compileall in tools/check.py catches the rest.
+PARSE_RULE = "GC00"
+
+
+@dataclass(frozen=True)
+class Finding:
+    rule: str
+    path: str          # repo-relative, forward slashes
+    line: int          # 1-based
+    message: str
+    hint: str = ""     # fix hint shown to the developer
+
+    def render(self) -> str:
+        s = f"{self.path}:{self.line}: {self.rule}: {self.message}"
+        if self.hint:
+            s += f"  [hint: {self.hint}]"
+        return s
+
+
+# -- source files + suppressions -------------------------------------------
+
+_DISABLE_RE = re.compile(r"#\s*graftcheck:\s*disable=([A-Z0-9,\s]+)")
+_DISABLE_FILE_RE = re.compile(r"#\s*graftcheck:\s*disable-file=([A-Z0-9,\s]+)")
+
+
+def _rule_list(raw: str) -> set[str]:
+    return {r.strip() for r in raw.split(",") if r.strip()}
+
+
+class SourceFile:
+    """One parsed module: AST + raw lines + suppression directives."""
+
+    def __init__(self, abspath: Path, rel: str, modname: str, text: str):
+        self.abspath = abspath
+        self.rel = rel
+        self.modname = modname
+        self.text = text
+        self.lines = text.splitlines()
+        self.parse_error: SyntaxError | None = None
+        try:
+            self.tree: ast.Module | None = ast.parse(text)
+        except SyntaxError as e:
+            self.tree = None
+            self.parse_error = e
+        # line (1-based) → rules disabled on exactly that line
+        self.line_disables: dict[int, set[str]] = {}
+        self.file_disables: set[str] = set()
+        for i, line in enumerate(self.lines, start=1):
+            if "graftcheck" not in line:
+                continue
+            m = _DISABLE_FILE_RE.search(line)
+            if m:
+                self.file_disables |= _rule_list(m.group(1))
+                continue
+            m = _DISABLE_RE.search(line)
+            if m:
+                self.line_disables.setdefault(i, set()).update(
+                    _rule_list(m.group(1))
+                )
+
+    def suppressed(self, rule: str, line: int) -> bool:
+        if rule in self.file_disables:
+            return True
+        return rule in self.line_disables.get(line, set())
+
+    def line_content(self, line: int) -> str:
+        if 1 <= line <= len(self.lines):
+            return self.lines[line - 1].strip()
+        return ""
+
+
+class Project:
+    """Every scanned module, indexed by relative path and module name."""
+
+    def __init__(self, root: Path, files: list[SourceFile]):
+        self.root = root
+        self.files = files
+        self.by_rel = {f.rel: f for f in files}
+        self.by_mod = {f.modname: f for f in files}
+        self._callgraph = None
+
+    def under(self, prefixes: list[str]) -> list[SourceFile]:
+        """Files whose relative path starts with any prefix (a prefix may
+        also name a single file exactly)."""
+        out = []
+        for f in self.files:
+            for p in prefixes:
+                p = p.rstrip("/")
+                if f.rel == p or f.rel.startswith(p + "/"):
+                    out.append(f)
+                    break
+        return out
+
+    @property
+    def callgraph(self):
+        if self._callgraph is None:
+            from livekit_server_tpu.analysis.callgraph import CallGraph
+
+            self._callgraph = CallGraph(self)
+        return self._callgraph
+
+
+def load_project(root: Path, paths: list[str]) -> Project:
+    root = Path(root)
+    files: list[SourceFile] = []
+    seen: set[str] = set()
+    for p in paths:
+        base = root / p
+        candidates = [base] if base.is_file() else sorted(base.rglob("*.py"))
+        for f in candidates:
+            rel = f.relative_to(root).as_posix()
+            if rel in seen:
+                continue
+            seen.add(rel)
+            modname = rel[:-3].replace("/", ".")
+            if modname.endswith(".__init__"):
+                modname = modname[: -len(".__init__")]
+            files.append(SourceFile(f, rel, modname, f.read_text()))
+    return Project(root, files)
+
+
+# -- config -----------------------------------------------------------------
+
+DEFAULT_CONFIG: dict = {
+    "paths": ["livekit_server_tpu"],
+    "baseline": "tools/graftcheck_baseline.json",
+    "gc01": {
+        "paths": ["livekit_server_tpu/runtime", "livekit_server_tpu/service"],
+        # self.state is guarded inside these classes — plus any class whose
+        # body mentions a guarded lock (a class carrying the donation lock
+        # must use it).
+        "state_classes": ["PlaneRuntime"],
+        # attribute tails that denote a PlaneRuntime held by another object
+        # (self.runtime.state, rt.state, ...)
+        "runtime_names": ["runtime", "rt"],
+        # methods that touch the donated state on behalf of the caller —
+        # calling one requires the lock exactly like touching state does
+        "state_methods": [
+            "snapshot", "snapshot_room", "restore", "restore_room",
+            "_upload_ctrl", "_stage", "_device_step",
+        ],
+        "lock_names": ["state_lock"],
+        # lock-held-by-contract: bodies may touch state because every
+        # caller holds state_lock (enforced via the state_methods check)
+        "lock_held": [
+            "PlaneRuntime.__init__",
+            "PlaneRuntime._upload_ctrl",
+            "PlaneRuntime._stage",
+            "PlaneRuntime._device_step",
+            "PlaneRuntime.snapshot",
+            "PlaneRuntime.snapshot_room",
+            "PlaneRuntime.restore",
+            "PlaneRuntime.restore_room",
+        ],
+    },
+    "gc02": {
+        "paths": ["livekit_server_tpu"],
+        # extra jit roots by qualified name when the wrap site is dynamic
+        "extra_roots": [],
+        "banned_prefixes": [
+            "time.", "random.", "numpy.random.", "threading.", "socket.",
+            "logging.", "asyncio.", "subprocess.", "os.path.",
+        ],
+        "banned_exact": [
+            "print", "open", "numpy.asarray", "numpy.array",
+            "numpy.save", "numpy.load", "input",
+        ],
+        "banned_methods": ["item", "tolist", "block_until_ready"],
+        # attribute segment that marks structured-logging / bus receivers:
+        # self.log.warn(...), log.info(...), bus.publish(...)
+        "banned_receivers": ["log", "logger", "bus"],
+    },
+    "gc03": {
+        "paths": ["livekit_server_tpu"],
+        "lock_names": ["state_lock", "_ckpt_lock", "_create_locks"],
+        "blocking_calls": [
+            "time.sleep", "socket.create_connection", "os.system",
+            "subprocess.run", "subprocess.call", "subprocess.check_output",
+            "requests.", "urllib.request.",
+        ],
+    },
+    "gc04": {
+        "paths": [
+            "livekit_server_tpu/routing",
+            "livekit_server_tpu/runtime/relay.py",
+        ],
+        "net_errors": [
+            "ConnectionError", "ConnectionResetError", "ConnectionRefusedError",
+            "BrokenPipeError", "OSError", "TimeoutError", "IncompleteReadError",
+            "socket.error", "asyncio.TimeoutError", "asyncio.IncompleteReadError",
+        ],
+        "dial_calls": [
+            "asyncio.open_connection", "open_connection",
+            "create_datagram_endpoint", "create_connection",
+        ],
+        "retry_helpers": ["retry_async", "CircuitBreaker"],
+    },
+}
+
+
+@dataclass
+class Config:
+    root: Path
+    paths: list[str] = field(default_factory=lambda: ["livekit_server_tpu"])
+    baseline: str = "tools/graftcheck_baseline.json"
+    rules: dict = field(default_factory=dict)
+
+    def rule(self, name: str) -> dict:
+        """Per-rule table: defaults overlaid with pyproject overrides."""
+        merged = dict(DEFAULT_CONFIG.get(name, {}))
+        merged.update(self.rules.get(name, {}))
+        return merged
+
+
+def load_config(root: Path) -> Config:
+    """[tool.graftcheck] from pyproject.toml over the built-in defaults."""
+    raw: dict = {}
+    pyproject = Path(root) / "pyproject.toml"
+    if pyproject.exists():
+        try:
+            import tomllib  # py311+
+        except ImportError:
+            import tomli as tomllib  # this image ships tomli on 3.10
+        raw = (
+            tomllib.loads(pyproject.read_text())
+            .get("tool", {})
+            .get("graftcheck", {})
+        )
+    cfg = Config(root=Path(root))
+    cfg.paths = raw.get("paths", DEFAULT_CONFIG["paths"])
+    cfg.baseline = raw.get("baseline", DEFAULT_CONFIG["baseline"])
+    cfg.rules = {k: v for k, v in raw.items() if isinstance(v, dict)}
+    return cfg
+
+
+def qual_allowed(qual: str, patterns: list[str]) -> bool:
+    """fnmatch a function qualname (`Class.method` / `outer.inner`)
+    against the config allowlist."""
+    return any(fnmatch.fnmatchcase(qual, pat) for pat in patterns)
+
+
+# -- engine -----------------------------------------------------------------
+
+def run_all(
+    project: Project, config: Config, rules: list[str] | None = None
+) -> list[Finding]:
+    """Run the analyzers, apply per-line/file suppressions, sort."""
+    from livekit_server_tpu.analysis import gc01, gc02, gc03, gc04
+
+    impls: dict[str, Callable[[Project, dict], list[Finding]]] = {
+        "GC01": gc01.run,
+        "GC02": gc02.run,
+        "GC03": gc03.run,
+        "GC04": gc04.run,
+    }
+    findings: list[Finding] = []
+    for f in project.files:
+        if f.parse_error is not None:
+            findings.append(
+                Finding(
+                    PARSE_RULE, f.rel, f.parse_error.lineno or 0,
+                    f"syntax error: {f.parse_error.msg}",
+                )
+            )
+    for rule in rules or list(impls):
+        findings.extend(impls[rule](project, config.rule(rule.lower())))
+    kept = []
+    for fd in findings:
+        sf = project.by_rel.get(fd.path)
+        if sf is not None and sf.suppressed(fd.rule, fd.line):
+            continue
+        kept.append(fd)
+    kept.sort(key=lambda fd: (fd.path, fd.line, fd.rule, fd.message))
+    return kept
+
+
+# -- baseline ---------------------------------------------------------------
+#
+# Entries key on (rule, path, stripped line content) rather than line
+# numbers, so unrelated edits above a baselined finding don't churn the
+# file. Identical lines are disambiguated by an occurrence counter.
+
+def _baseline_key(fd: Finding, project: Project) -> tuple[str, str, str]:
+    sf = project.by_rel.get(fd.path)
+    content = sf.line_content(fd.line) if sf is not None else ""
+    return (fd.rule, fd.path, content)
+
+
+def load_baseline(path: Path) -> list[dict]:
+    p = Path(path)
+    if not p.exists():
+        return []
+    data = json.loads(p.read_text())
+    return data.get("findings", [])
+
+
+def write_baseline(path: Path, findings: list[Finding], project: Project) -> None:
+    entries = [
+        {"rule": r, "path": p, "content": c}
+        for (r, p, c) in sorted(_baseline_key(fd, project) for fd in findings)
+    ]
+    Path(path).write_text(
+        json.dumps({"version": 1, "findings": entries}, indent=1) + "\n"
+    )
+
+
+def diff_baseline(
+    findings: list[Finding], baseline: list[dict], project: Project
+) -> tuple[list[Finding], list[dict]]:
+    """→ (new findings not covered by the baseline, stale baseline entries
+    whose finding no longer exists). Stale entries FAIL the run: the
+    baseline may only shrink, never silently rot."""
+    from collections import Counter
+
+    have = Counter(
+        (e.get("rule", ""), e.get("path", ""), e.get("content", ""))
+        for e in baseline
+    )
+    new: list[Finding] = []
+    for fd in findings:
+        key = _baseline_key(fd, project)
+        if have.get(key, 0) > 0:
+            have[key] -= 1
+        else:
+            new.append(fd)
+    stale = [
+        {"rule": r, "path": p, "content": c}
+        for (r, p, c), n in have.items()
+        for _ in range(n)
+        if n > 0
+    ]
+    return new, stale

@@ -1,0 +1,147 @@
+"""GC02 — tracer purity.
+
+Functions traced by `jax.jit` / `shard_map` / `pl.pallas_call` execute
+once at trace time and then replay as compiled XLA: host side effects
+inside them (wall clocks, RNG, numpy materialization, logging, bus I/O,
+threading) silently freeze into the graph or fire at trace time only.
+This rule walks the wrapper call graph from every wrap site — including
+the nested-closure shapes the runtime uses (`_build_step`'s `tick`,
+mesh.make_sharded_tick's `shard_map` + `jit` rebinding, partial-wrapped
+Pallas kernels, `@functools.partial(jax.jit, ...)` decorators) — and
+flags banned calls anywhere in the reachable set. Everything lexically
+inside a traced function (lambdas, nested defs) is traced with it, so
+nested bodies are scanned too.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from livekit_server_tpu.analysis.callgraph import (
+    FuncInfo,
+    body_calls,
+    dotted_name,
+    local_assignments,
+)
+from livekit_server_tpu.analysis.core import Finding, Project
+
+_WRAPPERS = {"jit", "shard_map", "pallas_call"}
+
+
+def _wrapper_tail(expr: ast.AST, cg, modname: str) -> str | None:
+    dotted = dotted_name(expr)
+    if dotted is None:
+        return None
+    tail = cg.expand_alias(dotted, modname).rsplit(".", 1)[-1]
+    return tail if tail in _WRAPPERS else None
+
+
+def _roots(project: Project, cfg: dict) -> list[tuple[FuncInfo, str]]:
+    """(traced function, wrap-site description) for every wrap site."""
+    cg = project.callgraph
+    roots: list[tuple[FuncInfo, str]] = []
+
+    def try_root(expr: ast.AST, scope, sf, assigns, site: str) -> None:
+        target = cg.resolve(expr, scope, sf, assigns)
+        if target is not None:
+            roots.append((target, site))
+
+    for sf in project.under(cfg["paths"]):
+        if sf.tree is None:
+            continue
+        for (mod, qual), fi in cg.funcs.items():
+            if mod != sf.modname:
+                continue
+            assigns = local_assignments(fi.node)
+            # decorator roots: @jax.jit / @functools.partial(jax.jit, ...)
+            for dec in getattr(fi.node, "decorator_list", []):
+                wrapped = None
+                if _wrapper_tail(dec, cg, sf.modname):
+                    wrapped = fi
+                elif isinstance(dec, ast.Call):
+                    inner = dec.args[0] if dec.args else None
+                    if _wrapper_tail(dec.func, cg, sf.modname) or (
+                        inner is not None
+                        and _wrapper_tail(inner, cg, sf.modname)
+                    ):
+                        wrapped = fi
+                if wrapped is not None:
+                    site = f"{sf.rel}:{fi.node.lineno} (@decorator)"
+                    roots.append((wrapped, site))
+            # call roots inside this function: jit(f) / shard_map(f, ...)
+            for call in body_calls(fi.node):
+                if _wrapper_tail(call.func, cg, sf.modname) and call.args:
+                    site = f"{sf.rel}:{call.lineno}"
+                    try_root(call.args[0], fi, sf, assigns, site)
+        # module-level wrap sites
+        for stmt in sf.tree.body:
+            if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                 ast.ClassDef)):
+                continue
+            for call in ast.walk(stmt):
+                if isinstance(call, ast.Call) and \
+                        _wrapper_tail(call.func, cg, sf.modname) and call.args:
+                    try_root(call.args[0], None, sf,
+                             None, f"{sf.rel}:{call.lineno}")
+    for qual in cfg.get("extra_roots", []):
+        mod, _, name = qual.rpartition(".")
+        fi = cg.funcs.get((mod, name))
+        if fi is not None:
+            roots.append((fi, f"extra_roots:{qual}"))
+    return roots
+
+
+def _banned(call: ast.Call, cg, modname: str, cfg: dict) -> str | None:
+    """Reason string when this call is impure in traced code."""
+    dotted = dotted_name(call.func)
+    if dotted is not None:
+        full = cg.expand_alias(dotted, modname)
+        if full in cfg["banned_exact"]:
+            return f"`{dotted}` materializes host state"
+        for p in cfg["banned_prefixes"]:
+            if full.startswith(p):
+                return f"`{dotted}` is host-side ({p}*)"
+        parts = dotted.split(".")
+        for seg in parts[:-1]:
+            if seg in cfg["banned_receivers"]:
+                return f"`{dotted}` is logging/bus I/O"
+    if isinstance(call.func, ast.Attribute) and \
+            call.func.attr in cfg["banned_methods"]:
+        return f"`.{call.func.attr}()` forces a host sync"
+    return None
+
+
+def run(project: Project, cfg: dict) -> list[Finding]:
+    cg = project.callgraph
+    findings: list[Finding] = []
+    seen_funcs: set[int] = set()
+    seen_sites: set[tuple[str, int, str]] = set()
+    queue = _roots(project, cfg)
+    while queue:
+        fi, site = queue.pop()
+        if id(fi) in seen_funcs:
+            continue
+        seen_funcs.add(id(fi))
+        sf = fi.module
+        assigns = local_assignments(fi.node)
+        # everything lexically inside a traced function is traced with it
+        for call in body_calls(fi.node, include_nested=True):
+            why = _banned(call, cg, sf.modname, cfg)
+            if why is not None:
+                key = (sf.rel, call.lineno, why)
+                if key not in seen_sites:
+                    seen_sites.add(key)
+                    findings.append(
+                        Finding(
+                            "GC02", sf.rel, call.lineno,
+                            f"{why} inside `{fi.qual}`, which is traced "
+                            f"(jit/shard_map/pallas wrap at {site})",
+                            hint="hoist the host effect out of the traced "
+                            "function; pass results in as arguments",
+                        )
+                    )
+                continue
+            callee = cg.resolve(call.func, fi, sf, assigns)
+            if callee is not None:
+                queue.append((callee, site))
+    return findings

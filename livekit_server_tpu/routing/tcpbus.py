@@ -34,6 +34,7 @@ from livekit_server_tpu.routing.kv import MemoryBus, Subscription
 from livekit_server_tpu.utils.backoff import (
     BackoffPolicy,
     CircuitBreaker,
+    RetryAborted,
     retry_async,
 )
 
@@ -206,7 +207,10 @@ class TCPBusClient:
 
     @classmethod
     async def connect(cls, host: str, port: int, token: str = "") -> "TCPBusClient":
-        reader, writer = await asyncio.open_connection(host, port)
+        # Initial dial fails fast by design — the caller decides whether a
+        # reachable bus is a boot requirement; only the established client
+        # owns the reconnect policy.
+        reader, writer = await asyncio.open_connection(host, port)  # graftcheck: disable=GC04
         client = cls(reader, writer, host=host, port=port, token=token)
         if token:
             await client._call("auth", token)
@@ -257,44 +261,44 @@ class TCPBusClient:
                 return
 
     async def _reconnect(self) -> bool:
-        """Dial until the bus answers (jittered backoff, breaker-capped
-        dial rate), then re-auth and re-subscribe every live channel.
+        """Dial until the bus answers (retry_async: jittered backoff,
+        breaker-capped dial rate — one probe per cooldown against a
+        hard-down bus), then re-auth and re-subscribe every live channel.
         Returns False only on close()."""
-        attempt = 0
-        while not self.closed:
-            if not self._dial_breaker.allow():
-                # Open breaker: one probe per cooldown instead of a dial
-                # per backoff step against a hard-down bus.
-                await asyncio.sleep(self._dial_breaker.cooldown_s)
-                continue
+
+        async def dial() -> None:
+            reader, writer = await asyncio.open_connection(self._host, self._port)
             try:
-                reader, writer = await asyncio.open_connection(self._host, self._port)
-                try:
-                    self._writer.close()   # old transport: no fd leak
-                except Exception:  # noqa: BLE001 — already torn down
-                    pass
-                self._reader, self._writer = reader, writer
-                # Mark live BEFORE re-issuing auth/subs: they go through
-                # _send, which fails fast while disconnected.
-                self._connected = True
-                if self._token:
-                    # _send writes on the NEW connection; the response is
-                    # read by the outer loop after we return.
-                    self._send("auth", self._token).add_done_callback(
-                        lambda f: f.exception()
-                    )
-                for channel in self._subs:
-                    self._send("sub", channel).add_done_callback(
-                        lambda f: f.exception()
-                    )
-                self.reconnects += 1
-                self._dial_breaker.record_success()
-                return True
-            except OSError:
-                self._dial_breaker.record_failure()
-                await asyncio.sleep(self._dial_backoff.delay(attempt))
-                attempt += 1
-        return False
+                self._writer.close()   # old transport: no fd leak
+            except Exception:  # noqa: BLE001 — already torn down
+                pass
+            self._reader, self._writer = reader, writer
+            # Mark live BEFORE re-issuing auth/subs: they go through
+            # _send, which fails fast while disconnected.
+            self._connected = True
+            if self._token:
+                # _send writes on the NEW connection; the response is
+                # read by the outer loop after we return.
+                self._send("auth", self._token).add_done_callback(
+                    lambda f: f.exception()
+                )
+            for channel in self._subs:
+                self._send("sub", channel).add_done_callback(
+                    lambda f: f.exception()
+                )
+            self.reconnects += 1
+
+        try:
+            await retry_async(
+                dial, self._dial_backoff,
+                retry_on=(OSError,),
+                breaker=self._dial_breaker,
+                wait_when_open=True,
+                should_abort=lambda: self.closed,
+            )
+        except RetryAborted:
+            return False
+        return True
 
     def _send(self, op: str, *args) -> asyncio.Future:
         if self.closed or not self._connected:

@@ -364,7 +364,9 @@ async def start_media_relay(
     max_allocations: int = 4096,
 ) -> MediaRelay:
     loop = asyncio.get_running_loop()
-    _, proto = await loop.create_datagram_endpoint(
+    # Listen-side bind, not a dial: a taken port is a config error that
+    # should fail loudly at startup, not be retried into.
+    _, proto = await loop.create_datagram_endpoint(  # graftcheck: disable=GC04
         lambda: MediaRelay(upstream_addr, secret, ttl_s, max_allocations),
         local_addr=(host, port),
     )

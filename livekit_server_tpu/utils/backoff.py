@@ -88,6 +88,20 @@ class CircuitOpen(ConnectionError):
     """Raised when the breaker rejects a call without attempting it."""
 
 
+class RetryAborted(Exception):
+    """Raised when `should_abort` turns true between attempts (e.g. the
+    owning client was closed while its reconnect loop slept)."""
+
+
+def _default_give_up(attempts: int, err: BaseException) -> None:
+    from livekit_server_tpu.utils.logger import log
+
+    log.warn(
+        "retry_async giving up",
+        attempts=attempts, error=type(err).__name__, detail=str(err),
+    )
+
+
 async def retry_async(
     fn: Callable[[], Awaitable[T]],
     policy: BackoffPolicy,
@@ -96,37 +110,44 @@ async def retry_async(
     timeout: float | None = None,
     breaker: CircuitBreaker | None = None,
     on_retry: Callable[[int, BaseException], None] | None = None,
+    on_give_up: Callable[[int, BaseException], None] | None = None,
+    wait_when_open: bool = False,
+    should_abort: Callable[[], bool] | None = None,
     rng: random.Random | None = None,
 ) -> T:
     """Run `fn` under the policy: per-attempt `timeout`, backoff between
     attempts, breaker consulted before each. Raises the last error when
-    attempts are exhausted, or CircuitOpen when the breaker rejects."""
+    attempts are exhausted, or CircuitOpen when the breaker rejects.
+
+    `on_give_up(attempts, err)` fires once, just before the final raise
+    at exhaustion (default: logs the attempt count — a silent give-up
+    looks identical to a hang from the caller's side). `wait_when_open`
+    turns a breaker rejection into a cooldown sleep instead of
+    CircuitOpen — the shape a persistent reconnect loop wants.
+    `should_abort` is polled before each attempt; True raises
+    RetryAborted (e.g. the owning client was closed mid-backoff)."""
     attempt = 0
     while True:
+        if should_abort is not None and should_abort():
+            raise RetryAborted("aborted between retry attempts")
         if breaker is not None and not breaker.allow():
-            raise CircuitOpen("circuit breaker open")
+            if not wait_when_open:
+                raise CircuitOpen("circuit breaker open")
+            await asyncio.sleep(breaker.cooldown_s)
+            continue
         try:
             if timeout is not None:
                 result = await asyncio.wait_for(fn(), timeout)
             else:
                 result = await fn()
-        except retry_on as e:  # noqa: PERF203 — retry loop by design
+        except retry_on + (asyncio.TimeoutError,) as e:  # noqa: PERF203
             if breaker is not None:
                 breaker.record_failure()
             if policy.exhausted(attempt + 1):
+                (on_give_up or _default_give_up)(attempt + 1, e)
                 raise
             if on_retry is not None:
                 on_retry(attempt, e)
-            await asyncio.sleep(policy.delay(attempt, rng))
-            attempt += 1
-            continue
-        except asyncio.TimeoutError:
-            if breaker is not None:
-                breaker.record_failure()
-            if policy.exhausted(attempt + 1):
-                raise
-            if on_retry is not None:
-                on_retry(attempt, asyncio.TimeoutError())
             await asyncio.sleep(policy.delay(attempt, rng))
             attempt += 1
             continue

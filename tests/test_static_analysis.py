@@ -1,0 +1,444 @@
+"""graftcheck: analyzer unit tests on fixtures + the real-tree gate.
+
+Each rule is exercised on minimal good/bad fixture modules written to a
+temp dir; the final tests run the full suite over the actual repo tree
+(zero non-baselined findings — this is the tier-1 wiring) and unit-test
+the retry_async extensions the GC04 migration leans on.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import textwrap
+from pathlib import Path
+
+import pytest
+
+from livekit_server_tpu.analysis import (
+    core,
+    gc01,
+    gc02,
+    gc03,
+    gc04,
+    diff_baseline,
+    load_project,
+    run_all,
+    write_baseline,
+)
+from livekit_server_tpu.utils.backoff import (
+    BackoffPolicy,
+    CircuitBreaker,
+    RetryAborted,
+    retry_async,
+)
+
+REPO_ROOT = Path(__file__).resolve().parent.parent
+
+
+def make_project(tmp_path, files: dict[str, str]):
+    for rel, src in files.items():
+        p = tmp_path / rel
+        p.parent.mkdir(parents=True, exist_ok=True)
+        p.write_text(textwrap.dedent(src))
+    return load_project(tmp_path, ["pkg"])
+
+
+def cfg_for(rule: str, **overrides) -> dict:
+    merged = dict(core.DEFAULT_CONFIG[rule])
+    merged["paths"] = ["pkg"]
+    merged.update(overrides)
+    return merged
+
+
+def lines_of(findings, rule):
+    return sorted(f.line for f in findings if f.rule == rule)
+
+
+# -- GC01 donation safety ---------------------------------------------------
+
+GC01_FIXTURE = """\
+    import asyncio
+
+    class PlaneRuntime:
+        def __init__(self):
+            self.state = object()
+            self.state_lock = asyncio.Lock()
+
+        async def good(self):
+            async with self.state_lock:
+                self.state = self.state
+
+        async def good_region(self):
+            await self.state_lock.acquire()
+            try:
+                self.state = self.state
+            finally:
+                self.state_lock.release()
+
+        async def bad(self):
+            self.state = None            # line 20: lockless donated write
+
+        async def bad_after_release(self):
+            await self.state_lock.acquire()
+            self.state_lock.release()
+            x = self.state               # line 25: read after release
+
+    class Manager:
+        def __init__(self, runtime):
+            self.runtime = runtime
+
+        async def good(self):
+            async with self.runtime.state_lock:
+                return self.runtime.snapshot()
+
+        async def bad(self):
+            return self.runtime.snapshot()   # line 36: lockless state method
+"""
+
+
+def test_gc01_fixture(tmp_path):
+    project = make_project(tmp_path, {"pkg/rt.py": GC01_FIXTURE})
+    cfg = cfg_for("gc01", lock_held=["PlaneRuntime.__init__"])
+    findings = gc01.run(project, cfg)
+    assert all(f.rule == "GC01" for f in findings)
+    assert lines_of(findings, "GC01") == [20, 25, 36]
+
+
+def test_gc01_lock_held_allowlist(tmp_path):
+    project = make_project(tmp_path, {"pkg/rt.py": GC01_FIXTURE})
+    cfg = cfg_for(
+        "gc01",
+        lock_held=["PlaneRuntime.__init__", "PlaneRuntime.bad*",
+                   "Manager.bad"],
+    )
+    assert gc01.run(project, cfg) == []
+
+
+# -- GC02 tracer purity -----------------------------------------------------
+
+GC02_FIXTURE = """\
+    import time
+    import jax
+    import numpy as np
+
+    def helper(x):
+        return time.time() + x       # line 6: reachable from tick
+
+    def host_side():
+        return np.asarray(time.time())   # host: NOT reachable, no finding
+
+    def build():
+        def tick(state):
+            t = time.time()          # line 13
+            a = np.asarray(state)    # line 14
+            return helper(t) + a
+        return jax.jit(tick, donate_argnums=(0,))
+"""
+
+
+def test_gc02_nested_jit_closure(tmp_path):
+    project = make_project(tmp_path, {"pkg/ops.py": GC02_FIXTURE})
+    findings = gc02.run(project, cfg_for("gc02"))
+    assert lines_of(findings, "GC02") == [6, 13, 14]
+
+
+def test_gc02_rebound_shard_map_and_decorator(tmp_path):
+    src = """\
+        import functools
+        import jax
+        from jax.experimental.shard_map import shard_map as _shard_map
+
+        @functools.partial(jax.jit, static_argnames=("k",))
+        def mix(x, k):
+            print(x)                 # line 7
+            return x
+
+        def make(mesh):
+            def tick(state):
+                import time
+                return state, time.perf_counter()   # line 13
+            smapped = _shard_map(tick, mesh=mesh)
+            return jax.jit(smapped, donate_argnums=(0,))
+    """
+    project = make_project(tmp_path, {"pkg/mesh.py": src})
+    findings = gc02.run(project, cfg_for("gc02"))
+    assert lines_of(findings, "GC02") == [7, 13]
+
+
+# -- GC03 lock discipline ---------------------------------------------------
+
+GC03_FIXTURE = """\
+    import asyncio
+    import time
+
+    class M:
+        def __init__(self):
+            self.a_lock = asyncio.Lock()
+            self.b_lock = asyncio.Lock()
+
+        async def ab(self):
+            async with self.a_lock:
+                async with self.b_lock:   # edge a -> b
+                    pass
+
+        async def ba(self):
+            async with self.b_lock:
+                async with self.a_lock:   # line 16: closes the cycle
+                    pass
+
+        async def blocker(self):
+            async with self.a_lock:
+                time.sleep(1)             # line 21: blocks the loop
+
+        async def reenter(self):
+            async with self.a_lock:
+                async with self.a_lock:   # line 25: not reentrant
+                    pass
+"""
+
+
+def test_gc03_cycle_blocking_and_reentry(tmp_path):
+    project = make_project(tmp_path, {"pkg/locks.py": GC03_FIXTURE})
+    cfg = cfg_for("gc03", lock_names=["a_lock", "b_lock"])
+    findings = gc03.run(project, cfg)
+    msgs = [f.message for f in findings]
+    assert any("lock-order cycle" in m for m in msgs)
+    assert any("blocking call `time.sleep`" in m for m in msgs)
+    assert any("re-acquisition of `a_lock`" in m for m in msgs)
+
+
+def test_gc03_interprocedural_reacquire(tmp_path):
+    src = """\
+        import asyncio
+
+        class M:
+            def __init__(self):
+                self.state_lock = asyncio.Lock()
+
+            async def inner(self):
+                async with self.state_lock:
+                    pass
+
+            async def outer(self):
+                async with self.state_lock:
+                    await self.inner()    # deadlock through the call
+    """
+    project = make_project(tmp_path, {"pkg/m.py": src})
+    cfg = cfg_for("gc03", lock_names=["state_lock"])
+    findings = gc03.run(project, cfg)
+    assert any("call into `M.inner`" in f.message for f in findings)
+
+
+# -- GC04 retry policy ------------------------------------------------------
+
+GC04_BAD = """\
+    import asyncio
+
+    class C:
+        async def reconnect(self):
+            while True:                  # line 5: ad-hoc retry loop
+                try:
+                    r, w = await asyncio.open_connection("h", 1)  # line 7
+                    return r, w
+                except OSError:
+                    await asyncio.sleep(0.1)
+"""
+
+GC04_GOOD = """\
+    import asyncio
+    from livekit_server_tpu.utils.backoff import retry_async
+
+    class C:
+        async def reconnect(self, policy):
+            async def dial():
+                return await asyncio.open_connection("h", 1)
+            return await retry_async(dial, policy, retry_on=(OSError,))
+"""
+
+
+def test_gc04_bare_retry_loop(tmp_path):
+    project = make_project(tmp_path, {"pkg/bus.py": GC04_BAD})
+    findings = gc04.run(project, cfg_for("gc04"))
+    assert lines_of(findings, "GC04") == [5, 7]
+
+
+def test_gc04_retry_async_managed(tmp_path):
+    project = make_project(tmp_path, {"pkg/bus.py": GC04_GOOD})
+    assert gc04.run(project, cfg_for("gc04")) == []
+
+
+# -- suppressions -----------------------------------------------------------
+
+def run_all_pkg(project):
+    config = core.Config(root=project.root, paths=["pkg"])
+    config.rules = {r.lower(): {"paths": ["pkg"]} for r in core.RULES}
+    return run_all(project, config)
+
+
+def test_exact_line_disable(tmp_path):
+    bad = GC04_BAD.replace(
+        'await asyncio.open_connection("h", 1)  # line 7',
+        'await asyncio.open_connection("h", 1)  # graftcheck: disable=GC04',
+    ).replace(
+        "while True:                  # line 5: ad-hoc retry loop",
+        "while True:  # graftcheck: disable=GC04",
+    )
+    project = make_project(tmp_path, {"pkg/bus.py": bad})
+    assert run_all_pkg(project) == []
+
+
+def test_disable_is_rule_specific(tmp_path):
+    bad = GC04_BAD.replace(
+        'await asyncio.open_connection("h", 1)  # line 7',
+        'await asyncio.open_connection("h", 1)  # graftcheck: disable=GC01',
+    )
+    project = make_project(tmp_path, {"pkg/bus.py": bad})
+    # wrong rule id on the dial line: both GC04 findings survive
+    assert lines_of(run_all_pkg(project), "GC04") == [5, 7]
+
+
+def test_file_level_disable(tmp_path):
+    bad = "# graftcheck: disable-file=GC04\n" + textwrap.dedent(GC04_BAD)
+    project = make_project(tmp_path, {"pkg/bus.py": bad})
+    assert run_all_pkg(project) == []
+
+
+def test_parse_error_is_a_finding(tmp_path):
+    project = make_project(tmp_path, {"pkg/broken.py": "def f(:\n"})
+    findings = run_all_pkg(project)
+    assert [f.rule for f in findings] == [core.PARSE_RULE]
+
+
+# -- baseline ---------------------------------------------------------------
+
+def test_baseline_roundtrip_and_stale(tmp_path):
+    project = make_project(tmp_path, {"pkg/bus.py": GC04_BAD})
+    findings = run_all_pkg(project)
+    assert findings
+    bl_path = tmp_path / "baseline.json"
+    write_baseline(bl_path, findings, project)
+    baseline = core.load_baseline(bl_path)
+
+    # same tree: fully covered, nothing stale
+    new, stale = diff_baseline(findings, baseline, project)
+    assert new == [] and stale == []
+
+    # one finding fixed: its entry is now stale — the run must fail so
+    # the baseline only ever shrinks
+    new, stale = diff_baseline(findings[1:], baseline, project)
+    assert new == [] and len(stale) == 1
+
+    # a brand-new finding is never absorbed by unrelated entries
+    extra = core.Finding("GC01", "pkg/bus.py", 1, "x")
+    new, _ = diff_baseline(findings + [extra], baseline, project)
+    assert new == [extra]
+
+
+# -- the real tree ----------------------------------------------------------
+
+def test_real_tree_is_clean():
+    """The tier-1 gate: all four analyzers over livekit_server_tpu/ with
+    zero findings beyond the committed (shrink-only) baseline."""
+    config = core.load_config(REPO_ROOT)
+    project = load_project(REPO_ROOT, config.paths)
+    findings = run_all(project, config)
+    baseline = core.load_baseline(REPO_ROOT / config.baseline)
+    new, stale = diff_baseline(findings, baseline, project)
+    assert new == [], "\n".join(f.render() for f in new)
+    assert stale == [], f"stale baseline entries (remove them): {stale}"
+
+
+def test_runner_exits_zero_on_real_tree(capsys):
+    from tools.check import main
+
+    assert main(["--no-compile"]) == 0
+    out = capsys.readouterr().out
+    assert "clean" in out
+
+
+# -- retry_async extensions (GC04's landing pad) ----------------------------
+
+def test_retry_async_on_give_up():
+    calls = []
+
+    async def always_fails():
+        raise ConnectionError("nope")
+
+    async def run():
+        with pytest.raises(ConnectionError):
+            await retry_async(
+                always_fails,
+                BackoffPolicy(base=0.0, max_attempts=3, jitter=False),
+                on_give_up=lambda n, e: calls.append((n, type(e).__name__)),
+            )
+
+    asyncio.run(run())
+    assert calls == [(3, "ConnectionError")]
+
+
+def test_retry_async_default_give_up_logs():
+    import io
+
+    from livekit_server_tpu.utils import logger as logger_mod
+
+    buf = io.StringIO()
+    logger_mod.configure(stream=buf)
+
+    async def always_fails():
+        raise ConnectionError("nope")
+
+    async def run():
+        with pytest.raises(ConnectionError):
+            await retry_async(
+                always_fails,
+                BackoffPolicy(base=0.0, max_attempts=2, jitter=False),
+            )
+
+    try:
+        asyncio.run(run())
+        out = buf.getvalue()
+        assert "retry_async giving up" in out and "attempts=2" in out
+    finally:
+        logger_mod.configure()
+
+
+def test_retry_async_should_abort():
+    attempts = []
+
+    async def fails():
+        attempts.append(1)
+        raise OSError("down")
+
+    async def run():
+        with pytest.raises(RetryAborted):
+            await retry_async(
+                fails,
+                BackoffPolicy(base=0.0, jitter=False),
+                retry_on=(OSError,),
+                should_abort=lambda: len(attempts) >= 2,
+            )
+
+    asyncio.run(run())
+    assert len(attempts) == 2
+
+
+def test_retry_async_wait_when_open():
+    breaker = CircuitBreaker(threshold=1, cooldown_s=0.01)
+    state = {"n": 0}
+
+    async def flaky():
+        state["n"] += 1
+        if state["n"] < 3:
+            raise ConnectionError("down")
+        return "up"
+
+    async def run():
+        return await retry_async(
+            flaky,
+            BackoffPolicy(base=0.0, jitter=False),
+            breaker=breaker,
+            wait_when_open=True,
+        )
+
+    assert asyncio.run(run()) == "up"
+    assert breaker.trips >= 1

@@ -1,0 +1,104 @@
+"""graftcheck runner — the repo's pre-commit / tier-1 static gate.
+
+    python -m tools.check              # lint + compileall; exit 0 iff clean
+    python -m tools.check --json       # findings as JSON on stdout
+    python -m tools.check --baseline   # (re)write the committed baseline
+
+Exit codes: 0 clean, 1 findings (or compile errors), 2 stale baseline /
+config problems. The baseline may only shrink: a baselined finding that
+no longer reproduces must be removed from the baseline file, otherwise
+the run fails with the stale entries listed.
+"""
+
+from __future__ import annotations
+
+import argparse
+import compileall
+import json
+import sys
+import time
+from pathlib import Path
+
+REPO_ROOT = Path(__file__).resolve().parent.parent
+
+
+def main(argv: list[str] | None = None) -> int:
+    ap = argparse.ArgumentParser(prog="tools.check", description=__doc__)
+    ap.add_argument("--baseline", action="store_true",
+                    help="rewrite the baseline file from current findings")
+    ap.add_argument("--json", action="store_true", dest="as_json",
+                    help="emit findings as JSON")
+    ap.add_argument("--no-compile", action="store_true",
+                    help="skip the compileall pass (pure lint)")
+    ap.add_argument("--rules", default=None,
+                    help="comma-separated subset, e.g. GC01,GC04")
+    args = ap.parse_args(argv)
+
+    sys.path.insert(0, str(REPO_ROOT))
+    from livekit_server_tpu.analysis import (
+        core,
+        diff_baseline,
+        load_baseline,
+        load_project,
+        run_all,
+        write_baseline,
+    )
+
+    t0 = time.perf_counter()
+    config = core.load_config(REPO_ROOT)
+    rules = None
+    if args.rules:
+        rules = [r.strip().upper() for r in args.rules.split(",") if r.strip()]
+        bad = [r for r in rules if r not in core.RULES]
+        if bad:
+            print(f"unknown rules: {', '.join(bad)}", file=sys.stderr)
+            return 2
+    project = load_project(REPO_ROOT, config.paths)
+    findings = run_all(project, config, rules)
+
+    baseline_path = REPO_ROOT / config.baseline
+    if args.baseline:
+        write_baseline(baseline_path, findings, project)
+        print(f"baseline written: {len(findings)} finding(s) -> "
+              f"{config.baseline}")
+        return 0
+
+    new, stale = diff_baseline(findings, load_baseline(baseline_path), project)
+
+    # Bytecode-compile the tree: catches syntax errors in files the
+    # analyzers never import (plugins, dead branches) — cheap and total.
+    compiled_ok = True
+    if not args.no_compile:
+        compiled_ok = compileall.compile_dir(
+            str(REPO_ROOT / "livekit_server_tpu"), quiet=2, force=False
+        )
+
+    if args.as_json:
+        print(json.dumps({
+            "findings": [vars(f) for f in new],
+            "stale_baseline": stale,
+            "compile_ok": bool(compiled_ok),
+        }, indent=1))
+    else:
+        for f in new:
+            print(f.render())
+        for e in stale:
+            print(f"STALE baseline entry (fixed? remove it): "
+                  f"{e.get('rule')} {e.get('path')}: {e.get('content')}")
+        if not compiled_ok:
+            print("compileall: errors (see above)")
+        dt = time.perf_counter() - t0
+        status = "clean" if not (new or stale) and compiled_ok else "FAILED"
+        print(f"graftcheck: {len(new)} finding(s), {len(stale)} stale "
+              f"baseline entr(ies), {len(project.files)} files in "
+              f"{dt:.2f}s — {status}")
+
+    if stale:
+        return 2
+    if new or not compiled_ok:
+        return 1
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())
