@@ -157,10 +157,13 @@ DEFAULT_CONFIG: dict = {
         # (self.runtime.state, rt.state, ...)
         "runtime_names": ["runtime", "rt"],
         # methods that touch the donated state on behalf of the caller —
-        # calling one requires the lock exactly like touching state does
+        # calling one requires the lock exactly like touching state does.
+        # The three-stage split keeps _stage_host/_schedule_probe OUT of
+        # this set: they read host mirrors only and run lock-free,
+        # overlapped with the in-flight device step.
         "state_methods": [
             "snapshot", "snapshot_room", "restore", "restore_room",
-            "_upload_ctrl", "_stage", "_device_step",
+            "_upload_ctrl", "_device_step",
         ],
         "lock_names": ["state_lock"],
         # lock-held-by-contract: bodies may touch state because every
@@ -168,7 +171,6 @@ DEFAULT_CONFIG: dict = {
         "lock_held": [
             "PlaneRuntime.__init__",
             "PlaneRuntime._upload_ctrl",
-            "PlaneRuntime._stage",
             "PlaneRuntime._device_step",
             "PlaneRuntime.snapshot",
             "PlaneRuntime.snapshot_room",

@@ -313,19 +313,23 @@ def _room_tick(
     # scatters serialize per element on TPU while this select/transpose
     # fuses (the cfg4-scale tick was dominated by exactly this scatter).
     lanes = jnp.arange(L, dtype=jnp.int32)[None, None, :]            # [1,1,L]
-    def to_streams(x, fill):
-        routed = jnp.where(
-            eff_layer[:, :, None] == lanes, x[:, :, None],
-            jnp.asarray(fill, x.dtype),
-        )                                                            # [T,K,L]
-        return routed.transpose(0, 2, 1).reshape(T * L, K)
-
-    st_sn = to_streams(inp.sn, 0)
-    st_ts = to_streams(inp.ts, 0)
-    st_size = to_streams(inp.size, 0)
-    st_arr = to_streams(inp.arrival_rtp, 0)
-    st_valid = to_streams(inp.valid, False)
-    stats = rtpstats.update_tick(state.stats, st_sn, st_ts, st_size, st_arr, st_valid)
+    # One stacked routed select for all five stats fields (sn/ts/size/
+    # arrival/valid) — five separate [T,K,L] selects each materialize
+    # their own routing compare + transpose; stacked they share it and
+    # fuse into one pass (same discipline as the tracker's tr_vals stack
+    # below). Every field's "not this lane" fill is 0 (valid rides as
+    # int32 0/1), so a single zero fill serves the stack.
+    st_vals = jnp.stack(
+        [inp.sn, inp.ts, inp.size, inp.arrival_rtp,
+         inp.valid.astype(jnp.int32)]
+    )                                                                # [5,T,K]
+    st_routed = jnp.where(
+        (eff_layer[:, :, None] == lanes)[None], st_vals[:, :, :, None], 0
+    )                                                                # [5,T,K,L]
+    st = st_routed.transpose(0, 1, 3, 2).reshape(5, T * L, K)
+    stats = rtpstats.update_tick(
+        state.stats, st[0], st[1], st[2], st[3], st[4].astype(jnp.bool_)
+    )
 
     # ---- 2. per-layer liveness + measured [4][4] bitrate matrix ---------
     # StreamTracker rows per (track, layer). Unlike the stats rows above,
@@ -731,6 +735,46 @@ def unpack_tick_inputs(
         tick_ms=tick_ms,
         roll_quality=roll_quality,
     )
+
+
+def pack_ctrl_rows(meta: TrackMeta, ctrl: SubControl, rows, pad_to: int | None = None):
+    """Host-side half of the dirty-row control upload: gather the dirtied
+    room rows of the host mirrors into two stacked int32 arrays.
+
+    Returns (rows [n] i32, meta_rows [4, n, T] i32, ctrl_rows [4, n, T, S]
+    i32) — O(dirty rows) bytes, not O(R·T·S). `pad_to` repeats the first
+    row up to a bucket size so the device scatter compiles once per
+    bucket instead of once per distinct dirty count (duplicate indices
+    carry identical values, so the scatter stays deterministic).
+    """
+    import numpy as np
+
+    rows = np.asarray(sorted(rows), np.int32)
+    if pad_to is not None and len(rows) < pad_to:
+        rows = np.concatenate([rows, np.repeat(rows[:1], pad_to - len(rows))])
+    meta_rows = np.stack([np.asarray(m)[rows].astype(np.int32) for m in meta])
+    ctrl_rows = np.stack([np.asarray(c)[rows].astype(np.int32) for c in ctrl])
+    return rows, meta_rows, ctrl_rows
+
+
+def apply_ctrl_delta(state: PlaneState, rows, meta_rows, ctrl_rows) -> PlaneState:
+    """Device-side (traced) half: scatter the dirtied rows into the
+    control tensors via `.at[rows].set(...)` — the delta-upload analog of
+    the full `_replace` in PlaneRuntime._upload_ctrl. Jitted with the
+    state donated, so the row writes are in-place in HBM."""
+    meta = TrackMeta(
+        *[
+            leaf.at[rows].set(meta_rows[i].astype(leaf.dtype))
+            for i, leaf in enumerate(state.meta)
+        ]
+    )
+    ctrl = SubControl(
+        *[
+            leaf.at[rows].set(ctrl_rows[i].astype(leaf.dtype))
+            for i, leaf in enumerate(state.ctrl)
+        ]
+    )
+    return state._replace(meta=meta, ctrl=ctrl)
 
 
 def pack_tick_outputs(out: TickOutputs) -> jax.Array:

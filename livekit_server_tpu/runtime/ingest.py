@@ -112,27 +112,46 @@ class PacketIn:
                               # (SR-normalized by the transport)
 
 
-class IngestBuffer:
-    """Double-buffered staging area for one node's tick inputs."""
+class _StagingSet:
+    """One of the two ping-ponged per-tick staging halves: the [R, T, K]
+    packet field arrays, the payload slab, and the per-(room, track)
+    write cursor. IngestBuffer binds the ACTIVE set's arrays as its own
+    attributes, so push()/push_batch()/drain() — and the tests that poke
+    `buf.sn` directly — address whichever set currently receives pushes."""
 
-    def __init__(self, dims: plane.PlaneDims, tick_ms: int):
-        self.dims = dims
-        self.tick_ms = tick_ms
-        R, T, K, S = dims
+    # Attributes rebound onto IngestBuffer at each flip.
+    ARRAYS = (
+        "_count", "sn", "ts", "layer", "temporal", "keyframe", "layer_sync",
+        "begin_pic", "end_frame", "pid", "tl0", "keyidx", "size", "frame_ms",
+        "audio_level", "arrival_rtp", "ts_jump", "valid",
+        "_slab", "pay_off", "pay_len", "marker", "t_arr",
+        "dd_off", "dd_len", "dd_ver",
+    )
+
+    def __init__(self, dims: plane.PlaneDims):
+        R, T, K, _ = dims
+        i32 = lambda: np.zeros((R, T, K), np.int32)
+        boo = lambda: np.zeros((R, T, K), bool)
         self._count = np.zeros((R, T), np.int32)
-        self.dropped = 0
-        # Rows quiesced for migration: once a room's state snapshot is
-        # taken, admitting more packets would advance munger offsets past
-        # what the destination node restores (duplicate SNs on re-issue).
-        self.frozen_rows: set[int] = set()
-        # Optional FaultInjector (runtime/faultinject.py) consulted by
-        # push()/push_batch(); None on the default config path. Delayed
-        # packets re-enter at the top of drain() for their release tick.
-        self.fault = None
-        self._fault_tick = 0
-        self._i32 = lambda: np.zeros((R, T, K), np.int32)
-        self._bool = lambda: np.zeros((R, T, K), bool)
-        self._alloc_fields()
+        self.sn = i32()
+        self.ts = i32()
+        self.layer = i32()
+        self.temporal = i32()
+        self.keyframe = boo()
+        self.layer_sync = boo()
+        self.begin_pic = boo()
+        self.end_frame = boo()
+        self.pid = i32()
+        self.tl0 = i32()
+        self.keyidx = i32()
+        self.size = i32()
+        self.frame_ms = i32()
+        self.audio_level = np.full((R, T, K), 127, np.int32)
+        self.arrival_rtp = i32()
+        # -1 = SR-normalized (exact cross-layer continuity); else one-frame
+        # fallback advance at a source switch (forwarder.go:1456).
+        self.ts_jump = np.full((R, T, K), 3000, np.int32)
+        self.valid = boo()
         # Payload slab — host-side only (PacketFactory analog; payload
         # bytes never cross to the device). One contiguous bytearray per
         # tick plus [R, T, K] offset/length arrays, so egress gathers
@@ -145,7 +164,53 @@ class IngestBuffer:
         self.dd_off = np.full((R, T, K), -1, np.int64)
         self.dd_len = np.zeros((R, T, K), np.int32)
         self.dd_ver = np.full((R, T, K), -1, np.int32)
-        # Per-subscriber feedback staging.
+        self.needs_scrub = False
+
+    def scrub(self) -> None:
+        """Reset for reuse as the push target. Only the masks/cursors and
+        payload index arrays need clearing — stale packet field values
+        are dead under valid=False (drain snapshots honor the mask)."""
+        self._slab.clear()
+        self.pay_off[:] = -1
+        self.pay_len[:] = 0
+        self.marker[:] = False
+        self.t_arr[:] = 0.0
+        self.dd_off[:] = -1
+        self.dd_len[:] = 0
+        self.dd_ver[:] = -1
+        self._count[:] = 0
+        self.valid[:] = False
+        self.audio_level[:] = 127
+        self.needs_scrub = False
+
+
+class IngestBuffer:
+    """Double-buffered staging area for one node's tick inputs: two
+    ping-ponged _StagingSets, flipped at each drain(), so staging tick
+    N+1 can fill one set while tick N's device step / slab-history
+    retention still reference data snapshotted from the other. The
+    retired set's reset is deferrable (scrub_retired) so its memsets run
+    in the serving loop's post-dispatch slack, off the staging path."""
+
+    def __init__(self, dims: plane.PlaneDims, tick_ms: int):
+        self.dims = dims
+        self.tick_ms = tick_ms
+        R, T, K, S = dims
+        self.dropped = 0
+        # Rows quiesced for migration: once a room's state snapshot is
+        # taken, admitting more packets would advance munger offsets past
+        # what the destination node restores (duplicate SNs on re-issue).
+        self.frozen_rows: set[int] = set()
+        # Optional FaultInjector (runtime/faultinject.py) consulted by
+        # push()/push_batch(); None on the default config path. Delayed
+        # packets re-enter at the top of drain() for their release tick.
+        self.fault = None
+        self._fault_tick = 0
+        self._sets = (_StagingSet(dims), _StagingSet(dims))
+        self._active = 0
+        self._bind(self._sets[0])
+        # Per-subscriber feedback staging (single-set: the [R, S]
+        # accumulators are small enough to reset inline at drain).
         self._estimate = np.zeros((R, S), np.float32)
         self._estimate_valid = np.zeros((R, S), bool)
         self._nacks = np.zeros((R, S), np.float32)
@@ -183,26 +248,21 @@ class IngestBuffer:
         self._nack_tick_cnt = np.zeros((R, S), np.int32)
         self.dupes = 0
 
-    def _alloc_fields(self):
-        self.sn = self._i32()
-        self.ts = self._i32()
-        self.layer = self._i32()
-        self.temporal = self._i32()
-        self.keyframe = self._bool()
-        self.layer_sync = self._bool()
-        self.begin_pic = self._bool()
-        self.end_frame = self._bool()
-        self.pid = self._i32()
-        self.tl0 = self._i32()
-        self.keyidx = self._i32()
-        self.size = self._i32()
-        self.frame_ms = self._i32()
-        self.audio_level = np.full(self.sn.shape, 127, np.int32)
-        self.arrival_rtp = self._i32()
-        # -1 = SR-normalized (exact cross-layer continuity); else one-frame
-        # fallback advance at a source switch (forwarder.go:1456).
-        self.ts_jump = np.full(self.sn.shape, 3000, np.int32)
-        self.valid = self._bool()
+    def _bind(self, s: _StagingSet) -> None:
+        """Point the buffer's staging attributes at `s`'s arrays (the
+        ping-pong flip). bytearray += and reshape-view writes mutate the
+        bound objects in place, so push paths need no indirection."""
+        for name in _StagingSet.ARRAYS:
+            setattr(self, name, getattr(s, name))
+
+    def scrub_retired(self) -> None:
+        """Deferred reset of the set retired by the last drain(). The
+        serving loop calls this in the post-dispatch slack; if it never
+        runs (step_once, direct drain() callers), the next drain() scrubs
+        inline before flipping to the set."""
+        s = self._sets[1 - self._active]
+        if s.needs_scrub:
+            s.scrub()
 
     def push(self, pkt: PacketIn, t_rx: float = 0.0, _fault_ok: bool = False) -> bool:
         """Stage one packet; False (and counted) if the tick is full."""
@@ -505,8 +565,21 @@ class IngestBuffer:
         tick_index: int = 0,
         pad_num=None,
         pad_track=None,
+        reuse_fields: bool = False,
     ) -> tuple[plane.TickInputs, PayloadSlab]:
-        """Snapshot this tick's tensors and reset for the next tick."""
+        """Snapshot this tick's tensors, then flip to the other staging
+        set so the next tick's pushes land in a fresh buffer.
+
+        Fields with post-drain lifetimes are ALWAYS copied: the munger
+        columns (sn/ts/ts_jump/pid/tl0/keyidx/begin_pic/valid) are read
+        at fan-out time — up to a full pipeline window later — and the
+        PayloadSlab is retained for the SLAB_WINDOW RTX history. With
+        `reuse_fields=True` (the pipelined runtime's staging path, which
+        packs the device arrays synchronously right after this returns),
+        the remaining pack-only fields are handed out as zero-copy views
+        of the retiring set; they are dead once packed, and the set is
+        recycled at the next flip. Direct callers (tests, mesh staging)
+        keep the default full-copy semantics."""
         if self.fault is not None:
             # Release held-back (delayed) packets whose tick has arrived:
             # they stage now, so they ride THIS tick's tensors.
@@ -519,15 +592,16 @@ class IngestBuffer:
             pad_num = np.zeros((R, S), np.int32)
         if pad_track is None:
             pad_track = np.full((R, S), -1, np.int32)
+        cp = (lambda a: a) if reuse_fields else (lambda a: a.copy())
         inp = plane.TickInputs(
-            sn=self.sn.copy(), ts=self.ts.copy(), layer=self.layer.copy(),
-            temporal=self.temporal.copy(), keyframe=self.keyframe.copy(),
-            layer_sync=self.layer_sync.copy(), begin_pic=self.begin_pic.copy(),
-            end_frame=self.end_frame.copy(),
+            sn=self.sn.copy(), ts=self.ts.copy(), layer=cp(self.layer),
+            temporal=cp(self.temporal), keyframe=cp(self.keyframe),
+            layer_sync=cp(self.layer_sync), begin_pic=self.begin_pic.copy(),
+            end_frame=cp(self.end_frame),
             pid=self.pid.copy(), tl0=self.tl0.copy(), keyidx=self.keyidx.copy(),
-            size=self.size.copy(), frame_ms=self.frame_ms.copy(),
-            audio_level=self.audio_level.copy(),
-            arrival_rtp=self.arrival_rtp.copy(), ts_jump=self.ts_jump.copy(),
+            size=cp(self.size), frame_ms=cp(self.frame_ms),
+            audio_level=cp(self.audio_level),
+            arrival_rtp=cp(self.arrival_rtp), ts_jump=self.ts_jump.copy(),
             valid=self.valid.copy(),
             estimate=self._estimate.copy(),
             estimate_valid=self._estimate_valid.copy(),
@@ -567,17 +641,15 @@ class IngestBuffer:
             dd_ver=self.dd_ver.copy(),
             t_arr=self.t_arr.copy(),
         )
-        self._slab.clear()
-        self.pay_off[:] = -1
-        self.pay_len[:] = 0
-        self.marker[:] = False
-        self.t_arr[:] = 0.0
-        self.dd_off[:] = -1
-        self.dd_len[:] = 0
-        self.dd_ver[:] = -1
-        self._count[:] = 0
-        self.valid[:] = False
-        self.audio_level[:] = 127
+        # Retire the drained set (its reset is deferred to scrub_retired)
+        # and flip pushes onto the other one — scrubbing it inline only if
+        # the deferred scrub never ran.
+        self._sets[self._active].needs_scrub = True
+        nxt = self._sets[1 - self._active]
+        if nxt.needs_scrub:
+            nxt.scrub()
+        self._active = 1 - self._active
+        self._bind(nxt)
         self._estimate_valid[:] = False
         self._nacks[:] = 0.0
         self._fb_delay_sum[:] = 0.0

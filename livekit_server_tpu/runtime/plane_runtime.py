@@ -246,6 +246,40 @@ def _build_step(audio_params, bwe_params, red_enabled=True):
     return jax.jit(tick, donate_argnums=(0,))
 
 
+@functools.lru_cache(maxsize=None)
+def _build_ctrl_delta(sharding=None):
+    """Dirty-row control upload (plane.apply_ctrl_delta), state donated so
+    the row scatters run in-place in HBM. One instance per sharding,
+    shared across runtimes like _build_step; jax caches per padded row
+    count (the caller pads to power-of-two buckets to bound variants)."""
+    if sharding is None:
+        return jax.jit(plane.apply_ctrl_delta, donate_argnums=(0,))
+    return jax.jit(
+        plane.apply_ctrl_delta, donate_argnums=(0,), out_shardings=sharding
+    )
+
+
+@dataclass
+class StagedTick:
+    """One tick's host-staged inputs, carried through the three-stage
+    pipeline (stage N+1 ‖ device N ‖ fan-out N-1) with its per-stage
+    timings. `packed` holds the pre-packed device arrays (non-mesh path):
+    packing happens at STAGE time, so the staging set's field arrays are
+    fully consumed before the set is recycled, and the worker thread's
+    span shrinks to the device round trip alone."""
+
+    inp: plane.TickInputs
+    payloads: Any
+    idx: int
+    roll: bool
+    packed: tuple | None = None
+    stage_s: float = 0.0
+    device_s: float = 0.0
+    edge: float = 0.0      # scheduled dispatch edge (perf_counter)
+    deadline: float = 0.0  # owning-tick egress deadline; 0 = unaccounted
+    depth: int = 0         # pipeline depth this tick ran at
+
+
 class PlaneRuntime:
     """Owns the device plane state + the host mirrors and tick loop."""
 
@@ -289,7 +323,12 @@ class PlaneRuntime:
             max_spatial=np.full((R, T, S), plane.MAX_LAYERS - 1, np.int32),
             max_temporal=np.full((R, T, S), 3, np.int32),
         )
-        self._ctrl_dirty = True
+        # Control-upload dirty tracking: mutations record their room row;
+        # the upload ships only those rows unless the full flag is set
+        # (init/restore) or the count crosses ctrl_delta_max_rows.
+        self._ctrl_dirty = True          # full [R, T, S] upload needed
+        self._dirty_rows: set[int] = set()
+        self.ctrl_delta_max_rows = max(1, dims.rooms // 8)
 
         self.state = plane.init_state(dims)
         # Host-owned SN/TS/VP8 rewrite state (the round-5 decide-on-
@@ -298,16 +337,19 @@ class PlaneRuntime:
         self._mesh = mesh
         if mesh is not None:
             from livekit_server_tpu.parallel import make_sharded_tick, shard_tree
+            from livekit_server_tpu.parallel.mesh import room_sharding
 
             self.state = shard_tree(self.state, mesh)
             self._step = make_sharded_tick(
                 mesh, self._ap, self._bp, donate=True, red_enabled=red_enabled,
             )
+            self._apply_delta = _build_ctrl_delta(room_sharding(mesh))
         else:
             # Shared across PlaneRuntime instances with identical params so
             # repeated construction (tests, restarts) reuses the XLA
             # compilation cache instead of re-tracing a fresh closure.
             self._step = _build_step(self._ap, self._bp, red_enabled)
+            self._apply_delta = _build_ctrl_delta()
 
         # Rolling payload history for NACK replay (slab keys reference slot
         # tick % SLAB_WINDOW; resolve_nacks age-gates so a recycled slot is
@@ -335,10 +377,22 @@ class PlaneRuntime:
         # buffers mid-step, so concurrent readers would see dead arrays.
         self.state_lock = asyncio.Lock()
         self._on_tick: list[Callable[[TickResult], Awaitable[None] | None]] = []
-        self.stats = {"ticks": 0, "fwd_packets": 0, "fwd_bytes": 0, "late_ticks": 0}
+        self.stats = {
+            "ticks": 0, "fwd_packets": 0, "fwd_bytes": 0, "late_ticks": 0,
+            # Pipeline shape: cumulative per-stage seconds + stall count
+            # (a window that found the previous fan-out still running).
+            "stage_s": 0.0, "device_s": 0.0, "fanout_s": 0.0,
+            "pipeline_stalls": 0,
+            # Control-upload accounting (the dirty-row protocol's receipt).
+            "ctrl_full_uploads": 0, "ctrl_delta_uploads": 0,
+            "ctrl_delta_rows": 0, "ctrl_upload_bytes": 0,
+        }
         from collections import deque
 
         self.recent_tick_s: deque = deque(maxlen=120)  # /debug/ticks window
+        # Per-tick stage breakdown dicts (idx/stage_ms/device_ms/fanout_ms/
+        # total_ms/depth/late) — the /debug/ticks pipeline view.
+        self.recent_ticks: deque = deque(maxlen=120)
         # Single worker: device steps are strictly ordered (donated state).
         from concurrent.futures import ThreadPoolExecutor
 
@@ -361,19 +415,19 @@ class PlaneRuntime:
             # Free the columns' subscriber state implicitly: masks go false.
             self.ctrl.subscribed[room, track, :] = False
             self.ingest.track_pub_sub[room, track] = -1
-        self._ctrl_dirty = True
+        self._dirty_rows.add(room)
 
     def set_subscription(self, room: int, track: int, sub: int, *,
                          subscribed: bool, sub_muted: bool = False) -> None:
         self.ctrl.subscribed[room, track, sub] = subscribed
         self.ctrl.sub_muted[room, track, sub] = sub_muted
-        self._ctrl_dirty = True
+        self._dirty_rows.add(room)
 
     def set_layer_caps(self, room: int, track: int, sub: int,
                        max_spatial: int, max_temporal: int = 3) -> None:
         self.ctrl.max_spatial[room, track, sub] = max_spatial
         self.ctrl.max_temporal[room, track, sub] = max_temporal
-        self._ctrl_dirty = True
+        self._dirty_rows.add(room)
 
     def clear_room(self, room: int) -> None:
         self.meta.published[room, :] = False
@@ -389,7 +443,7 @@ class PlaneRuntime:
         # Munger offsets likewise: the next tenant's streams must anchor
         # fresh, not continue a dead room's SN/TS spaces.
         self.munger.clear_room(room)
-        self._ctrl_dirty = True
+        self._dirty_rows.add(room)
 
     def on_tick(self, cb: Callable[[TickResult], Awaitable[None] | None]) -> None:
         self._on_tick.append(cb)
@@ -399,89 +453,140 @@ class PlaneRuntime:
 
     # -- tick ------------------------------------------------------------
     def _upload_ctrl(self) -> None:
+        """Ship pending host-mirror control mutations to the device.
+
+        Dirty-row delta by default: the dirtied room rows go up as a
+        stacked row-gather + `.at[rows].set(...)` scatter (O(dirty rows)
+        bytes), so subscription churn in one room no longer costs an
+        [R, T, S] host→HBM copy at north-star dims. Full `_replace`
+        upload when the full flag is set (init/restore) or the dirty
+        count crosses ctrl_delta_max_rows. No-op when clean."""
         import jax.numpy as jnp
 
-        if self._mesh is None:
-            put = jnp.asarray
-        else:
-            from livekit_server_tpu.parallel.mesh import room_sharding
+        rows = self._dirty_rows
+        if not self._ctrl_dirty and not rows:
+            return
+        if self._ctrl_dirty or len(rows) > self.ctrl_delta_max_rows:
+            if self._mesh is None:
+                put = jnp.asarray
+            else:
+                from livekit_server_tpu.parallel.mesh import room_sharding
 
-            sharding = room_sharding(self._mesh)
-            put = lambda x: jax.device_put(jnp.asarray(x), sharding)
-        self.state = self.state._replace(
-            meta=jax.tree.map(lambda x: put(x.copy()), plane.TrackMeta(*self.meta)),
-            ctrl=jax.tree.map(lambda x: put(x.copy()), plane.SubControl(*self.ctrl)),
-        )
+                sharding = room_sharding(self._mesh)
+                put = lambda x: jax.device_put(jnp.asarray(x), sharding)
+            self.state = self.state._replace(
+                meta=jax.tree.map(lambda x: put(x.copy()), plane.TrackMeta(*self.meta)),
+                ctrl=jax.tree.map(lambda x: put(x.copy()), plane.SubControl(*self.ctrl)),
+            )
+            self.stats["ctrl_full_uploads"] += 1
+        else:
+            # Pad the row count to a power-of-two bucket so the scatter
+            # compiles once per bucket, not once per distinct count.
+            pad_to = 1 << (len(rows) - 1).bit_length() if len(rows) > 1 else 1
+            r, meta_rows, ctrl_rows = plane.pack_ctrl_rows(
+                self.meta, self.ctrl, rows, pad_to=pad_to
+            )
+            self.state = self._apply_delta(self.state, r, meta_rows, ctrl_rows)
+            self.stats["ctrl_delta_uploads"] += 1
+            self.stats["ctrl_delta_rows"] += len(rows)
+            self.stats["ctrl_upload_bytes"] += meta_rows.nbytes + ctrl_rows.nbytes
+        self._dirty_rows = set()
         self._ctrl_dirty = False
 
-    def _device_step(self, inp):
+    def _device_step(self, st: StagedTick):
         """The blocking device round trip; runs off the event loop.
+        Inputs were pre-packed at stage time (non-mesh), so this thread's
+        span is the device call alone — its wall time lands in
+        `st.device_s`.
 
         Returns None (instead of outputs) when a supervisor restart
         abandoned this step mid-flight: the epoch check straddles the
         injected stall so a woken stale thread never consumes — or
         donates — state the restart already restored."""
         epoch = self.run_epoch
+        t0 = time.perf_counter()
         if self.fault is not None:
             self.fault.maybe_stall()
         if epoch != self.run_epoch:
             return None
         if self._mesh is not None:
-            state, out = self._step(self.state, inp)
+            state, out = self._step(self.state, st.inp)
             out = jax.tree.map(np.asarray, out)
         else:
-            packed = plane.pack_tick_inputs(inp)
-            state, buf = self._step(self.state, *packed)
+            state, buf = self._step(self.state, *st.packed)
             out = plane.unpack_tick_outputs(
                 np.asarray(buf), self.dims, self.red_enabled
             )
         if epoch != self.run_epoch:
             return None  # restarted mid-step: result belongs to a dead run
         self.state = state
+        st.device_s = time.perf_counter() - t0
         return out
 
-    def _stage(self):
-        """Host pre-step: ctrl upload, probe scheduling, ingest drain.
-        Claims this tick's index; returns (inp, payloads, idx, roll, t0)."""
+    def _stage_host(self) -> StagedTick:
+        """Pipelined host staging: claim a tick index, drain the ingest
+        buffer, pre-pack the device input arrays. Touches ONLY host-owned
+        state (ingest staging sets, slab history) — never self.state — so
+        it needs no lock and can overlap an in-flight device step. Probe
+        scheduling happens later, at dispatch (_schedule_probe), where the
+        freshest device mirrors are available."""
         t0 = time.perf_counter()
-        if self._ctrl_dirty:
-            self._upload_ctrl()
         idx = self.tick_index
         self.tick_index += 1
         # Close the quality/stats window about once per second
         # (connectionquality windows; room.go:1318 worker cadence).
         q_ticks = max(1, 1000 // self.tick_ms)
         roll = (idx + 1) % q_ticks == 0
-        # Probe scheduling (probe_controller.go): padding rides the first
-        # live video track each subscriber is actually SUBSCRIBED to (its
-        # munger lane must be started for padding_tick to emit anything);
-        # results return as estimate samples.
+        inp, payloads = self.ingest.drain(
+            roll_quality=roll, tick_index=idx,
+            reuse_fields=(self._mesh is None),
+        )
+        # Retain the slab for the RTX window: replay keys minted this tick
+        # reference slot (tick % SLAB_WINDOW) until it recycles.
+        self._slab_history[idx % plane.SLAB_WINDOW] = payloads
+        packed = None
+        if self._mesh is None:
+            # Pack here — NOT in the worker — so the drained staging set's
+            # zero-copy field views are consumed before the set recycles,
+            # and the packing memcpys overlap the previous device step.
+            packed = plane.pack_tick_inputs(inp)
+        st = StagedTick(inp=inp, payloads=payloads, idx=idx, roll=roll,
+                        packed=packed)
+        st.stage_s = time.perf_counter() - t0
+        return st
+
+    def _schedule_probe(self, st: StagedTick) -> None:
+        """Probe scheduling (probe_controller.go) for `st`, at dispatch
+        time: padding rides the first live video track each subscriber is
+        actually SUBSCRIBED to (its munger lane must be started for
+        padding_tick to emit anything); results return as estimate
+        samples. Runs against the latest device mirrors (one tick stale,
+        same as the pre-split staging) and the tick's own drained
+        estimate snapshot. pad_num/pad_track are host-only fields — the
+        device never reads them — so injecting them after pre-pack is
+        sound; they feed _assemble_padding at fan-out."""
         vid = self.meta.is_video & self.meta.published & ~self.meta.pub_muted
         cand = vid[:, :, None] & self.ctrl.subscribed          # [R, T, S]
         pad_track = np.where(
             cand.any(axis=1), cand.argmax(axis=1), -1
         ).astype(np.int32)                                     # [R, S]
         pad_num = self.prober.update(
-            now_ms=idx * self.tick_ms,
+            now_ms=st.idx * self.tick_ms,
             committed=self._last_committed,
             congested=self._last_congested,
             deficient=self._last_deficient,
-            estimate=self.ingest._estimate,
-            estimate_valid=self.ingest._estimate_valid,
+            estimate=np.asarray(st.inp.estimate),
+            estimate_valid=np.asarray(st.inp.estimate_valid),
             pad_track=pad_track,
         )
         if self.ingest.frozen_rows:
             # Probe padding also advances munger SN lanes; a row mid-
             # migration must stay byte-for-byte at its snapshot.
             pad_num[list(self.ingest.frozen_rows)] = 0
-        inp, payloads = self.ingest.drain(
-            roll_quality=roll, tick_index=idx,
-            pad_num=pad_num, pad_track=pad_track,
+        st.inp = st.inp._replace(
+            pad_num=np.asarray(pad_num, np.int32),
+            pad_track=np.asarray(pad_track, np.int32),
         )
-        # Retain the slab for the RTX window: replay keys minted this tick
-        # reference slot (tick % SLAB_WINDOW) until it recycles.
-        self._slab_history[idx % plane.SLAB_WINDOW] = payloads
-        return inp, payloads, idx, roll, t0
 
     def _mirror_probe_inputs(self, out) -> None:
         """Probe-controller inputs for the NEXT stage; must land as soon
@@ -491,51 +596,81 @@ class PlaneRuntime:
         self._last_congested = np.asarray(out.congested)
         self._last_deficient = np.asarray(out.deficient)
 
-    async def _complete(self, out, inp, payloads, idx, roll, t0, pre_s=None) -> TickResult:
-        """Host post-step: fan out + callbacks. `pre_s` (pipelined loop)
-        is the stage+device work time measured when the device future
-        resolved — the deferred fan-out must not bill the scheduler sleep
-        between ticks as work."""
+    async def _complete(self, out, st: StagedTick) -> TickResult:
+        """Host post-step: fan out + callbacks. Per-stage work times
+        (stage/device/fan-out) sum into tick_s — the deferred fan-out
+        never bills the scheduler sleep between windows as work — and
+        lateness is judged against the OWNING tick's deadline (dispatch
+        edge + (1 + depth) periods), checked after the delivery callbacks
+        have actually run."""
         c0 = time.perf_counter()
-        base = pre_s if pre_s is not None else c0 - t0
-        result = self._fan_out(out, payloads, inp, base, idx)
-        # Total tick work: stage+device plus this fan-out.
-        result.tick_s = base + (time.perf_counter() - c0)
-        result.quality_window_closed = roll
+        result = self._fan_out(out, st.payloads, st.inp, 0.0, st.idx)
+        fanout_s = time.perf_counter() - c0
+        result.tick_s = st.stage_s + st.device_s + fanout_s
+        result.quality_window_closed = st.roll
         self.recent_tick_s.append(round(result.tick_s, 5))
         self.stats["ticks"] += 1
         self.stats["fwd_packets"] += result.fwd_packets
         self.stats["fwd_bytes"] += result.fwd_bytes
+        self.stats["stage_s"] += st.stage_s
+        self.stats["device_s"] += st.device_s
+        self.stats["fanout_s"] += fanout_s
         for cb in self._on_tick:
             r = cb(result)
             if asyncio.iscoroutine(r):
                 await r
+        # Egress leaves inside the callbacks (wire tx), so the deadline
+        # check runs after them: a tick is late when its sends left after
+        # the end of the window its pipeline depth entitles it to.
+        late = bool(st.deadline) and time.perf_counter() > st.deadline
+        if late:
+            self.stats["late_ticks"] += 1
+        self.recent_ticks.append({
+            "idx": st.idx, "depth": st.depth,
+            "stage_ms": round(st.stage_s * 1000.0, 3),
+            "device_ms": round(st.device_s * 1000.0, 3),
+            "fanout_ms": round(fanout_s * 1000.0, 3),
+            "total_ms": round(result.tick_s * 1000.0, 3),
+            "late": late,
+        })
         return result
 
     async def step_once(self) -> TickResult:
         """One sequential tick (tests, warmup, manual stepping); the device
         round trip runs in a worker thread so the event loop (signal
         sessions) never blocks on HBM/tunnel latency. The serving loop
-        (`_run`) instead pipelines: egress fan-out of tick N overlaps tick
-        N+1's device step.
+        (`_run`) instead pipelines: staging of tick N+1 and egress fan-out
+        of tick N-1 overlap tick N's device step.
 
-        Do NOT interleave step_once with a RUNNING serving loop: the
+        step_once must NOT interleave with a RUNNING serving loop: the
         device steps serialize safely under state_lock, but this path's
         immediate fan-out can land before the loop's deferred fan-out of
         an EARLIER tick, which then rewrites munger lanes backwards
-        (last-writer-wins) and emits egress out of wire order."""
+        (last-writer-wins) and emits egress out of wire order — hence the
+        hard RuntimeError below instead of a docstring plea."""
+        if self._task is not None and not self._task.done():
+            raise RuntimeError(
+                "step_once() while the serving loop is running: its "
+                "immediate fan-out would land ahead of the loop's deferred "
+                "fan-out of an earlier tick and rewrite munger lanes "
+                "backwards (out-of-wire-order egress). Stop the loop first "
+                "or consume ticks via on_tick()."
+            )
         loop = asyncio.get_running_loop()
-        # Stage under the lock: _upload_ctrl replaces fields on self.state,
-        # and a concurrent serving-loop tick may have that state donated to
-        # an in-flight device step — staging against it reads deleted
-        # buffers (or the step's commit silently discards the upload).
+        # Staging reads only host mirrors — no lock needed. The ctrl
+        # upload and the device step touch (and donate) self.state, so
+        # they run under the lock: a concurrent snapshot/restore (room
+        # migration) must never observe donated-and-deleted buffers.
+        st = self._stage_host()
+        self._schedule_probe(st)
         async with self.state_lock:
-            inp, payloads, idx, roll, t0 = self._stage()
-            out = await loop.run_in_executor(self._executor, self._device_step, inp)
+            self._upload_ctrl()
+            out = await loop.run_in_executor(self._executor, self._device_step, st)
         if out is None:
             raise asyncio.CancelledError("device step abandoned by restart")
         self._mirror_probe_inputs(out)
-        return await self._complete(out, inp, payloads, idx, roll, t0)
+        self.ingest.scrub_retired()
+        return await self._complete(out, st)
 
     def resolve_nacks(self, room: int, sub: int, track: int, sns) -> list[EgressPacket]:
         """NACKed munged SNs → replay EgressPackets, at RTCP time (the
@@ -682,45 +817,93 @@ class PlaneRuntime:
         if self._task is None:
             self._task = asyncio.ensure_future(self._run())
 
+    @staticmethod
+    async def _sleep_until(when: float) -> None:
+        """Window-edge sleep: coarse asyncio.sleep to just short of the
+        edge, then a yield loop for the tail. An epoll-backed sleep
+        overshoots by the event-loop lag (hundreds of µs under rx load)
+        — at a 5 ms tick that alone costs 5-10% of the cadence. The
+        sleep(0) tail keeps rx/feedback callbacks running while landing
+        the dispatch within ~50 µs of the edge; the spin is bounded by
+        the 1.5 ms margin and only burns the window's idle slack."""
+        delay = when - time.perf_counter() - 0.0015
+        if delay > 0:
+            await asyncio.sleep(delay)
+        while time.perf_counter() < when:
+            await asyncio.sleep(0)
+
     async def _run(self) -> None:
-        """Pipelined serving loop (the 'double-buffered DMA' this module
-        documents): tick N's device step is dispatched to the worker
-        thread, then tick N-1's fan-out + egress runs on the event loop
-        WHILE the device crunches — so a tick's wall budget is
-        max(device, host-egress) + staging instead of their sum. The
-        completion queue is bounded at 1: if host egress can't keep up,
-        the loop degrades to sequential instead of queueing stale sends.
-        self.state stays single-owner: staging (which touches the donated
-        state via ctrl uploads) runs under state_lock, so it can never
-        observe a state donated to an in-flight device step — not this
-        loop's, and not a concurrent step_once's (tests and warmup step
-        manually while the loop serves)."""
+        """Three-stage pipelined serving loop (the 'double-buffered DMA'
+        this module documents): within one tick window,
+
+            stage N+1  ‖  device N  ‖  fan-out N-1
+
+        Tick N — staged during the PREVIOUS window — is dispatched to the
+        worker thread at the window edge; while the device crunches, the
+        event loop stages tick N+1 (ingest drain + input pre-pack, into
+        the other ingest ping-pong set) and runs tick N-1's fan-out +
+        egress. A tick's wall budget is max(device, stage + fan-out) +
+        dispatch ε instead of the former stage + max(device, fan-out):
+        nothing host-side sits in front of the device dispatch but the
+        (delta) ctrl upload.
+
+        The completion queue is bounded at 1: if host egress can't keep
+        up, the loop degrades to sequential (counted in pipeline_stalls)
+        instead of queueing stale sends, and a stalled device future
+        simply holds the loop at `await fut` — no new tick is staged past
+        the one already prepared, so depth is bounded by construction.
+
+        self.state stays single-owner: only the ctrl upload + dispatched
+        device step touch the donated state, and exactly that span runs
+        under state_lock. Staging reads host mirrors only and needs no
+        lock (the GC01 split: _upload_ctrl/_device_step keep the
+        lock-held contract, _stage_host is lock-free)."""
         period = self.tick_ms / 1000.0
         next_at = time.perf_counter() + period
         loop = asyncio.get_running_loop()
-        pending = None  # (out, staged, pre_s) — previous tick awaiting fan-out
+        pending: tuple | None = None   # (out, StagedTick) awaiting fan-out
         pending_task: asyncio.Task | None = None
+        staged: StagedTick | None = None  # pre-staged next tick
+        depth = 0 if self.low_latency else 1
         try:
             while True:
-                await asyncio.sleep(max(0.0, next_at - time.perf_counter()))
+                await self._sleep_until(next_at)
                 if pending_task is not None:
                     # Backpressure: previous fan-out still running ⇒ wait
                     # (sequential under overload; no unbounded queue).
-                    res = await pending_task
+                    if not pending_task.done():
+                        self.stats["pipeline_stalls"] += 1
+                    await pending_task
                     pending_task = self._complete_task = None
-                    if res.tick_s > period:
-                        self.stats["late_ticks"] += 1
+                if staged is None:
+                    # Cold start, post-resync, or low_latency mode: stage
+                    # at the window edge (low latency keeps the freshest
+                    # possible drain at the cost of serializing it).
+                    staged = self._stage_host()
+                cur, staged = staged, None
+                cur.depth = depth
+                cur.edge = next_at
+                cur.deadline = next_at + (1 + depth) * period
+                self._schedule_probe(cur)
                 await self.state_lock.acquire()
-                staged = self._stage()
-                fut = loop.run_in_executor(
-                    self._executor, self._device_step, staged[0]
-                )
                 try:
+                    self._upload_ctrl()
+                    fut = loop.run_in_executor(self._executor, self._device_step, cur)
                     if pending is not None:
                         pending_task = self._complete_task = asyncio.ensure_future(
-                            self._complete(pending[0], *pending[1], pre_s=pending[2])
+                            self._complete(pending[0], pending[1])
                         )
                         pending = None
+                    if not self.low_latency:
+                        # Stage N+1 while device N runs in the worker:
+                        # the drain flips to the other ingest ping-pong
+                        # set and the pre-pack memcpys overlap the device
+                        # step — the tentpole overlap. Staging touches
+                        # host mirrors only; the lock we hold here guards
+                        # the in-flight donated state, not this.
+                        staged = self._stage_host()
+                    # Fan-out N-1 (the task above) and any arriving-packet
+                    # handlers run on the event loop during this await.
                     out = await fut
                 finally:
                     self.state_lock.release()
@@ -729,7 +912,8 @@ class PlaneRuntime:
                     # bail to the drain handler without touching state.
                     raise asyncio.CancelledError("device step abandoned by restart")
                 self._mirror_probe_inputs(out)
-                pending = (out, staged, time.perf_counter() - staged[4])
+                self.ingest.scrub_retired()
+                pending = (out, cur)
                 if self.low_latency:
                     # Fan out THIS tick's egress now rather than
                     # overlapping it with the next device step: the sends
@@ -739,11 +923,7 @@ class PlaneRuntime:
                     # re-run the same tick (double egress + munger state
                     # advanced twice).
                     to_complete, pending = pending, None
-                    res = await self._complete(
-                        to_complete[0], *to_complete[1], pre_s=to_complete[2]
-                    )
-                    if res.tick_s > period:
-                        self.stats["late_ticks"] += 1
+                    await self._complete(to_complete[0], to_complete[1])
                 next_at += period
                 if next_at < time.perf_counter() - 5 * period:
                     next_at = time.perf_counter() + period  # resync after stall
@@ -754,7 +934,7 @@ class PlaneRuntime:
                 await pending_task
                 self._complete_task = None
             if pending is not None:
-                await self._complete(pending[0], *pending[1], pre_s=pending[2])
+                await self._complete(pending[0], pending[1])
             raise
 
     async def stop(self) -> None:
@@ -875,7 +1055,7 @@ class PlaneRuntime:
         self.ctrl.sub_muted[row] = False
         self.ctrl.max_spatial[row] = plane.MAX_LAYERS - 1
         self.ctrl.max_temporal[row] = 3
-        self._ctrl_dirty = True
+        self._dirty_rows.add(row)
 
     def restore(self, snap: dict[str, Any]) -> None:
         flat, treedef = jax.tree.flatten(self.state)

@@ -168,12 +168,17 @@ class LivekitServer:
         return web.json_response({"count": len(tasks), "tasks": tasks})
 
     async def debug_ticks(self, request: web.Request) -> web.Response:
-        """Recent tick timing breakdown (§5.1 profiling surface)."""
+        """Recent tick timing breakdown (§5.1 profiling surface): totals
+        plus the per-tick pipeline-stage split (stage/device/fanout ms,
+        depth, late) so an overlap regression is visible per stage rather
+        than inferred from host_ms_per_tick."""
         rt = self.room_manager.runtime
         body = {
             "tick_ms": rt.tick_ms,
             "stats": rt.stats,
+            "pipeline_depth": 0 if rt.low_latency else 1,
             "recent_tick_s": list(getattr(rt, "recent_tick_s", [])),
+            "recent_ticks": list(getattr(rt, "recent_ticks", [])),
         }
         udp = getattr(self.room_manager, "udp", None)
         if udp is not None and getattr(udp, "fwd_latency", None) is not None:

@@ -111,6 +111,13 @@ class TelemetryService:
         self.set_gauge("livekit_packets_forwarded_total", stats.get("fwd_packets", 0))
         self.set_gauge("livekit_bytes_forwarded_total", stats.get("fwd_bytes", 0))
         self.set_gauge("livekit_plane_late_ticks_total", stats.get("late_ticks", 0))
+        # Pipeline-stage seconds (three-stage tick loop) + control-upload
+        # accounting — cumulative, so rates are scrape-window deltas.
+        for k in ("stage_s", "device_s", "fanout_s"):
+            self.set_gauge(f"livekit_plane_{k}_total", stats.get(k, 0.0))
+        for k in ("pipeline_stalls", "ctrl_full_uploads", "ctrl_delta_uploads",
+                  "ctrl_delta_rows", "ctrl_upload_bytes"):
+            self.set_gauge(f"livekit_plane_{k}_total", stats.get(k, 0))
 
     def observe_transport(self, stats: dict[str, Any]) -> None:
         """UDP/TCP media-wire counters (prometheus/packets.go direction

@@ -229,7 +229,14 @@ async def test_room_handoff_over_bus():
             for i in range(3):
                 rt.ingest.push(PacketIn(room=row_a, track=0, sn=100 + i,
                                         ts=0, size=10, payload=b"x"))
-                await rt.step_once()
+            # The node's serving loop is running, so step_once() would race
+            # its deferred fan-out (and now raises); let the loop drain the
+            # pushed packets and wait for the munger lane to advance.
+            for _ in range(500):
+                if int(rt.munger.last_sn[row_a, 0, 1]) == 102:
+                    break
+                await asyncio.sleep(0.01)
+            assert int(rt.munger.last_sn[row_a, 0, 1]) == 102
             await alice.close()
 
             assert await srv_a.room_manager.handoff_room("mig")

@@ -114,6 +114,60 @@ def test_gc01_lock_held_allowlist(tmp_path):
     assert gc01.run(project, cfg) == []
 
 
+# Three-stage split: the lock-free staging half (ingest drain + probe
+# mirrors, no donated-state access) must NOT be flagged, while the
+# delta-upload/_replace half keeps the lock-held contract.
+GC01_SPLIT_FIXTURE = """\
+    import asyncio
+
+    class PlaneRuntime:
+        def __init__(self):
+            self.state = object()
+            self.state_lock = asyncio.Lock()
+            self.ingest = object()
+            self._dirty_rows = set()
+
+        def _stage_host(self):
+            inp = self.ingest            # host mirrors only: never flagged
+            self._dirty_rows.add(1)
+            return inp
+
+        def _upload_ctrl(self):
+            self.state = self.state      # allowed: caller-holds-lock contract
+
+        async def good_tick(self):
+            staged = self._stage_host()          # lock-free staging: OK
+            async with self.state_lock:
+                self._upload_ctrl()              # upload under the lock: OK
+            return staged
+
+        async def bad_tick(self):
+            staged = self._stage_host()          # still fine lock-free
+            self._upload_ctrl()                  # line 26: lockless upload
+            self.state = None                    # line 27: lockless _replace
+            return staged
+"""
+
+
+def test_gc01_three_stage_split(tmp_path):
+    """Default config (the real tree's contract): _upload_ctrl/_device_step
+    are state methods needing the lock; _stage_host is not."""
+    project = make_project(tmp_path, {"pkg/rt.py": GC01_SPLIT_FIXTURE})
+    findings = gc01.run(project, cfg_for("gc01"))
+    assert all(f.rule == "GC01" for f in findings)
+    assert lines_of(findings, "GC01") == [26, 27]
+
+
+def test_gc01_staging_half_never_needs_lock(tmp_path):
+    """Treating the drain/probe half as a state method would be a false
+    positive factory — the default config must not include it."""
+    assert "_stage_host" not in core.DEFAULT_CONFIG["gc01"]["state_methods"]
+    assert "_schedule_probe" not in core.DEFAULT_CONFIG["gc01"]["state_methods"]
+    good_only = GC01_SPLIT_FIXTURE.split("async def bad_tick")[0]
+    project = make_project(tmp_path, {"pkg/rt.py": good_only})
+    assert gc01.run(project, cfg_for("gc01")) == []
+
+
 # -- GC02 tracer purity -----------------------------------------------------
 
 GC02_FIXTURE = """\
