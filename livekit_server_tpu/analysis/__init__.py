@@ -18,6 +18,10 @@ analyzers wired into the tier-1 gate:
   GC04 retry-policy — network dials/sends in routing/ and the media
        relay must route through utils/backoff.retry_async; bare
        while+sleep retry loops are findings.
+  GC05 bounded-queues — every asyncio.Queue / collections.deque
+       constructed in runtime/ and routing/ carries an explicit bound
+       (maxsize=/maxlen=); unbounded buffers turn overload into memory
+       growth instead of counted drops.
 
 Suppressions: `# graftcheck: disable=GC01` on the finding's exact line
 (with a justification comment), `# graftcheck: disable-file=GC02` for a

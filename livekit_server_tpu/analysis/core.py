@@ -18,7 +18,7 @@ from typing import Callable
 
 # -- finding model ----------------------------------------------------------
 
-RULES = ("GC01", "GC02", "GC03", "GC04")
+RULES = ("GC01", "GC02", "GC03", "GC04", "GC05")
 
 # Parse/config failures surface as findings too (rule GC00) so the runner
 # has one reporting path; compileall in tools/check.py catches the rest.
@@ -220,6 +220,14 @@ DEFAULT_CONFIG: dict = {
         ],
         "retry_helpers": ["retry_async", "CircuitBreaker"],
     },
+    "gc05": {
+        "paths": [
+            "livekit_server_tpu/runtime",
+            "livekit_server_tpu/routing",
+        ],
+        "queue_calls": ["Queue", "LifoQueue", "PriorityQueue"],
+        "deque_calls": ["deque"],
+    },
 }
 
 
@@ -270,13 +278,14 @@ def run_all(
     project: Project, config: Config, rules: list[str] | None = None
 ) -> list[Finding]:
     """Run the analyzers, apply per-line/file suppressions, sort."""
-    from livekit_server_tpu.analysis import gc01, gc02, gc03, gc04
+    from livekit_server_tpu.analysis import gc01, gc02, gc03, gc04, gc05
 
     impls: dict[str, Callable[[Project, dict], list[Finding]]] = {
         "GC01": gc01.run,
         "GC02": gc02.run,
         "GC03": gc03.run,
         "GC04": gc04.run,
+        "GC05": gc05.run,
     }
     findings: list[Finding] = []
     for f in project.files:

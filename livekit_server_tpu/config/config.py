@@ -118,13 +118,34 @@ class RoomConfig:
 
 @dataclass
 class LimitsConfig:
-    """config.go LimitConfig — node admission limits."""
+    """config.go LimitConfig — node admission limits, plus the overload
+    governor (runtime/governor.py) that closes the loop from tick
+    telemetry to load shedding. Admission limits default to 0 =
+    unlimited; the governor defaults ON (L4 still only engages under
+    sustained measured overload)."""
 
     num_tracks: int = 0          # 0 = unlimited
     bytes_per_sec: float = 0.0
     subscription_limit_video: int = 0
     subscription_limit_audio: int = 0
     max_rooms: int = 0
+    # Node-level ingress packet rate: joins/publishes are refused while
+    # the measured rate (router stats heartbeat) exceeds this. 0 = off.
+    packets_per_sec: float = 0.0
+    # Overload governor: degradation ladder L1 clamp spatial layers →
+    # L2 police video ingress → L3 pause non-pinned video → L4 reject
+    # new work. Escalates after `escalate_ticks` consecutive pressured
+    # ticks (late / stalled / capacity-dropping / work ratio ≥ enter);
+    # de-escalates one level per `dwell_ticks` consecutive calm ticks
+    # (work ratio ≤ exit) — enter/exit split + dwell are the hysteresis.
+    governor_enabled: bool = True
+    governor_enter_pressure: float = 0.85   # work ratio entering overload
+    governor_exit_pressure: float = 0.55    # work ratio counting as calm
+    governor_escalate_ticks: int = 20
+    governor_dwell_ticks: int = 150
+    # L2 token buckets: per-(room, track) video packets/sec + burst.
+    governor_ingress_pps: float = 400.0
+    governor_ingress_burst: float = 100.0
 
 
 @dataclass
@@ -186,6 +207,9 @@ class SupervisorConfig:
     max_restarts: int = 5            # consecutive, without regaining health
     restart_backoff_base_s: float = 0.1
     restart_backoff_max_s: float = 5.0
+    # Stall-deadline multiplier while the overload governor is engaged:
+    # "overloaded but making progress" must shed load, not restart.
+    overload_grace: float = 5.0
 
 
 @dataclass
@@ -202,6 +226,10 @@ class FaultInjectConfig:
     delay_ticks: int = 2         # delayed packets re-enter after N ticks
     stall_every: int = 0         # every Nth device step stalls (0 = never)
     stall_s: float = 0.0
+    # Flood mode: offered-load multiplier (extra staged copies per
+    # arriving packet; <= 1.0 = off) for reproducible overload.
+    flood_mult: float = 1.0
+    flood_rooms: list[int] = field(default_factory=list)  # [] = all rooms
 
 
 @dataclass
@@ -386,7 +414,21 @@ def _validate(cfg: Config) -> None:
             raise ConfigError(f"faults.{name} must be in [0, 1], got {v}")
     if f.drop_pct + f.dup_pct + f.delay_pct > 1.0:
         raise ConfigError("faults.drop_pct + dup_pct + delay_pct must be <= 1")
+    if f.flood_mult < 0.0:
+        raise ConfigError(f"faults.flood_mult must be >= 0, got {f.flood_mult}")
     if cfg.supervisor.tick_deadline_ms <= 0:
         raise ConfigError("supervisor.tick_deadline_ms must be positive")
+    if cfg.supervisor.overload_grace < 1.0:
+        raise ConfigError("supervisor.overload_grace must be >= 1")
+    lim = cfg.limits
+    if not lim.governor_enter_pressure > lim.governor_exit_pressure:
+        raise ConfigError(
+            "limits.governor_enter_pressure must exceed governor_exit_pressure "
+            "(the hysteresis band)"
+        )
+    for name in ("governor_escalate_ticks", "governor_dwell_ticks",
+                 "governor_ingress_pps", "governor_ingress_burst"):
+        if getattr(lim, name) <= 0:
+            raise ConfigError(f"limits.{name} must be positive")
     if cfg.kv.lease_ttl_s <= 0:
         raise ConfigError("kv.lease_ttl_s must be positive")

@@ -39,6 +39,11 @@ class Subscription:
     """One subscriber's bounded queue on a channel (drop-on-overflow, the
     reference's bounded-channel semantics — signal.go:295-348)."""
 
+    # Process-wide overflow count across every subscription — exported
+    # as livekit_bus_sub_dropped_total (a saturated bus must be visible,
+    # not a per-instance count that dies with the subscription).
+    total_dropped = 0
+
     def __init__(self, bus: "MemoryBus", channel: str, size: int):
         self._bus = bus
         self._channel = channel
@@ -51,6 +56,7 @@ class Subscription:
             self._q.put_nowait(msg)
         except asyncio.QueueFull:
             self.dropped += 1
+            Subscription.total_dropped += 1
 
     async def __aiter__(self) -> AsyncIterator[Any]:
         while not self.closed:

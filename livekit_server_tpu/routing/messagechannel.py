@@ -29,10 +29,16 @@ class ChannelClosed(Exception):
 class MessageChannel:
     """Async bounded channel; WriteMessage never blocks (drop-on-full)."""
 
+    # Process-wide overflow count across every channel instance —
+    # exported as livekit_signal_channel_dropped_total (a saturated
+    # signal path must be visible, not a silent local counter).
+    total_dropped = 0
+
     def __init__(self, size: int = DEFAULT_SIZE, connection_id: str = ""):
         self._q: asyncio.Queue[Any] = asyncio.Queue(maxsize=size)
         self._closed = False
         self.connection_id = connection_id
+        self.dropped = 0  # this channel's overflow count
 
     @property
     def is_closed(self) -> bool:
@@ -44,6 +50,8 @@ class MessageChannel:
         try:
             self._q.put_nowait(msg)
         except asyncio.QueueFull:
+            self.dropped += 1
+            MessageChannel.total_dropped += 1
             raise ChannelFull from None
 
     async def read_message(self) -> Any:

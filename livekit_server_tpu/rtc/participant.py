@@ -172,6 +172,18 @@ class Participant:
                            "mime_type": mime}},
             )
             return None
+        deny = getattr(self.room, "admission", None)
+        reason = deny("publish") if deny is not None else ""
+        if reason:
+            # Node admission (governor L4 / LimitConfig track cap / node
+            # ingress rate): answer explicitly — same contract as the
+            # codec rejection above, dead air would hang the SDK.
+            self.send(
+                "request_response",
+                {"error": {"reason": "node_overloaded", "cid": cid,
+                           "message": reason}},
+            )
+            return None
         try:
             track_type = pm.TrackType(int(req.get("type", 0)))
             source = pm.TrackSource(int(req.get("source", 0)))

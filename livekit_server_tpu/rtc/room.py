@@ -45,6 +45,10 @@ class Room:
         self.closed = False
         self.udp = None  # UDPMediaTransport when the node serves UDP media
         self.crypto = None  # MediaCryptoRegistry (join-time key minting)
+        # Node admission gate (RoomManager._admission_denied): returns a
+        # non-empty rejection reason when new work must be refused.
+        # None (tests constructing rooms directly) admits everything.
+        self.admission = None
         # Incremental indexes for the per-tick hot path (no per-packet
         # dict rebuilds): sub col → participant, track col → track sid.
         self.sub_index: dict[int, Participant] = {}
@@ -273,6 +277,13 @@ class Room:
         width = settings.get("width", 0)
         height = settings.get("height", 0)
         fps = settings.get("fps", 0)
+        if "pinned" in settings:
+            # Pinned subscriptions (screen share, active speaker) are
+            # exempt from the governor's L3 video pause.
+            self.runtime.set_pinned(
+                self.slots.row, track.track_col, subscriber.sub_col,
+                bool(settings["pinned"]),
+            )
         self.runtime.set_subscription(
             self.slots.row,
             track.track_col,

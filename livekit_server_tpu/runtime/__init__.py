@@ -18,12 +18,14 @@ from livekit_server_tpu.runtime.ingest import IngestBuffer
 from livekit_server_tpu.runtime.plane_runtime import PlaneRuntime
 from livekit_server_tpu.runtime.supervisor import PlaneSupervisor
 from livekit_server_tpu.runtime.faultinject import FaultInjector, FaultSpec
+from livekit_server_tpu.runtime.governor import OverloadGovernor
 
 __all__ = [
     "CapacityError",
     "FaultInjector",
     "FaultSpec",
     "IngestBuffer",
+    "OverloadGovernor",
     "PlaneRuntime",
     "PlaneSupervisor",
     "SlotAllocator",

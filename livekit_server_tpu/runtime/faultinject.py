@@ -43,6 +43,13 @@ class FaultSpec:
     delay_ticks: int = 2      # held-back packets re-enter after this many ticks
     stall_every: int = 0      # every Nth device step stalls (0 = never)
     stall_s: float = 0.0      # stall duration
+    # Flood mode: multiply offered load by staging extra copies of each
+    # arriving packet (<= 1.0 disables). Non-integer multipliers add the
+    # fractional copy with a seeded draw; integer multipliers draw
+    # nothing, keeping the drop/delay/dup sequence alignment identical
+    # to a non-flood run with the same seed.
+    flood_mult: float = 1.0
+    flood_rooms: tuple = ()   # room rows to flood (empty = every room)
 
 
 @dataclass
@@ -53,6 +60,7 @@ class FaultStats:
     stalls: int = 0
     severed: int = 0
     killed: int = 0
+    flooded: int = 0          # extra packet copies staged by flood mode
 
 
 class FaultInjector:
@@ -76,6 +84,7 @@ class FaultInjector:
             seed=cfg.seed, drop_pct=cfg.drop_pct, dup_pct=cfg.dup_pct,
             delay_pct=cfg.delay_pct, delay_ticks=cfg.delay_ticks,
             stall_every=cfg.stall_every, stall_s=cfg.stall_s,
+            flood_mult=cfg.flood_mult, flood_rooms=tuple(cfg.flood_rooms),
         ))
 
     # -- ingest-boundary packet faults -----------------------------------
@@ -97,6 +106,23 @@ class FaultInjector:
             self.stats.duplicated += 1
             return "dup"
         return "pass"
+
+    def flood_copies(self, room: int) -> int:
+        """Extra copies to stage for one arriving packet in flood mode
+        (0 when disabled or the room is excluded). IngestBuffer.push
+        calls this once per ORIGINAL packet; a 4.0 multiplier returns 3
+        so original + copies = 4x offered load."""
+        s = self.spec
+        if s.flood_mult <= 1.0:
+            return 0
+        if s.flood_rooms and room not in s.flood_rooms:
+            return 0
+        extra = int(s.flood_mult) - 1
+        frac = s.flood_mult - int(s.flood_mult)
+        if frac > 0.0 and float(self.rng.random()) < frac:
+            extra += 1
+        self.stats.flooded += extra
+        return extra
 
     def take_due(self, tick_index: int) -> list:
         """Delayed packets whose release tick has arrived (drained by

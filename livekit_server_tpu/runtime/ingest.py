@@ -196,7 +196,15 @@ class IngestBuffer:
         self.dims = dims
         self.tick_ms = tick_ms
         R, T, K, S = dims
-        self.dropped = 0
+        # Drop accounting, split by cause so shedding metrics are
+        # trustworthy: capacity = tick slab overflow (real overload
+        # pressure), fault = chaos-injected loss (faultinject.py),
+        # policed = governor token-bucket shedding (intentional — must
+        # NOT read back as pressure). `dropped` below sums them for the
+        # pre-split readers (/debug/rooms, bench).
+        self.dropped_capacity = 0
+        self.dropped_fault = 0
+        self.dropped_policed = 0
         # Rows quiesced for migration: once a room's state snapshot is
         # taken, admitting more packets would advance munger offsets past
         # what the destination node restores (duplicate SNs on re-issue).
@@ -206,6 +214,15 @@ class IngestBuffer:
         # packets re-enter at the top of drain() for their release tick.
         self.fault = None
         self._fault_tick = 0
+        # Ingress policer (governor L2+): per-(room, track) token
+        # buckets, refilled at drain() so admission cost stays O(1) per
+        # packet. rate == 0 disables. `_police_video` holds a LIVE view
+        # of the runtime's is_video mirror when set — audio is exempt by
+        # construction (prioritized degradation: video sheds first).
+        self._police_rate = 0.0
+        self._police_burst = 0.0
+        self._police_tokens = np.zeros((R, T), np.float64)
+        self._police_video = None
         self._sets = (_StagingSet(dims), _StagingSet(dims))
         self._active = 0
         self._bind(self._sets[0])
@@ -264,23 +281,83 @@ class IngestBuffer:
         if s.needs_scrub:
             s.scrub()
 
-    def push(self, pkt: PacketIn, t_rx: float = 0.0, _fault_ok: bool = False) -> bool:
-        """Stage one packet; False (and counted) if the tick is full."""
+    @property
+    def dropped(self) -> int:
+        """Total drops across causes (back-compat reader; the split
+        counters are the trustworthy signal)."""
+        return self.dropped_capacity + self.dropped_fault + self.dropped_policed
+
+    def set_policer(
+        self, rate_pps: float, burst: float, is_video: np.ndarray | None = None
+    ) -> None:
+        """Arm the per-(room, track) ingress token buckets (governor L2).
+        `is_video` is held by reference — tracks whose flag is False
+        (audio) bypass the policer entirely."""
+        self._police_rate = float(rate_pps)
+        self._police_burst = float(burst)
+        self._police_tokens[:] = burst
+        self._police_video = is_video
+
+    def clear_policer(self) -> None:
+        self._police_rate = 0.0
+        self._police_video = None
+
+    @staticmethod
+    def _group_ranks(flat_rt: np.ndarray, n: int):
+        """Arrival-order rank of each packet within its (room, track)
+        group. Returns (order, sorted_rt, grp_start, sizes, ranks)."""
+        order = np.argsort(flat_rt, kind="stable")
+        sorted_rt = flat_rt[order]
+        grp_start = np.r_[0, np.nonzero(np.diff(sorted_rt))[0] + 1]
+        sizes = np.diff(np.r_[grp_start, n])
+        ranks = np.empty(n, np.int64)
+        ranks[order] = np.arange(n) - np.repeat(grp_start, sizes)
+        return order, sorted_rt, grp_start, sizes, ranks
+
+    def push(
+        self,
+        pkt: PacketIn,
+        t_rx: float = 0.0,
+        _fault_ok: bool = False,
+        _count_rx: bool = True,
+    ) -> bool:
+        """Stage one packet; False (and counted by cause) if shed."""
         if pkt.room in self.frozen_rows:
             return False  # mid-migration: the row's state is already shipped
+        r, t = pkt.room, pkt.track
+        # Receive accounting first: the packet arrived on the wire no
+        # matter what verdict follows (the old fault path returned before
+        # counting, skewing rates vs. capacity drops which counted after).
+        # drain()'s delayed-release re-entry passes _count_rx=False — its
+        # arrival was counted at the original push.
+        if _count_rx:
+            self.rx_pkts[r, t] += 1
+            self.rx_bytes[r, t] += pkt.size
         if self.fault is not None and not _fault_ok:
             verdict = self.fault.on_packet(pkt, self._fault_tick)
-            if verdict in ("drop", "delay"):
-                return False  # delayed packets re-enter via drain()
+            if verdict == "drop":
+                self.dropped_fault += 1
+                return False
+            if verdict == "delay":
+                return False  # not a drop: re-enters via drain() take_due
             if verdict == "dup":
                 self.push(pkt, t_rx, _fault_ok=True)
-        self.rx_pkts[pkt.room, pkt.track] += 1
-        self.rx_bytes[pkt.room, pkt.track] += pkt.size
-        k = self._count[pkt.room, pkt.track]
+            # Flood mode: stage seeded extra copies of this packet —
+            # reproducible offered-load multiplication for overload tests.
+            extra = self.fault.flood_copies(pkt.room)
+            for _ in range(extra):
+                self.push(pkt, t_rx, _fault_ok=True)
+        if self._police_rate > 0.0 and (
+            self._police_video is None or self._police_video[r, t]
+        ):
+            if self._police_tokens[r, t] < 1.0:
+                self.dropped_policed += 1
+                return False
+            self._police_tokens[r, t] -= 1.0
+        k = self._count[r, t]
         if k >= self.dims.pkts:
-            self.dropped += 1
+            self.dropped_capacity += 1
             return False
-        r, t = pkt.room, pkt.track
         self._count[r, t] = k + 1
         self.sn[r, t, k] = pkt.sn & 0xFFFF
         self.ts[r, t, k] = _wrap_i32(pkt.ts)
@@ -381,18 +458,50 @@ class IngestBuffer:
         np.add.at(self.rx_pkts.reshape(-1), flat_rt, 1)
         np.add.at(self.rx_bytes.reshape(-1), flat_rt, size.astype(np.int64))
         # Arrival-order rank within each (room, track) group.
-        order = np.argsort(flat_rt, kind="stable")
-        sorted_rt = flat_rt[order]
-        grp_start = np.r_[0, np.nonzero(np.diff(sorted_rt))[0] + 1]
-        sizes = np.diff(np.r_[grp_start, n])
-        ranks = np.empty(n, np.int64)
-        ranks[order] = np.arange(n) - np.repeat(grp_start, sizes)
+        order, sorted_rt, grp_start, sizes, ranks = self._group_ranks(flat_rt, n)
+        if self._police_rate > 0.0:
+            # Vectorized token buckets (same semantics as the scalar
+            # path): each group's first floor(tokens) non-exempt packets
+            # are admitted this batch; the rest are policed. Audio
+            # (is_video False) bypasses entirely.
+            tok = self._police_tokens.reshape(-1)
+            exempt = (
+                np.zeros(n, bool) if self._police_video is None
+                else ~self._police_video.reshape(-1)[flat_rt]
+            )
+            quota = np.floor(tok[flat_rt]).astype(np.int64)
+            pol = ~exempt & (ranks >= quota)
+            adm = ~exempt & ~pol
+            if adm.any():
+                np.subtract.at(tok, flat_rt[adm], 1.0)
+            n_pol = int(pol.sum())
+            if n_pol:
+                self.dropped_policed += n_pol
+                keep1 = ~pol
+                (room, track, layer, sn, ts, ts_aligned, temporal, keyframe,
+                 layer_sync, begin_pic, marker, pid, tl0, keyidx, size,
+                 frame_ms, audio_level, arrival_rtp, pay_start, pay_length,
+                 dd_start, dd_length, dd_version, end_frame) = (
+                    a[keep1] for a in (
+                        room, track, layer, sn, ts, ts_aligned, temporal,
+                        keyframe, layer_sync, begin_pic, marker, pid, tl0,
+                        keyidx, size, frame_ms, audio_level, arrival_rtp,
+                        pay_start, pay_length, dd_start, dd_length,
+                        dd_version, end_frame)
+                )
+                n = len(room)
+                if n == 0:
+                    return 0
+                flat_rt = room.astype(np.int64) * T + track
+                order, sorted_rt, grp_start, sizes, ranks = self._group_ranks(
+                    flat_rt, n
+                )
         base = self._count.reshape(-1)[flat_rt]
         k = base + ranks
         keep = k < K
         dropped = n - int(keep.sum())
         if dropped:
-            self.dropped += dropped
+            self.dropped_capacity += dropped
             (room, track, k, layer, sn, ts, ts_aligned, temporal, keyframe,
              layer_sync, begin_pic, end_frame, marker, pid, tl0, keyidx,
              size, frame_ms, audio_level, arrival_rtp, pay_start,
@@ -580,11 +689,20 @@ class IngestBuffer:
         of the retiring set; they are dead once packed, and the set is
         recycled at the next flip. Direct callers (tests, mesh staging)
         keep the default full-copy semantics."""
+        if self._police_rate > 0.0:
+            # Token refill: once per tick, clipped at the burst ceiling.
+            np.minimum(
+                self._police_tokens
+                + self._police_rate * (self.tick_ms / 1000.0),
+                self._police_burst,
+                out=self._police_tokens,
+            )
         if self.fault is not None:
             # Release held-back (delayed) packets whose tick has arrived:
-            # they stage now, so they ride THIS tick's tensors.
+            # they stage now, so they ride THIS tick's tensors. Their
+            # arrival was rx-counted at the original push.
             for pkt in self.fault.take_due(tick_index):
-                self.push(pkt, _fault_ok=True)
+                self.push(pkt, _fault_ok=True, _count_rx=False)
             self._fault_tick = tick_index + 1
         self._reorder_dedup()
         R, T, K, S = self.dims

@@ -329,6 +329,19 @@ class PlaneRuntime:
         self._ctrl_dirty = True          # full [R, T, S] upload needed
         self._dirty_rows: set[int] = set()
         self.ctrl_delta_max_rows = max(1, dims.rooms // 8)
+        # Governor shed overlay (runtime/governor.py): applied to the
+        # EFFECTIVE control tensors at upload time, never written into
+        # the authoritative `self.ctrl` mirrors — snapshots, failover
+        # restores, and recovery all keep every subscriber's true
+        # desired caps, and un-shedding is just a re-upload.
+        self.shed_spatial_cap = plane.MAX_LAYERS - 1   # no clamp
+        self.shed_pause_video = False
+        # Subscriptions exempt from the L3 video pause (screen-share /
+        # active-speaker pins via update_track_settings).
+        self.pinned = np.zeros((R, T, S), bool)
+        # Optional OverloadGovernor; None unless RoomManager attaches
+        # one. _complete feeds it each finished tick's verdict.
+        self.governor = None
 
         self.state = plane.init_state(dims)
         # Host-owned SN/TS/VP8 rewrite state (the round-5 decide-on-
@@ -429,6 +442,47 @@ class PlaneRuntime:
         self.ctrl.max_temporal[room, track, sub] = max_temporal
         self._dirty_rows.add(room)
 
+    def set_pinned(self, room: int, track: int, sub: int, pinned: bool) -> None:
+        """Exempt one subscription from the governor's L3 video pause
+        (screen shares, active speakers). Dirty-row like any ctrl edit:
+        the pin participates in the effective upload."""
+        self.pinned[room, track, sub] = pinned
+        self._dirty_rows.add(room)
+
+    def set_shed(self, *, spatial_cap: int | None = None,
+                 pause_video: bool | None = None) -> None:
+        """Governor actuator: set the shed overlay. A change forces a
+        full ctrl upload at the next tick edge — transitions are rare
+        (ladder moves), so the O(R·T·S) copy is fine; the authoritative
+        mirrors stay untouched."""
+        changed = False
+        if spatial_cap is not None and spatial_cap != self.shed_spatial_cap:
+            self.shed_spatial_cap = int(spatial_cap)
+            changed = True
+        if pause_video is not None and pause_video != self.shed_pause_video:
+            self.shed_pause_video = bool(pause_video)
+            changed = True
+        if changed:
+            self._ctrl_dirty = True
+
+    def _effective_ctrl(self) -> plane.SubControl:
+        """The SubControl actually uploaded: desired caps with the shed
+        overlay applied (spatial clamp; L3 mutes non-pinned video subs).
+        Reads only host mirrors — callable without the state lock."""
+        cap = self.shed_spatial_cap
+        if cap >= plane.MAX_LAYERS - 1 and not self.shed_pause_video:
+            return self.ctrl
+        sub_muted = self.ctrl.sub_muted
+        if self.shed_pause_video:
+            vid = (self.meta.is_video & self.meta.published)[:, :, None]
+            sub_muted = sub_muted | (vid & ~self.pinned)
+        return plane.SubControl(
+            subscribed=self.ctrl.subscribed,
+            sub_muted=sub_muted,
+            max_spatial=np.minimum(self.ctrl.max_spatial, cap),
+            max_temporal=self.ctrl.max_temporal,
+        )
+
     def clear_room(self, room: int) -> None:
         self.meta.published[room, :] = False
         self.meta.pub_muted[room, :] = False
@@ -476,7 +530,10 @@ class PlaneRuntime:
                 put = lambda x: jax.device_put(jnp.asarray(x), sharding)
             self.state = self.state._replace(
                 meta=jax.tree.map(lambda x: put(x.copy()), plane.TrackMeta(*self.meta)),
-                ctrl=jax.tree.map(lambda x: put(x.copy()), plane.SubControl(*self.ctrl)),
+                ctrl=jax.tree.map(
+                    lambda x: put(x.copy()),
+                    plane.SubControl(*self._effective_ctrl()),
+                ),
             )
             self.stats["ctrl_full_uploads"] += 1
         else:
@@ -484,7 +541,7 @@ class PlaneRuntime:
             # compiles once per bucket, not once per distinct count.
             pad_to = 1 << (len(rows) - 1).bit_length() if len(rows) > 1 else 1
             r, meta_rows, ctrl_rows = plane.pack_ctrl_rows(
-                self.meta, self.ctrl, rows, pad_to=pad_to
+                self.meta, self._effective_ctrl(), rows, pad_to=pad_to
             )
             self.state = self._apply_delta(self.state, r, meta_rows, ctrl_rows)
             self.stats["ctrl_delta_uploads"] += 1
@@ -633,6 +690,9 @@ class PlaneRuntime:
             "total_ms": round(result.tick_s * 1000.0, 3),
             "late": late,
         })
+        if self.governor is not None:
+            # Close the overload loop on the finished tick's verdict.
+            self.governor.on_tick(self.recent_ticks[-1])
         return result
 
     async def step_once(self) -> TickResult:

@@ -48,6 +48,7 @@ class PlaneSupervisor:
         check_interval_s: float = 0.1,
         checkpoint_interval_s: float = 2.0,
         max_restarts: int = 5,
+        overload_grace: float = 5.0,
         backoff: BackoffPolicy | None = None,
         telemetry=None,
         log: Logger | None = None,
@@ -58,6 +59,12 @@ class PlaneSupervisor:
         self.check_interval_s = check_interval_s
         self.checkpoint_interval_s = checkpoint_interval_s
         self.max_restarts = max_restarts
+        # Stall-deadline multiplier while the overload governor is
+        # engaged: a governed plane is slow BECAUSE it is shedding load,
+        # and a restart both loses the shed state and re-offers the full
+        # load to a cold plane — the restart-storm failure mode. Genuine
+        # no-progress still restarts once the widened deadline passes.
+        self.overload_grace = max(1.0, overload_grace)
         self.backoff = backoff or BackoffPolicy(base=0.1, max_delay=5.0)
         self.telemetry = telemetry
         self.log = log or Logger()
@@ -146,6 +153,13 @@ class PlaneSupervisor:
             if ticks > self._baseline_ticks
             else self.warmup_deadline_s
         )
+        # "Overloaded but making progress" is the governor's job, not
+        # ours: while it is engaged (level > 0) widen the stall deadline
+        # so load-induced lateness cannot trigger a restart storm. A
+        # truly wedged plane still trips the widened deadline.
+        gov = getattr(self.runtime, "governor", None)
+        if gov is not None and gov.level > 0 and ticks > self._baseline_ticks:
+            deadline = max(deadline, self.tick_deadline_s * self.overload_grace)
         if now - self._progress_at > deadline:
             return f"tick watchdog: no progress in {now - self._progress_at:.2f}s"
         return ""

@@ -115,6 +115,7 @@ class RoomManager:
                 check_interval_s=sup.check_interval_ms / 1000.0,
                 checkpoint_interval_s=sup.checkpoint_interval_s,
                 max_restarts=sup.max_restarts,
+                overload_grace=sup.overload_grace,
                 backoff=BackoffPolicy(
                     base=sup.restart_backoff_base_s, max_delay=sup.restart_backoff_max_s
                 ),
@@ -131,6 +132,19 @@ class RoomManager:
             self.fault = FaultInjector.from_config(config.faults)
             self.runtime.fault = self.fault
             self.runtime.ingest.fault = self.fault
+        # Overload governor (runtime/governor.py): closes the loop from
+        # tick telemetry to the degradation ladder. Attached to the
+        # runtime (per-tick sensor feed) and consulted by admission; the
+        # supervisor reads runtime.governor for its stall grace.
+        self.governor = None
+        self.admission_rejected: dict[str, int] = {}
+        if config.limits.governor_enabled:
+            from livekit_server_tpu.runtime.governor import OverloadGovernor
+
+            self.governor = OverloadGovernor.from_config(
+                self.runtime, config.limits, log=self.log
+            )
+            self.runtime.governor = self.governor
         router.on_new_session(self.start_session)
         self._update_node_stats()
 
@@ -149,10 +163,15 @@ class RoomManager:
             room = self.rooms.get(name)
             if room is not None:
                 return room
+            reason = self._admission_denied("room")
+            if reason:
+                raise CapacityError(reason)
             stored = await self.store.load_room(name)
             room = Room(name, self.runtime, info=info or stored)
             room.udp = self.udp
             room.crypto = self.crypto
+            # Publish-admission gate consulted by Participant.add_track_request.
+            room.admission = self._admission_denied
             if info is None and stored is None:
                 room.info.empty_timeout = self.config.room.empty_timeout_s
                 room.info.departure_timeout = self.config.room.departure_timeout_s
@@ -210,12 +229,14 @@ class RoomManager:
     ) -> None:
         try:
             room = await self.get_or_create_room(room_name)
-        except CapacityError:
-            # Node room tensor full: reject explicitly (the reference sends
-            # a limits-reached error; a silent open WebSocket is the
-            # failure ADVICE flagged). The sink close lets rtcservice's
-            # pump end the connection.
-            self._reject_session(response_sink, request_source)
+        except CapacityError as e:
+            # Node room tensor full or admission refused: reject
+            # explicitly (the reference sends a limits-reached error; a
+            # silent open WebSocket is the failure ADVICE flagged). The
+            # sink close lets rtcservice's pump end the connection.
+            self._reject_session(
+                response_sink, request_source, str(e) or "node at capacity"
+            )
             return
         identity = init.get("identity", "")
 
@@ -243,6 +264,12 @@ class RoomManager:
             await self._session_worker(room, existing, request_source)
             return
 
+        # Node admission (after resume handling: an existing session may
+        # always resume — the governor only refuses NEW load).
+        reason = self._admission_denied("join")
+        if reason:
+            self._reject_session(response_sink, request_source, reason)
+            return
         # A same-identity rejoin replaces its old session (room.join kicks
         # the duplicate), so it must not count toward the cap.
         max_p = room.info.max_participants
@@ -320,6 +347,38 @@ class RoomManager:
                     room=room.info.to_dict(),
                     participant=participant.to_info().to_dict(),
                 )
+
+    def _admission_denied(self, kind: str) -> str:
+        """Non-empty rejection reason when the node must refuse new work
+        of `kind` ('room' / 'join' / 'publish') — the config.go
+        LimitConfig seat plus the governor's L4. Every refusal is
+        explicit (signal response) and counted; existing sessions are
+        never evicted by any of these gates."""
+        lim = self.config.limits
+        st = self.router.local_node.stats
+        reason = ""
+        if self.governor is not None and not self.governor.should_admit(kind):
+            reason = "node overloaded"
+        elif kind == "room" and lim.max_rooms and len(self.rooms) >= lim.max_rooms:
+            reason = "max rooms on node"
+        elif kind == "publish" and lim.num_tracks and (
+            sum(len(r.tracks) for r in self.rooms.values()) >= lim.num_tracks
+        ):
+            reason = "max tracks on node"
+        elif kind in ("join", "publish") and (
+            lim.packets_per_sec and st.packets_in_per_sec > lim.packets_per_sec
+        ):
+            reason = "node ingress packet rate exceeded"
+        elif kind in ("join", "publish") and (
+            lim.bytes_per_sec and st.bytes_in_per_sec > lim.bytes_per_sec
+        ):
+            reason = "node ingress byte rate exceeded"
+        if reason:
+            self.admission_rejected[kind] = self.admission_rejected.get(kind, 0) + 1
+            if self.governor is not None:
+                self.governor.note_rejection(kind)
+            self.log.warn("admission refused", kind=kind, reason=reason)
+        return reason
 
     def _reject_session(
         self,
@@ -588,6 +647,8 @@ class RoomManager:
             self.telemetry.observe_tick_latency(res.tick_s)
             if self.udp is not None:
                 self.telemetry.observe_transport(self.udp.stats)
+            if self.governor is not None:
+                self.telemetry.observe_overload(self.governor.stats_dict())
 
     # -- periodic reaping (server.go backgroundWorker) --------------------
     def start(self) -> None:

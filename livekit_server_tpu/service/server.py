@@ -76,6 +76,7 @@ class LivekitServer:
         self.app.router.add_get("/debug/analytics", self.debug_analytics)
         self.app.router.add_get("/debug/tasks", self.debug_tasks)
         self.app.router.add_get("/debug/ticks", self.debug_ticks)
+        self.app.router.add_get("/debug/overload", self.debug_overload)
         self._runner: web.AppRunner | None = None
         self._sites: list[web.TCPSite] = []
         self._stats_task: asyncio.Task | None = None
@@ -196,8 +197,41 @@ class LivekitServer:
         if bus is not None and hasattr(bus, "retries"):
             self.telemetry.set_gauge("livekit_bus_retries_total", bus.retries)
             self.telemetry.set_gauge("livekit_bus_reconnects_total", bus.reconnects)
+        self.telemetry.observe_queue_drops()
         return web.Response(
             text=self.telemetry.prometheus_text(), content_type="text/plain"
+        )
+
+    async def debug_overload(self, request: web.Request) -> web.Response:
+        """Overload-governor state: ladder level, recent transitions,
+        split ingest drop counters, admission rejections, bus/signal
+        back-pressure drops, and the active limits."""
+        from dataclasses import asdict
+
+        from livekit_server_tpu.routing.kv import Subscription
+        from livekit_server_tpu.routing.messagechannel import MessageChannel
+
+        rm = self.room_manager
+        gov = rm.governor
+        ing = rm.runtime.ingest
+        return web.json_response(
+            {
+                "governor": gov.snapshot() if gov is not None else None,
+                "ingest": {
+                    "dropped_capacity": ing.dropped_capacity,
+                    "dropped_fault": ing.dropped_fault,
+                    "dropped_policed": ing.dropped_policed,
+                },
+                "admission_rejected": dict(rm.admission_rejected),
+                "queue_drops": {
+                    "signal_channel": MessageChannel.total_dropped,
+                    "bus_subscription": Subscription.total_dropped,
+                },
+                "supervisor_restarts": (
+                    rm.supervisor.restarts if rm.supervisor is not None else 0
+                ),
+                "limits": asdict(self.config.limits),
+            }
         )
 
     async def debug_analytics(self, request: web.Request) -> web.Response:

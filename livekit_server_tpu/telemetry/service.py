@@ -119,6 +119,38 @@ class TelemetryService:
                   "ctrl_delta_rows", "ctrl_upload_bytes"):
             self.set_gauge(f"livekit_plane_{k}_total", stats.get(k, 0))
 
+    def observe_overload(self, snap: dict[str, Any]) -> None:
+        """Overload-governor state (runtime/governor.py stats_dict):
+        ladder level, transition counts, the split ingest drop counters,
+        and admission rejections by kind."""
+        self.set_gauge("livekit_governor_level", snap.get("level", 0))
+        self.set_gauge(
+            "livekit_governor_escalations_total", snap.get("escalations", 0)
+        )
+        self.set_gauge(
+            "livekit_governor_transitions_total", snap.get("transitions_total", 0)
+        )
+        for k in ("dropped_capacity", "dropped_fault", "dropped_policed"):
+            self.set_gauge(f"livekit_ingest_{k}_total", snap.get(k, 0))
+        for kind, n in snap.get("rejected", {}).items():
+            self.set_gauge(
+                "livekit_admission_rejected_total", n, kind=str(kind)
+            )
+
+    def observe_queue_drops(self) -> None:
+        """Bus/signal back-pressure drops (the QueueFull paths that used
+        to lose messages with at most a local count): process-wide
+        class counters read at scrape/tick time."""
+        from livekit_server_tpu.routing.kv import Subscription
+        from livekit_server_tpu.routing.messagechannel import MessageChannel
+
+        self.set_gauge(
+            "livekit_signal_channel_dropped_total", MessageChannel.total_dropped
+        )
+        self.set_gauge(
+            "livekit_bus_sub_dropped_total", Subscription.total_dropped
+        )
+
     def observe_transport(self, stats: dict[str, Any]) -> None:
         """UDP/TCP media-wire counters (prometheus/packets.go direction
         labels: rx/tx, plus NACK/PLI/RTX feedback volumes)."""

@@ -20,6 +20,7 @@ from livekit_server_tpu.analysis import (
     gc02,
     gc03,
     gc04,
+    gc05,
     diff_baseline,
     load_project,
     run_all,
@@ -319,6 +320,66 @@ def test_gc04_bare_retry_loop(tmp_path):
 def test_gc04_retry_async_managed(tmp_path):
     project = make_project(tmp_path, {"pkg/bus.py": GC04_GOOD})
     assert gc04.run(project, cfg_for("gc04")) == []
+
+
+# -- GC05 bounded queues ----------------------------------------------------
+
+GC05_FIXTURE = """\
+    import asyncio
+    from collections import deque
+
+    class Buffers:
+        def __init__(self):
+            self.a = asyncio.Queue()              # line 6: no bound
+            self.b = asyncio.Queue(maxsize=0)     # line 7: literal unbounded
+            self.c = deque()                      # line 8: no bound
+            self.d = deque(maxlen=None)           # line 9: literal unbounded
+            self.e = asyncio.Queue(maxsize=8)     # bounded: OK
+            self.f = asyncio.Queue(8)             # positional bound: OK
+            self.g = deque(maxlen=16)             # bounded: OK
+            self.h = deque([], 16)                # positional bound: OK
+"""
+
+
+def test_gc05_fixture(tmp_path):
+    project = make_project(tmp_path, {"pkg/buf.py": GC05_FIXTURE})
+    findings = gc05.run(project, cfg_for("gc05"))
+    assert all(f.rule == "GC05" for f in findings)
+    assert lines_of(findings, "GC05") == [6, 7, 8, 9]
+
+
+def test_gc05_distinguishes_missing_from_zero(tmp_path):
+    project = make_project(tmp_path, {"pkg/buf.py": GC05_FIXTURE})
+    by_line = {f.line: f.message for f in gc05.run(project, cfg_for("gc05"))}
+    assert "no maxsize= given" in by_line[6]
+    assert "literally unbounded" in by_line[7]
+    assert "no maxlen= given" in by_line[8]
+    assert "literally unbounded" in by_line[9]
+
+
+def test_gc05_inline_disable(tmp_path):
+    suppressed = GC05_FIXTURE.replace(
+        "# line 6: no bound", "# graftcheck: disable=GC05"
+    ).replace(
+        "# line 7: literal unbounded", "# graftcheck: disable=GC05"
+    ).replace(
+        "# line 8: no bound", "# graftcheck: disable=GC05"
+    ).replace(
+        "# line 9: literal unbounded", "# graftcheck: disable=GC05"
+    )
+    project = make_project(tmp_path, {"pkg/buf.py": suppressed})
+    assert lines_of(run_all_pkg(project), "GC05") == []
+
+
+def test_gc05_kwargs_splat_not_flagged(tmp_path):
+    src = """\
+        import asyncio
+
+        def make(**kw):
+            return asyncio.Queue(**kw)   # bound unknowable statically
+    """
+    project = make_project(tmp_path, {"pkg/buf.py": src})
+    assert gc05.run(project, cfg_for("gc05")) == []
 
 
 # -- suppressions -----------------------------------------------------------
