@@ -18,7 +18,7 @@ from typing import Callable
 
 # -- finding model ----------------------------------------------------------
 
-RULES = ("GC01", "GC02", "GC03", "GC04", "GC05")
+RULES = ("GC01", "GC02", "GC03", "GC04", "GC05", "GC06")
 
 # Parse/config failures surface as findings too (rule GC00) so the runner
 # has one reporting path; compileall in tools/check.py catches the rest.
@@ -163,11 +163,13 @@ DEFAULT_CONFIG: dict = {
         # overlapped with the in-flight device step.
         "state_methods": [
             "snapshot", "snapshot_room", "restore", "restore_room",
-            "_upload_ctrl", "_device_step",
+            "repair_room_row", "_upload_ctrl", "_device_step",
         ],
         "lock_names": ["state_lock"],
         # lock-held-by-contract: bodies may touch state because every
-        # caller holds state_lock (enforced via the state_methods check)
+        # caller holds state_lock (enforced via the state_methods check).
+        # IntegrityMonitor.maybe_audit and FaultInjector.maybe_bitflip run
+        # inside _device_step (itself lock-held) on the worker thread.
         "lock_held": [
             "PlaneRuntime.__init__",
             "PlaneRuntime._upload_ctrl",
@@ -176,6 +178,9 @@ DEFAULT_CONFIG: dict = {
             "PlaneRuntime.snapshot_room",
             "PlaneRuntime.restore",
             "PlaneRuntime.restore_room",
+            "PlaneRuntime.repair_room_row",
+            "IntegrityMonitor.maybe_audit",
+            "FaultInjector.maybe_bitflip",
         ],
     },
     "gc02": {
@@ -228,6 +233,28 @@ DEFAULT_CONFIG: dict = {
         "queue_calls": ["Queue", "LifoQueue", "PriorityQueue"],
         "deque_calls": ["deque"],
     },
+    "gc06": {
+        # Checkpoint-bearing modules: where serialized state meets the KV
+        # bus or the supervisor's snapshot store.
+        "paths": [
+            "livekit_server_tpu/runtime/plane_runtime.py",
+            "livekit_server_tpu/runtime/supervisor.py",
+            "livekit_server_tpu/runtime/integrity.py",
+            "livekit_server_tpu/service/roommanager.py",
+            "livekit_server_tpu/service/store.py",
+            "livekit_server_tpu/routing",
+        ],
+        "exempt": ["livekit_server_tpu/utils/checksum.py"],
+        "serializer_calls": [
+            "pickle.dumps", "pickle.dump", "marshal.dumps", "marshal.dump",
+            "numpy.save", "np.save",
+        ],
+        "serializer_tails": ["savez", "savez_compressed", "tobytes"],
+        "codec_calls": [
+            "encode_frame", "encode_frame_b64",
+            "decode_frame", "decode_frame_b64",
+        ],
+    },
 }
 
 
@@ -278,7 +305,7 @@ def run_all(
     project: Project, config: Config, rules: list[str] | None = None
 ) -> list[Finding]:
     """Run the analyzers, apply per-line/file suppressions, sort."""
-    from livekit_server_tpu.analysis import gc01, gc02, gc03, gc04, gc05
+    from livekit_server_tpu.analysis import gc01, gc02, gc03, gc04, gc05, gc06
 
     impls: dict[str, Callable[[Project, dict], list[Finding]]] = {
         "GC01": gc01.run,
@@ -286,6 +313,7 @@ def run_all(
         "GC03": gc03.run,
         "GC04": gc04.run,
         "GC05": gc05.run,
+        "GC06": gc06.run,
     }
     findings: list[Finding] = []
     for f in project.files:

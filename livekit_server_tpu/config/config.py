@@ -230,6 +230,39 @@ class FaultInjectConfig:
     # arriving packet; <= 1.0 = off) for reproducible overload.
     flood_mult: float = 1.0
     flood_rooms: list[int] = field(default_factory=list)  # [] = all rooms
+    # Silent-data-corruption mode: flip bits in one room's slice of a
+    # PlaneState leaf right before the device step at a chosen tick
+    # (-1 = never). Drives the integrity detect→quarantine→repair ladder.
+    bitflip_tick: int = -1
+    bitflip_room: int = 0
+    bitflip_leaf: str = "temporal_bytes"   # dotted path into PlaneState
+    bitflip_bit: int = 30                  # bit index within each element
+    bitflip_count: int = 1                 # elements flipped in the row
+    # Damage every Nth serialized checkpoint frame (0 = never): exercises
+    # checksum verification + generation fallback on restore.
+    corrupt_ckpt_every: int = 0
+
+
+@dataclass
+class IntegrityConfig:
+    """State-integrity plane (runtime/integrity.py): on-device invariant
+    audits on a tick cadence, row-level quarantine + repair from the last
+    verified checkpoint, bounded escalation to a supervisor restart."""
+
+    enabled: bool = True
+    # Audit every Nth tick. The audit is one fused jitted reduction over
+    # the plane state; 16 keeps its amortized cost well under 1% of tick
+    # time while bounding detection latency to N ticks.
+    audit_every_ticks: int = 16
+    # Row-repair attempts per room before escalating to a full plane
+    # restart (attempts reset once the room audits clean).
+    max_row_repairs: int = 3
+    # More rooms than this flagged by ONE audit ⇒ the corruption is not
+    # row-local (bad upload, poisoned kernel): skip row repair, restart.
+    storm_threshold: int = 4
+    # Verified checkpoint generations the supervisor retains; corrupt
+    # frames fall back a generation at restore.
+    checkpoint_generations: int = 3
 
 
 @dataclass
@@ -275,6 +308,7 @@ class Config:
     webhook: WebHookConfig = field(default_factory=WebHookConfig)
     supervisor: SupervisorConfig = field(default_factory=SupervisorConfig)
     faults: FaultInjectConfig = field(default_factory=FaultInjectConfig)
+    integrity: IntegrityConfig = field(default_factory=IntegrityConfig)
 
 
 _SCALARS = (int, float, str, bool)
@@ -416,6 +450,21 @@ def _validate(cfg: Config) -> None:
         raise ConfigError("faults.drop_pct + dup_pct + delay_pct must be <= 1")
     if f.flood_mult < 0.0:
         raise ConfigError(f"faults.flood_mult must be >= 0, got {f.flood_mult}")
+    if not 0 <= f.bitflip_bit <= 31:
+        raise ConfigError(f"faults.bitflip_bit must be in [0, 31], got {f.bitflip_bit}")
+    if f.bitflip_count <= 0:
+        raise ConfigError(f"faults.bitflip_count must be positive, got {f.bitflip_count}")
+    if f.bitflip_room < 0:
+        raise ConfigError(f"faults.bitflip_room must be >= 0, got {f.bitflip_room}")
+    if f.corrupt_ckpt_every < 0:
+        raise ConfigError(
+            f"faults.corrupt_ckpt_every must be >= 0, got {f.corrupt_ckpt_every}"
+        )
+    integ = cfg.integrity
+    for name in ("audit_every_ticks", "max_row_repairs", "storm_threshold",
+                 "checkpoint_generations"):
+        if getattr(integ, name) <= 0:
+            raise ConfigError(f"integrity.{name} must be positive")
     if cfg.supervisor.tick_deadline_ms <= 0:
         raise ConfigError("supervisor.tick_deadline_ms must be positive")
     if cfg.supervisor.overload_grace < 1.0:

@@ -50,6 +50,20 @@ class FaultSpec:
     # to a non-flood run with the same seed.
     flood_mult: float = 1.0
     flood_rooms: tuple = ()   # room rows to flood (empty = every room)
+    # Silent-data-corruption mode: flip bits in one room's slice of a
+    # chosen PlaneState leaf right before the device step at bitflip_tick
+    # (-1 = never). Element choice draws from a SEPARATE seeded rng so
+    # the packet-fault draw sequence stays alignment-identical to a
+    # no-bitflip run with the same seed.
+    bitflip_tick: int = -1
+    bitflip_room: int = 0
+    bitflip_leaf: str = "temporal_bytes"  # dotted path into PlaneState
+    bitflip_bit: int = 30     # bit index within each element's word
+    bitflip_count: int = 1    # elements flipped in the chosen row
+    # Checkpoint corruption: damage every Nth serialized checkpoint frame
+    # past its header (0 = never), so restore paths must catch it via
+    # checksum verification, not a deserialize crash.
+    corrupt_ckpt_every: int = 0
 
 
 @dataclass
@@ -61,6 +75,8 @@ class FaultStats:
     severed: int = 0
     killed: int = 0
     flooded: int = 0          # extra packet copies staged by flood mode
+    bitflips: int = 0         # state elements corrupted by bitflip mode
+    ckpt_corrupted: int = 0   # checkpoint frames damaged after encoding
 
 
 class FaultInjector:
@@ -73,10 +89,14 @@ class FaultInjector:
             spec = FaultSpec(**{**vars(spec), **overrides})
         self.spec = spec
         self.rng = np.random.default_rng(spec.seed)
+        # Separate stream for bitflip element choice: corruption faults
+        # must not perturb the packet-fault draw alignment.
+        self._sdc_rng = np.random.default_rng(spec.seed ^ 0x5DC5DC)
         self.stats = FaultStats()
         # release_tick → [PacketIn]; drained by take_due() at tick edges.
         self._held: dict[int, list] = {}
         self._step_count = 0
+        self._ckpt_count = 0
 
     @classmethod
     def from_config(cls, cfg) -> "FaultInjector":
@@ -85,6 +105,10 @@ class FaultInjector:
             delay_pct=cfg.delay_pct, delay_ticks=cfg.delay_ticks,
             stall_every=cfg.stall_every, stall_s=cfg.stall_s,
             flood_mult=cfg.flood_mult, flood_rooms=tuple(cfg.flood_rooms),
+            bitflip_tick=cfg.bitflip_tick, bitflip_room=cfg.bitflip_room,
+            bitflip_leaf=cfg.bitflip_leaf, bitflip_bit=cfg.bitflip_bit,
+            bitflip_count=cfg.bitflip_count,
+            corrupt_ckpt_every=cfg.corrupt_ckpt_every,
         ))
 
     # -- ingest-boundary packet faults -----------------------------------
@@ -145,6 +169,59 @@ class FaultInjector:
             self.stats.stalls += 1
             time.sleep(s.stall_s)
 
+    # -- silent data corruption -------------------------------------------
+    def maybe_bitflip(self, runtime, tick_index: int) -> None:
+        """Flip bits in one room's slice of the configured state leaf at
+        the configured tick — the SDC event the integrity audit exists to
+        catch. Called from PlaneRuntime._device_step on the worker thread
+        right before the step; the caller holds state_lock (GC01)."""
+        s = self.spec
+        if s.bitflip_tick < 0 or tick_index != s.bitflip_tick:
+            return
+        import jax.numpy as jnp
+
+        leaf = runtime.state
+        for part in s.bitflip_leaf.split("."):
+            leaf = getattr(leaf, part)
+        row = np.array(leaf[s.bitflip_room])
+        flat = row.reshape(-1)
+        itemsize = flat.dtype.itemsize
+        if itemsize == 4:
+            words = flat.view(np.uint32)
+            bit = np.uint32(1 << (s.bitflip_bit % 32))
+        else:  # bool / int8 leaves: flip within the byte
+            words = flat.view(np.uint8)
+            bit = np.uint8(1 << (s.bitflip_bit % 8))
+        n = min(max(1, s.bitflip_count), words.size)
+        idx = self._sdc_rng.choice(words.size, size=n, replace=False)
+        words[idx] ^= bit
+        new_leaf = leaf.at[s.bitflip_room].set(jnp.asarray(row, leaf.dtype))
+        runtime.state = _replace_leaf(runtime.state, s.bitflip_leaf, new_leaf)
+        self.stats.bitflips += n
+
+    def corrupt_ckpt(self, blob):
+        """Damage every Nth encoded checkpoint (bytes or b64 str) at a
+        deterministic offset PAST the frame header: the magic/version
+        survive, so only CRC verification can catch the damage."""
+        s = self.spec
+        if s.corrupt_ckpt_every <= 0:
+            return blob
+        self._ckpt_count += 1
+        if self._ckpt_count % s.corrupt_ckpt_every:
+            return blob
+        self.stats.ckpt_corrupted += 1
+        if isinstance(blob, str):
+            # b64 text (KV-bus room checkpoints): the 20-byte header spans
+            # the first 28 chars; swap one payload char for a different
+            # valid b64 char so decode succeeds but the CRC does not.
+            pos = 28 + (self._ckpt_count * 7919) % max(1, len(blob) - 30)
+            repl = "A" if blob[pos] != "A" else "B"
+            return blob[:pos] + repl + blob[pos + 1:]
+        pos = 20 + (self._ckpt_count * 7919) % max(1, len(blob) - 21)
+        out = bytearray(blob)
+        out[pos] ^= 0xFF
+        return bytes(out)
+
     # -- infrastructure faults (chaos-test helpers) ----------------------
     def sever_bus(self, client) -> None:
         """Hard-drop a TCPBusClient's socket (no FIN handshake): in-flight
@@ -181,3 +258,16 @@ class FaultInjector:
         if bus is not None and hasattr(bus, "_writer"):
             bus.closed = True  # suppress the reconnect loop: the node is dead
             self.sever_bus(bus)
+
+
+def _replace_leaf(tree, path: str, value):
+    """Rebuild a NamedTuple pytree with the leaf at dotted `path` swapped."""
+    parts = path.split(".")
+
+    def rec(node, i: int):
+        if i == len(parts) - 1:
+            return node._replace(**{parts[i]: value})
+        child = getattr(node, parts[i])
+        return node._replace(**{parts[i]: rec(child, i + 1)})
+
+    return rec(tree, 0)

@@ -385,6 +385,11 @@ class PlaneRuntime:
         # Optional FaultInjector (runtime/faultinject.py); None on the
         # default config path — chaos tests and soak runs attach one.
         self.fault = None
+        # Optional IntegrityMonitor (runtime/integrity.py); None unless
+        # RoomManager attaches one. _device_step runs its audit on the
+        # cadence; _complete drains its row-repair queue; quarantined
+        # rows are masked at fan-out and muted in the effective ctrl.
+        self.integrity = None
         # Guards self.state across the donated device step vs. host-side
         # snapshot/restore (room migration): donation deletes the old
         # buffers mid-step, so concurrent readers would see dead arrays.
@@ -467,15 +472,29 @@ class PlaneRuntime:
 
     def _effective_ctrl(self) -> plane.SubControl:
         """The SubControl actually uploaded: desired caps with the shed
-        overlay applied (spatial clamp; L3 mutes non-pinned video subs).
-        Reads only host mirrors — callable without the state lock."""
+        overlay applied (spatial clamp; L3 mutes non-pinned video subs)
+        and integrity-quarantined rooms fully muted. Reads only host
+        mirrors — callable without the state lock."""
         cap = self.shed_spatial_cap
-        if cap >= plane.MAX_LAYERS - 1 and not self.shed_pause_video:
+        quarantined = (
+            self.integrity.quarantined if self.integrity is not None else None
+        )
+        if (
+            cap >= plane.MAX_LAYERS - 1
+            and not self.shed_pause_video
+            and not quarantined
+        ):
             return self.ctrl
         sub_muted = self.ctrl.sub_muted
         if self.shed_pause_video:
             vid = (self.meta.is_video & self.meta.published)[:, :, None]
             sub_muted = sub_muted | (vid & ~self.pinned)
+        if quarantined:
+            # Quarantine mutes the WHOLE flagged room row (its state is
+            # suspect end to end); other rooms keep full audio + video.
+            qmask = np.zeros_like(self.ctrl.sub_muted)
+            qmask[sorted(quarantined)] = True
+            sub_muted = sub_muted | qmask
         return plane.SubControl(
             subscribed=self.ctrl.subscribed,
             sub_muted=sub_muted,
@@ -566,6 +585,8 @@ class PlaneRuntime:
             self.fault.maybe_stall()
         if epoch != self.run_epoch:
             return None
+        if self.fault is not None:
+            self.fault.maybe_bitflip(self, st.idx)
         if self._mesh is not None:
             state, out = self._step(self.state, st.inp)
             out = jax.tree.map(np.asarray, out)
@@ -577,6 +598,10 @@ class PlaneRuntime:
         if epoch != self.run_epoch:
             return None  # restarted mid-step: result belongs to a dead run
         self.state = state
+        if self.integrity is not None:
+            # Audit the committed state on the cadence; the fetched mask
+            # is a few dozen bytes riding the same device sync as `out`.
+            self.integrity.maybe_audit(st.idx)
         st.device_s = time.perf_counter() - t0
         return out
 
@@ -730,7 +755,11 @@ class PlaneRuntime:
             raise asyncio.CancelledError("device step abandoned by restart")
         self._mirror_probe_inputs(out)
         self.ingest.scrub_retired()
-        return await self._complete(out, st)
+        result = await self._complete(out, st)
+        if self.integrity is not None:
+            # Sequential path: repair right after the tick that audited.
+            await self.integrity.process()
+        return result
 
     def resolve_nacks(self, room: int, sub: int, track: int, sns) -> list[EgressPacket]:
         """NACKed munged SNs → replay EgressPackets, at RTCP time (the
@@ -812,11 +841,31 @@ class PlaneRuntime:
         # offset state (the rewrite half of DownTrack.WriteRTP,
         # rtpmunger.go + codecmunger/vp8.go) — via the native C++ walker
         # when built, numpy otherwise.
+        send_bits, drop_bits, switch_bits = (
+            out.send_bits, out.drop_bits, out.switch_bits,
+        )
+        if self.integrity is not None and self.integrity.quarantined:
+            # Same-tick quarantine: a room flagged by THIS tick's audit
+            # must not fan out its (suspect) sends even once — the ctrl
+            # mute only lands at the next upload edge. Zeroing the row's
+            # egress bits also freezes its munger lanes at their last
+            # good values, exactly like a migration freeze.
+            rows = [
+                r for r in self.integrity.quarantined
+                if r < send_bits.shape[0]
+            ]
+            if rows:
+                send_bits = np.array(send_bits)
+                drop_bits = np.array(drop_bits)
+                switch_bits = np.array(switch_bits)
+                send_bits[rows] = 0
+                drop_bits[rows] = 0
+                switch_bits[rows] = 0
         rr, tt, kk, ss, b_sn, b_ts, b_pid, b_tl0, b_ki = (
             self.munger.apply_columns(
                 inp.sn, inp.ts, inp.ts_jump, inp.pid, inp.tl0, inp.keyidx,
                 inp.begin_pic, inp.valid,
-                out.send_bits, out.drop_bits, out.switch_bits,
+                send_bits, drop_bits, switch_bits,
             )
         )
         batch = EgressBatch(
@@ -928,6 +977,12 @@ class PlaneRuntime:
         try:
             while True:
                 await self._sleep_until(next_at)
+                if self.integrity is not None:
+                    # Drain the row-repair queue filled by the last audit,
+                    # at the window edge and OUTSIDE the lock region below:
+                    # each repair takes state_lock itself, and the repaired
+                    # row's dirtied ctrl re-uploads in this very tick.
+                    await self.integrity.process()
                 if pending_task is not None:
                     # Backpressure: previous fan-out still running ⇒ wait
                     # (sequential under overload; no unbounded queue).
@@ -1048,23 +1103,131 @@ class PlaneRuntime:
 
     @staticmethod
     def encode_room_snapshot(snap: dict[str, Any]) -> str:
-        """Room snapshot → base64 npz string (rides the KV bus)."""
-        import base64
+        """Room snapshot → checksummed npz frame, base64 (rides the KV
+        bus). The utils/checksum frame (GC06) lets every restore path
+        verify the bytes before any `.at[]` scatter."""
         import io
+
+        from livekit_server_tpu.utils import checksum
 
         buf = io.BytesIO()
         np.savez_compressed(buf, *snap["arrays"])
-        return base64.b64encode(buf.getvalue()).decode()
+        return checksum.encode_frame_b64(buf.getvalue())
 
     @staticmethod
     def decode_room_snapshot(payload: str) -> dict[str, Any]:
-        import base64
+        """Verify + decode a room checkpoint; raises ChecksumError on a
+        corrupt frame BEFORE np.load touches the bytes."""
         import io
 
-        z = np.load(io.BytesIO(base64.b64decode(payload)))
+        from livekit_server_tpu.utils import checksum
+
+        z = np.load(io.BytesIO(checksum.decode_frame_b64(payload)))
         # savez names leaves arr_0..arr_N; z.files sorts lexically (arr_10
         # before arr_2), so index numerically.
         return {"arrays": [z[f"arr_{i}"] for i in range(len(z.files))]}
+
+    @staticmethod
+    def encode_snapshot(snap: dict[str, Any]) -> bytes:
+        """Full-plane snapshot → checksummed npz frame (the supervisor's
+        checkpoint-generation format)."""
+        import io
+
+        from livekit_server_tpu.utils import checksum
+
+        arrays = list(snap["arrays"]) + list(snap.get("munger", []))
+        buf = io.BytesIO()
+        np.savez_compressed(
+            buf, *arrays,
+            tick_index=np.int64(snap["tick_index"]),
+            n_state=np.int64(len(snap["arrays"])),
+        )
+        return checksum.encode_frame(buf.getvalue())
+
+    @staticmethod
+    def decode_snapshot(blob: bytes) -> dict[str, Any]:
+        """Verify + decode a full-plane checkpoint into the snapshot()
+        dict shape; ChecksumError on corruption, ValueError/KeyError on a
+        malformed archive."""
+        import io
+
+        from livekit_server_tpu.utils import checksum
+
+        z = np.load(io.BytesIO(checksum.decode_frame(blob)))
+        n_arrays = sum(1 for f in z.files if f.startswith("arr_"))
+        n_state = int(z["n_state"])
+        arrays = [z[f"arr_{i}"] for i in range(n_arrays)]
+        return {
+            "tick_index": int(z["tick_index"]),
+            "arrays": arrays[:n_state],
+            "munger": arrays[n_state:],
+        }
+
+    def _check_row_leaves(self, flat: list, arrays: list) -> None:
+        """Validate a row snapshot's leaves against the LIVE plane spec
+        (count, per-leaf row shape, dtype compatibility) before anything
+        scatters into donated device state."""
+        n_munger = len(HostMunger.FIELDS)
+        if len(arrays) != len(flat) + n_munger:
+            raise ValueError(
+                f"snapshot has {len(arrays)} leaves, plane has "
+                f"{len(flat)} + {n_munger} munger fields — "
+                f"source/destination plane versions differ"
+            )
+        for i, (leaf, a) in enumerate(zip(flat, arrays)):
+            a = np.asarray(a)
+            want = tuple(leaf.shape[1:])
+            if tuple(a.shape) != want:
+                raise ValueError(
+                    f"snapshot leaf {i} row shape {tuple(a.shape)} != "
+                    f"plane row shape {want} — dims mismatch"
+                )
+            if not np.can_cast(a.dtype, np.dtype(leaf.dtype), casting="same_kind"):
+                raise ValueError(
+                    f"snapshot leaf {i} dtype {a.dtype} incompatible with "
+                    f"plane dtype {np.dtype(leaf.dtype)}"
+                )
+
+    @staticmethod
+    def row_snapshot_from_full(snap: dict[str, Any], row: int) -> dict[str, Any]:
+        """Slice one room's row out of a FULL snapshot() dict, in the
+        snapshot_room() wire shape (state leaves then munger fields) —
+        how the integrity monitor turns the supervisor's last verified
+        checkpoint into a row-repair payload."""
+        return {
+            "arrays": [np.asarray(a[row]) for a in snap["arrays"]]
+            + [np.asarray(m[row]) for m in snap.get("munger", [])]
+        }
+
+    def repair_room_row(self, row: int, snap: dict[str, Any]) -> None:
+        """Integrity row repair: overwrite ONE corrupt room row from a
+        verified checkpoint, in place, without disturbing any other row.
+
+        Unlike restore_room (cross-node migration), the HOST mirrors stay
+        authoritative: this node's meta/ctrl were never suspect — only
+        the device row was — so the row's current subscriptions survive
+        and the dirty-row upload re-asserts them over the checkpoint's
+        older device copy at the next tick edge. Callers hold state_lock
+        (GC01)."""
+        import jax.numpy as jnp
+
+        flat, treedef = jax.tree.flatten(self.state)
+        self._check_row_leaves(flat, snap["arrays"])
+        dev_arrays = snap["arrays"][: len(flat)]
+        self.munger.restore_room(row, snap["arrays"][len(flat):])
+        new_flat = [
+            leaf.at[row].set(jnp.asarray(a, leaf.dtype))
+            for leaf, a in zip(flat, dev_arrays)
+        ]
+        self.state = jax.tree.unflatten(treedef, new_flat)
+        if self._mesh is not None:
+            from livekit_server_tpu.parallel import shard_tree
+
+            self.state = shard_tree(self.state, self._mesh)
+        # The replay ring references pre-repair munger SN spaces; replaying
+        # across the rewind would emit wrong-SN bytes. Clients re-NACK.
+        self.host_seq.clear_room(row)
+        self._dirty_rows.add(row)
 
     def restore_room(self, row: int, snap: dict[str, Any]) -> None:
         """Seed `row` from a snapshot taken on another node: munger/VP8
@@ -1086,13 +1249,7 @@ class PlaneRuntime:
         # and must not retain entries from whatever used the row before.
         self.host_seq.clear_room(row)
         flat, treedef = jax.tree.flatten(self.state)
-        n_munger = len(HostMunger.FIELDS)
-        if len(snap["arrays"]) != len(flat) + n_munger:
-            raise ValueError(
-                f"snapshot has {len(snap['arrays'])} leaves, plane has "
-                f"{len(flat)} + {n_munger} munger fields — "
-                f"source/destination plane versions differ"
-            )
+        self._check_row_leaves(flat, snap["arrays"])
         dev_arrays = snap["arrays"][: len(flat)]
         self.munger.restore_room(row, snap["arrays"][len(flat):])
         new_flat = [
@@ -1116,10 +1273,32 @@ class PlaneRuntime:
         self.ctrl.max_spatial[row] = plane.MAX_LAYERS - 1
         self.ctrl.max_temporal[row] = 3
         self._dirty_rows.add(row)
+        if self.integrity is not None:
+            # A legitimate row rewrite: drop quarantine history and
+            # re-baseline the audit cursors (they rewound on purpose).
+            self.integrity.on_row_restore(row)
 
     def restore(self, snap: dict[str, Any]) -> None:
         flat, treedef = jax.tree.flatten(self.state)
-        assert len(flat) == len(snap["arrays"])
+        arrays = snap.get("arrays")
+        if arrays is None or len(arrays) != len(flat):
+            raise ValueError(
+                f"full snapshot has {0 if arrays is None else len(arrays)} "
+                f"leaves, plane has {len(flat)} — snapshot/plane versions "
+                "differ"
+            )
+        for i, (leaf, a) in enumerate(zip(flat, arrays)):
+            a = np.asarray(a)
+            if tuple(a.shape) != tuple(leaf.shape):
+                raise ValueError(
+                    f"full snapshot leaf {i} shape {tuple(a.shape)} != "
+                    f"plane shape {tuple(leaf.shape)} — dims mismatch"
+                )
+            if not np.can_cast(a.dtype, np.dtype(leaf.dtype), casting="same_kind"):
+                raise ValueError(
+                    f"full snapshot leaf {i} dtype {a.dtype} incompatible "
+                    f"with plane dtype {np.dtype(leaf.dtype)}"
+                )
         self.state = jax.tree.unflatten(treedef, [a for a in snap["arrays"]])
         if self._mesh is not None:
             from livekit_server_tpu.parallel import shard_tree
@@ -1136,3 +1315,5 @@ class PlaneRuntime:
             self.munger = HostMunger(self.dims)
         self.tick_index = snap["tick_index"]
         self._ctrl_dirty = True
+        if self.integrity is not None:
+            self.integrity.on_full_restore()

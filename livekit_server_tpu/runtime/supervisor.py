@@ -21,7 +21,14 @@ plane level:
   - periodic checkpoints — a full-plane snapshot on a cadence (the
     restart seed), plus an optional per-room checkpoint callback the
     RoomManager uses to publish room rows to the KV bus (the failover
-    seed surviving nodes restore from; see service/roommanager.py)
+    seed surviving nodes restore from; see service/roommanager.py).
+    Checkpoints are kept as K encoded GENERATIONS, each wrapped in the
+    utils/checksum frame; restore walks newest→oldest and falls back a
+    generation (counter + warn) on a corrupt or shape-mismatched frame
+    instead of committing garbage into donated device state.
+  - restart-cause taxonomy — `stall` (watchdog) vs `integrity`
+    (requested by the IntegrityMonitor's escalation ladder via
+    request_restart), with separate counters.
 
 Restart rewinds at most one checkpoint interval of munger advance:
 packets forwarded after the snapshot are re-issued with the same SNs
@@ -32,6 +39,7 @@ from __future__ import annotations
 
 import asyncio
 import time
+from collections import deque
 from typing import Any, Awaitable, Callable
 
 from livekit_server_tpu.utils.backoff import BackoffPolicy
@@ -49,6 +57,7 @@ class PlaneSupervisor:
         checkpoint_interval_s: float = 2.0,
         max_restarts: int = 5,
         overload_grace: float = 5.0,
+        ckpt_generations: int = 3,
         backoff: BackoffPolicy | None = None,
         telemetry=None,
         log: Logger | None = None,
@@ -72,9 +81,18 @@ class PlaneSupervisor:
         # its per-room bus publisher.
         self.room_checkpoint_cb: Callable[[], Awaitable[None]] | None = None
         self.last_snapshot: dict[str, Any] | None = None
+        # Encoded (checksummed) checkpoint generations, newest first.
+        # Restore verifies each frame and falls back a generation on
+        # corruption; the corrupt_ckpt fault writes damage HERE, so the
+        # in-memory last_snapshot above is kept only as a same-process
+        # compatibility convenience and is NOT the restart seed.
+        self._gens: deque = deque(maxlen=max(1, int(ckpt_generations)))
+        self.ckpt_fallbacks = 0      # generations skipped as corrupt/invalid
         self.restarts = 0            # lifetime restart count (telemetry)
+        self.restart_causes: dict[str, int] = {"stall": 0, "integrity": 0}
         self.gave_up = False
         self._attempts = 0           # consecutive restarts without health
+        self._requested_restart = "" # set by request_restart(), watchdog-consumed
         self._watch_task: asyncio.Task | None = None
         self._ckpt_task: asyncio.Task | None = None
         self._ticks_seen = -1
@@ -105,11 +123,33 @@ class PlaneSupervisor:
     async def checkpoint_now(self) -> None:
         """One full-plane snapshot (the restart seed), then the per-room
         callback. Taken under state_lock so the donated device step never
-        has the arrays mid-flight."""
+        has the arrays mid-flight. The snapshot is encoded + checksummed
+        into the generation ring; the corrupt_ckpt fault seam damages the
+        encoded bytes here, exactly where real bit rot would land."""
         async with self.runtime.state_lock:
             self.last_snapshot = self.runtime.snapshot()
+        blob = self.runtime.encode_snapshot(self.last_snapshot)
+        fault = getattr(self.runtime, "fault", None)
+        if fault is not None:
+            blob = fault.corrupt_ckpt(blob)
+        self._gens.appendleft(blob)
         if self.room_checkpoint_cb is not None:
             await self.room_checkpoint_cb()
+
+    def last_good_snapshot(self) -> dict[str, Any] | None:
+        """Newest checkpoint generation that verifies, decoded — the
+        IntegrityMonitor's row-repair source. Corrupt generations are
+        skipped with a counter + warn."""
+        for i, blob in enumerate(self._gens):
+            try:
+                return self.runtime.decode_snapshot(blob)
+            except (ValueError, KeyError, OSError) as e:  # ChecksumError ⊂ ValueError
+                self.ckpt_fallbacks += 1
+                self.log.warn(
+                    "checkpoint generation corrupt; falling back",
+                    generation=i, error=str(e),
+                )
+        return None
 
     async def _checkpointer(self) -> None:
         while True:
@@ -122,6 +162,15 @@ class PlaneSupervisor:
                 # (bus outage mid-publish) must not kill the cadence; the
                 # next interval retries with fresher state anyway.
                 self.log.warn("plane checkpoint failed", error=str(e))
+
+    # -- requested restarts (integrity escalation) -------------------------
+    def request_restart(self, reason: str) -> None:
+        """Ask for a full restart-from-snapshot (cause `integrity`).
+        Thread-safe: the IntegrityMonitor calls this from the device-step
+        worker; the watchdog poll consumes the flag on the event loop, so
+        requested restarts serialize with stall restarts."""
+        if not self._requested_restart:
+            self._requested_restart = reason
 
     # -- watchdog ---------------------------------------------------------
     def _stalled(self, now: float) -> str:
@@ -167,7 +216,13 @@ class PlaneSupervisor:
     async def _watchdog(self) -> None:
         while True:
             await asyncio.sleep(self.check_interval_s)
-            reason = self._stalled(time.monotonic())
+            cause = "stall"
+            reason = self._requested_restart
+            if reason:
+                self._requested_restart = ""
+                cause = "integrity"
+            else:
+                reason = self._stalled(time.monotonic())
             if not reason:
                 continue
             if self._attempts >= self.max_restarts:
@@ -177,15 +232,15 @@ class PlaneSupervisor:
                     attempts=self._attempts, reason=reason,
                 )
                 return
-            await self._restart(reason)
+            await self._restart(reason, cause=cause)
 
-    async def _restart(self, reason: str) -> None:
+    async def _restart(self, reason: str, cause: str = "stall") -> None:
         from concurrent.futures import ThreadPoolExecutor
 
         rt = self.runtime
         attempt = self._attempts
         self._attempts += 1
-        self.log.warn("restarting media plane", reason=reason,
+        self.log.warn("restarting media plane", reason=reason, cause=cause,
                       attempt=self._attempts, cap=self.max_restarts)
         # Invalidate any in-flight device step FIRST: a stale step
         # completing on the abandoned thread must not commit its state
@@ -198,14 +253,43 @@ class PlaneSupervisor:
         old = rt._executor
         rt._executor = ThreadPoolExecutor(max_workers=1, thread_name_prefix="plane")
         old.shutdown(wait=False)
-        if self.last_snapshot is not None:
-            async with rt.state_lock:
-                rt.restore(self.last_snapshot)
+        await self._restore_from_checkpoint()
         await asyncio.sleep(self.backoff.delay(attempt))
         self._ticks_seen = rt.stats.get("ticks", 0)
         self._baseline_ticks = self._ticks_seen
         self._progress_at = time.monotonic()
         rt.start()
         self.restarts += 1
+        self.restart_causes[cause] = self.restart_causes.get(cause, 0) + 1
         if self.telemetry is not None:
             self.telemetry.add("livekit_plane_restarts_total")
+            self.telemetry.add(
+                "livekit_plane_restarts_by_cause_total", cause=cause
+            )
+
+    async def _restore_from_checkpoint(self) -> bool:
+        """Restore the plane from the newest checkpoint generation that
+        both VERIFIES (checksum) and VALIDATES (leaf shapes/dtypes vs the
+        live plane). Each rejected generation counts a fallback. With no
+        usable generation (fresh supervisor, or all corrupt) the plane
+        restarts on its current state — the pre-checkpoint behavior."""
+        rt = self.runtime
+        for i, blob in enumerate(list(self._gens)):
+            try:
+                snap = rt.decode_snapshot(blob)
+                async with rt.state_lock:
+                    rt.restore(snap)
+                return True
+            except (ValueError, KeyError, OSError) as e:
+                self.ckpt_fallbacks += 1
+                self.log.warn(
+                    "checkpoint generation rejected at restore; falling back",
+                    generation=i, error=str(e),
+                )
+        if self.last_snapshot is not None:
+            # Same-process fallback: the raw dict snapshot (cannot have
+            # bit-rotted — it never left memory unencoded).
+            async with rt.state_lock:
+                rt.restore(self.last_snapshot)
+            return True
+        return False

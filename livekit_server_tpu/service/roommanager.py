@@ -116,6 +116,7 @@ class RoomManager:
                 checkpoint_interval_s=sup.checkpoint_interval_s,
                 max_restarts=sup.max_restarts,
                 overload_grace=sup.overload_grace,
+                ckpt_generations=config.integrity.checkpoint_generations,
                 backoff=BackoffPolicy(
                     base=sup.restart_backoff_base_s, max_delay=sup.restart_backoff_max_s
                 ),
@@ -145,6 +146,31 @@ class RoomManager:
                 self.runtime, config.limits, log=self.log
             )
             self.runtime.governor = self.governor
+        # State-integrity plane (runtime/integrity.py): on-device audits
+        # on the tick cadence, row quarantine + repair from the
+        # supervisor's last verified checkpoint, storm/repair-failure
+        # escalation to a supervisor restart (cause `integrity`).
+        self.integrity = None
+        integ = config.integrity
+        if integ.enabled:
+            from livekit_server_tpu.runtime.integrity import IntegrityMonitor
+
+            self.integrity = IntegrityMonitor(
+                self.runtime,
+                audit_every_ticks=integ.audit_every_ticks,
+                max_row_repairs=integ.max_row_repairs,
+                storm_threshold=integ.storm_threshold,
+                log=self.log,
+            )
+            self.runtime.integrity = self.integrity
+            if self.supervisor is not None:
+                self.integrity.snapshot_provider = self.supervisor.last_good_snapshot
+                self.integrity.escalate_cb = self.supervisor.request_restart
+        # Room-checkpoint generations on the KV bus: base key + :g1..:gK-1,
+        # rotated from this local history (payload strings, newest first).
+        self._ckpt_gens = max(1, integ.checkpoint_generations)
+        self._ckpt_history: dict[str, list[str]] = {}
+        self.ckpt_fallbacks = 0  # room-restore generations rejected
         router.on_new_session(self.start_session)
         self._update_node_stats()
 
@@ -210,14 +236,23 @@ class RoomManager:
         await self.router.clear_room_state(name)
         bus = getattr(self.router, "bus", None)
         if bus is not None:
-            # A deliberate delete must also retire the failover checkpoint,
-            # or a same-name room created within CHECKPOINT_TTL_S would
-            # adopt the dead room's SN/TS lanes.
+            # A deliberate delete must also retire the failover checkpoint
+            # — every generation of it — or a same-name room created
+            # within CHECKPOINT_TTL_S would adopt the dead room's SN/TS
+            # lanes.
             try:
-                await bus.delete(f"room_checkpoint:{name}")
+                for key in self._checkpoint_keys(name):
+                    await bus.delete(key)
             except (ConnectionError, OSError):
                 pass
+        self._ckpt_history.pop(name, None)
         self._update_node_stats()
+
+    def _checkpoint_keys(self, name: str) -> list[str]:
+        """KV keys for a room's checkpoint generations, newest first."""
+        return [f"room_checkpoint:{name}"] + [
+            f"room_checkpoint:{name}:g{i}" for i in range(1, self._ckpt_gens)
+        ]
 
     # -- session handling (roommanager.go StartSession) -------------------
     async def start_session(
@@ -474,28 +509,39 @@ class RoomManager:
     async def _maybe_restore_room(self, room: Room) -> None:
         """Adopt a migrated room's device state if a snapshot is waiting on
         the bus (the receiving half of handoff_room), falling back to the
-        latest failover checkpoint (the receiving half of
-        checkpoint_rooms) when no deliberate handoff is in flight."""
+        failover checkpoint GENERATIONS (the receiving half of
+        checkpoint_rooms) when no deliberate handoff is in flight.
+
+        Every candidate is checksum-verified (decode_room_snapshot) and
+        shape/dtype-validated (restore_room) before anything scatters
+        into device state; a corrupt or mismatched payload falls back a
+        generation (counter + warn) instead of raising out of room
+        creation. With no usable candidate the room starts fresh — a
+        stream reset, not an outage."""
         bus = getattr(self.router, "bus", None)
         if bus is None:
             return
-        key = f"room_snapshot:{room.name}"
-        raw = await bus.get(key)
-        if not raw:
-            key = f"room_checkpoint:{room.name}"
+        candidates = [f"room_snapshot:{room.name}"] + self._checkpoint_keys(room.name)
+        for key in candidates:
             raw = await bus.get(key)
-        if not raw:
-            return
-        try:
-            snap = self.runtime.decode_room_snapshot(raw)
-            async with self.runtime.state_lock:  # vs. the donated device step
-                self.runtime.restore_room(room.slots.row, snap)
+            if not raw:
+                continue
+            try:
+                snap = self.runtime.decode_room_snapshot(raw)
+                async with self.runtime.state_lock:  # vs. the donated device step
+                    self.runtime.restore_room(room.slots.row, snap)
+            except Exception as e:  # noqa: BLE001 — corruption, version or
+                # dims drift; reject-and-log, then try an older generation.
+                self.ckpt_fallbacks += 1
+                self.log.warn(
+                    "room snapshot rejected; falling back a generation",
+                    room=room.name, key=key, error=str(e),
+                )
+                await bus.delete(key)
+                continue
             self.log.info("room restored from snapshot", room=room.name, key=key)
-        except Exception as e:  # noqa: BLE001 — a bad snapshot (version/
-            # dims drift, corruption) must not poison room creation; the
-            # room starts fresh instead (a stream reset, not an outage).
-            self.log.warn("room snapshot rejected", room=room.name, error=str(e))
-        await bus.delete(key)
+            await bus.delete(key)
+            return
 
     # -- supervision & failover (tentpole of the supervised media plane) --
     async def checkpoint_rooms(self) -> None:
@@ -512,11 +558,19 @@ class RoomManager:
                     continue  # mid-handoff: handoff_room owns this row's snapshot
                 async with self.runtime.state_lock:  # vs. the donated device step
                     snap = self.runtime.snapshot_room(row)
-                await bus.set(
-                    f"room_checkpoint:{name}",
-                    self.runtime.encode_room_snapshot(snap),
-                    CHECKPOINT_TTL_S,
-                )
+                payload = self.runtime.encode_room_snapshot(snap)
+                if self.fault is not None:
+                    # corrupt_ckpt seam: damage lands on the encoded frame,
+                    # exactly where real bus/storage bit rot would.
+                    payload = self.fault.corrupt_ckpt(payload)
+                # Rotate the generation ring: newest at the base key, the
+                # previous K-1 payloads at :g1..:gK-1 so a corrupt newest
+                # frame falls back instead of orphaning the room.
+                hist = self._ckpt_history.setdefault(name, [])
+                hist.insert(0, payload)
+                del hist[self._ckpt_gens:]
+                for key, gen_payload in zip(self._checkpoint_keys(name), hist):
+                    await bus.set(key, gen_payload, CHECKPOINT_TTL_S)
 
     async def _failover_worker(self) -> None:
         """Scan for rooms pinned to dead nodes (lapsed liveness lease,
@@ -649,6 +703,20 @@ class RoomManager:
                 self.telemetry.observe_transport(self.udp.stats)
             if self.governor is not None:
                 self.telemetry.observe_overload(self.governor.stats_dict())
+            if self.integrity is not None:
+                self.telemetry.observe_integrity(self.integrity_stats())
+
+    def integrity_stats(self) -> dict:
+        """IntegrityMonitor stats + the checkpoint-generation fallback
+        counters spread across the supervisor (full-plane ring) and this
+        manager (KV room checkpoints) — the /debug/integrity payload."""
+        snap = self.integrity.stats_dict() if self.integrity is not None else {}
+        fallbacks = self.ckpt_fallbacks
+        if self.supervisor is not None:
+            fallbacks += self.supervisor.ckpt_fallbacks
+            snap["restart_causes"] = dict(self.supervisor.restart_causes)
+        snap["generation_fallbacks"] = fallbacks
+        return snap
 
     # -- periodic reaping (server.go backgroundWorker) --------------------
     def start(self) -> None:

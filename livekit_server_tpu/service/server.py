@@ -77,6 +77,7 @@ class LivekitServer:
         self.app.router.add_get("/debug/tasks", self.debug_tasks)
         self.app.router.add_get("/debug/ticks", self.debug_ticks)
         self.app.router.add_get("/debug/overload", self.debug_overload)
+        self.app.router.add_get("/debug/integrity", self.debug_integrity)
         self._runner: web.AppRunner | None = None
         self._sites: list[web.TCPSite] = []
         self._stats_task: asyncio.Task | None = None
@@ -231,6 +232,41 @@ class LivekitServer:
                     rm.supervisor.restarts if rm.supervisor is not None else 0
                 ),
                 "limits": asdict(self.config.limits),
+            }
+        )
+
+    async def debug_integrity(self, request: web.Request) -> web.Response:
+        """State-integrity plane: audits run, violations by rule, the
+        quarantine/repair ladder's outcomes, checkpoint checksum failures
+        + generation fallbacks, and supervisor restart causes."""
+        from livekit_server_tpu.utils.checksum import CodecStats
+
+        rm = self.room_manager
+        sup = rm.supervisor
+        return web.json_response(
+            {
+                "integrity": rm.integrity_stats() if rm.integrity is not None else None,
+                "checksum": {
+                    "frames_encoded": CodecStats.frames_encoded,
+                    "frames_verified": CodecStats.frames_verified,
+                    "verify_failures": CodecStats.verify_failures,
+                },
+                "restart_causes": (
+                    dict(sup.restart_causes) if sup is not None else {}
+                ),
+                "supervisor_ckpt_fallbacks": (
+                    sup.ckpt_fallbacks if sup is not None else 0
+                ),
+                "room_ckpt_fallbacks": rm.ckpt_fallbacks,
+                "config": {
+                    "enabled": self.config.integrity.enabled,
+                    "audit_every_ticks": self.config.integrity.audit_every_ticks,
+                    "max_row_repairs": self.config.integrity.max_row_repairs,
+                    "storm_threshold": self.config.integrity.storm_threshold,
+                    "checkpoint_generations": (
+                        self.config.integrity.checkpoint_generations
+                    ),
+                },
             }
         )
 

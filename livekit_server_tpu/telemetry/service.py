@@ -137,6 +137,34 @@ class TelemetryService:
                 "livekit_admission_rejected_total", n, kind=str(kind)
             )
 
+    def observe_integrity(self, snap: dict[str, Any]) -> None:
+        """State-integrity plane (runtime/integrity.py stats_dict +
+        checkpoint codec counters): audits run, violations by rule, the
+        repair ladder's outcomes, and checksum verification failures."""
+        from livekit_server_tpu.utils.checksum import CodecStats
+
+        self.set_gauge("livekit_integrity_audits_total", snap.get("audits", 0))
+        self.set_gauge(
+            "livekit_integrity_violations_total", snap.get("violations_total", 0)
+        )
+        for rule, n in snap.get("violations_by_rule", {}).items():
+            self.set_gauge(
+                "livekit_integrity_rule_violations_total", n, rule=str(rule)
+            )
+        for k in ("rows_quarantined", "rows_repaired", "repair_failures",
+                  "escalations"):
+            self.set_gauge(f"livekit_integrity_{k}_total", snap.get(k, 0))
+        self.set_gauge(
+            "livekit_integrity_quarantined_rows", len(snap.get("quarantined_rows", []))
+        )
+        self.set_gauge(
+            "livekit_ckpt_checksum_failures_total", CodecStats.verify_failures
+        )
+        self.set_gauge(
+            "livekit_ckpt_generation_fallbacks_total",
+            snap.get("generation_fallbacks", 0),
+        )
+
     def observe_queue_drops(self) -> None:
         """Bus/signal back-pressure drops (the QueueFull paths that used
         to lose messages with at most a local count): process-wide

@@ -18,6 +18,10 @@ import time
 
 import numpy as np
 
+import pytest
+
+pytest.importorskip("cryptography")  # OpenSSL-backed interop lane; absent in slim images
+
 from livekit_server_tpu.interop import dtls, sdp, srtp, stun
 from livekit_server_tpu.models import plane
 from livekit_server_tpu.runtime import PlaneRuntime

@@ -1,5 +1,9 @@
 """SRTP AEAD_AES_128_GCM against RFC 7714/3711 test vectors + properties."""
 
+import pytest
+
+pytest.importorskip("cryptography")  # OpenSSL-backed interop lane; absent in slim images
+
 from livekit_server_tpu.interop import srtp
 
 

@@ -21,6 +21,7 @@ from livekit_server_tpu.analysis import (
     gc03,
     gc04,
     gc05,
+    gc06,
     diff_baseline,
     load_project,
     run_all,
@@ -380,6 +381,76 @@ def test_gc05_kwargs_splat_not_flagged(tmp_path):
     """
     project = make_project(tmp_path, {"pkg/buf.py": src})
     assert gc05.run(project, cfg_for("gc05")) == []
+
+
+# -- GC06 checkpoint hygiene ------------------------------------------------
+
+GC06_FIXTURE = """\
+    import io
+    import pickle
+    import numpy as np
+
+    from livekit_server_tpu.utils import checksum
+
+    RAW = pickle.dumps({"boot": 1})        # line 7: module-level
+
+    def encode(snap):
+        buf = io.BytesIO()
+        np.savez_compressed(buf, *snap)    # framed below: OK
+        return checksum.encode_frame(buf.getvalue())
+
+    def leak(snap):
+        buf = io.BytesIO()
+        np.savez_compressed(buf, *snap)    # line 16: no codec
+        return buf.getvalue()
+
+    def handoff(state):
+        return state.tobytes()             # line 20: no codec
+"""
+
+
+def test_gc06_fixture(tmp_path):
+    project = make_project(tmp_path, {"pkg/ckpt.py": GC06_FIXTURE})
+    findings = gc06.run(project, cfg_for("gc06"))
+    assert all(f.rule == "GC06" for f in findings)
+    assert lines_of(findings, "GC06") == [7, 16, 20]
+
+
+def test_gc06_module_level_vs_function(tmp_path):
+    project = make_project(tmp_path, {"pkg/ckpt.py": GC06_FIXTURE})
+    by_line = {f.line: f.message for f in gc06.run(project, cfg_for("gc06"))}
+    assert "module-level" in by_line[7]
+    assert "leak()" in by_line[16]
+    assert "handoff()" in by_line[20]
+
+
+def test_gc06_exempt_path(tmp_path):
+    project = make_project(tmp_path, {"pkg/ckpt.py": GC06_FIXTURE})
+    cfg = cfg_for("gc06", exempt=["pkg/ckpt.py"])
+    assert gc06.run(project, cfg) == []
+
+
+def test_gc06_inline_disable(tmp_path):
+    suppressed = GC06_FIXTURE.replace(
+        "# line 7: module-level", "# graftcheck: disable=GC06"
+    ).replace(
+        "# line 16: no codec", "# graftcheck: disable=GC06"
+    ).replace(
+        "# line 20: no codec", "# graftcheck: disable=GC06"
+    )
+    project = make_project(tmp_path, {"pkg/ckpt.py": suppressed})
+    assert lines_of(run_all_pkg(project), "GC06") == []
+
+
+def test_gc06_method_dumps_not_flagged(tmp_path):
+    # A data-class `.dumps()` method is not pickle: the receiver must be
+    # module-ish (pickle/cPickle/marshal) for the dumps/dump heuristic.
+    src = """\
+        def publish(self, codec, row):
+            return self.codec.dumps(row)
+    """
+    project = make_project(tmp_path, {"pkg/pub.py": src})
+    assert gc06.run(project, cfg_for("gc06")) == []
 
 
 # -- suppressions -----------------------------------------------------------
