@@ -213,6 +213,7 @@ DEFAULT_CONFIG: dict = {
         "paths": [
             "livekit_server_tpu/routing",
             "livekit_server_tpu/runtime/relay.py",
+            "livekit_server_tpu/service",
         ],
         "net_errors": [
             "ConnectionError", "ConnectionResetError", "ConnectionRefusedError",

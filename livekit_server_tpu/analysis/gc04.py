@@ -9,8 +9,14 @@ trip a breaker — exactly the shape PR 1's fault injection punishes.
 
 Two findings:
 
-  * a `while` loop that catches a network error class and sleeps is an
-    ad-hoc retry loop;
+  * a `while` loop that catches a network error class and sleeps *as
+    backoff* is an ad-hoc retry loop. "As backoff" means the sleep sits
+    at or after the net-catching `try` — inside the handler, or at the
+    loop tail after a swallowed failure. A periodic poll worker that
+    sleeps at the TOP of its body (the sleep is the schedule, not a
+    reaction to failure) and then tolerates a net error until the next
+    interval is NOT a finding — that shape already has bounded, fixed
+    cadence and cannot storm;
   * a direct dial call (`asyncio.open_connection`,
     `create_datagram_endpoint`, ...) in a function that is not itself
     passed to `retry_async` is an unmanaged dial. Listen-side binds and
@@ -73,22 +79,34 @@ def run(project: Project, cfg: dict) -> list[Finding]:
             continue
         managed = _retry_wrapped_names(sf, cg, cfg)
 
-        # ad-hoc retry loops: while + except(net error) + sleep
+        # ad-hoc retry loops: while + except(net error) + sleep-as-backoff.
+        # The sleep must sit at or after the net-catching try (inside the
+        # handler, or at the loop tail behind a swallowed failure); a
+        # schedule-sleep at the top of a poll worker's body is cadence,
+        # not backoff, and does not fire.
         for node in ast.walk(sf.tree):
             if not isinstance(node, ast.While):
                 continue
             caught: set[str] = set()
-            sleeps = False
+            net_try_line: int | None = None
+            sleep_lines: list[int] = []
             for sub in ast.walk(node):
-                if isinstance(sub, ast.ExceptHandler):
-                    caught |= _handler_names(sub, cg, sf.modname) & net_errors
+                if isinstance(sub, ast.Try):
+                    for h in sub.handlers:
+                        got = _handler_names(h, cg, sf.modname) & net_errors
+                        if got:
+                            caught |= got
+                            if net_try_line is None or sub.lineno < net_try_line:
+                                net_try_line = sub.lineno
                 elif isinstance(sub, ast.Call):
                     dotted = dotted_name(sub.func)
                     if dotted and cg.expand_alias(
                         dotted, sf.modname
                     ) in _SLEEPS:
-                        sleeps = True
-            if caught and sleeps:
+                        sleep_lines.append(sub.lineno)
+            if caught and net_try_line is not None and any(
+                ln >= net_try_line for ln in sleep_lines
+            ):
                 findings.append(
                     Finding(
                         "GC04", sf.rel, node.lineno,

@@ -60,6 +60,15 @@ def _build_parser() -> argparse.ArgumentParser:
 
     nodes = sub.add_parser("list-nodes", help="list cluster nodes")
     nodes.add_argument("--config", help="path to YAML config")
+
+    drain = sub.add_parser(
+        "drain",
+        help="ask a node to migrate its rooms off and stop admitting "
+             "(the live-migration plane's node drain)",
+    )
+    drain.add_argument("--config", help="path to YAML config (for the bus)")
+    drain.add_argument("--node", required=True,
+                       help="node id to drain (see list-nodes)")
     return p
 
 
@@ -114,10 +123,12 @@ def main(argv: list[str] | None = None) -> int:
             yaml_path=args.config if args.config else None,
             yaml_text=None if args.config else "development: true",
         )
-        from livekit_server_tpu.service.server import create_server
+        from livekit_server_tpu.service.server import connect_bus, create_server
 
         async def run():
-            server = create_server(cfg)
+            # Without the shared bus the router falls back to a private
+            # in-memory registry and only ever lists this invocation.
+            server = create_server(cfg, bus=await connect_bus(cfg))
             await server.router.register_node()
             for n in await server.router.list_nodes():
                 print(json.dumps(n.to_dict()))
@@ -125,6 +136,28 @@ def main(argv: list[str] | None = None) -> int:
 
         asyncio.run(run())
         return 0
+    if args.command == "drain":
+        cfg = load_config(
+            yaml_path=args.config if args.config else None,
+            yaml_text=None if args.config else "development: true",
+        )
+        from livekit_server_tpu.service.server import connect_bus
+
+        async def run_drain():
+            bus = await connect_bus(cfg)
+            if bus is None:
+                print("drain needs a shared bus (kv.kind='tcp'); a "
+                      "single-node server just stops", flush=True)
+                return 2
+            n = await bus.publish(f"node_migrate:{args.node}", {"kind": "drain"})
+            if n == 0:
+                print(f"node {args.node} is not listening (already gone?)",
+                      flush=True)
+                return 1
+            print(f"drain requested on {args.node}", flush=True)
+            return 0
+
+        return asyncio.run(run_drain())
     if args.command == "serve":
         yaml_text = None if args.config else (
             "development: true" if args.dev else None

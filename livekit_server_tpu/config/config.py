@@ -213,6 +213,39 @@ class SupervisorConfig:
 
 
 @dataclass
+class MigrationConfig:
+    """Live room migration plane (service/migration.py): two-phase
+    PREPARE/ACK/COMMIT handoff over the bus with rollback, freeze-window
+    packet bridging, and governed node drain. Needs a shared bus
+    (kv.kind=tcp or an injected MemoryBus) — single-node memory mode
+    constructs no orchestrator."""
+
+    enabled: bool = True
+    # TTL of the `room_snapshot:` key written by the NON-orchestrated
+    # handoff path (handoff_room) — how long an unpinned snapshot waits
+    # for some node's get_or_create_room to adopt it.
+    snapshot_ttl_s: float = 120.0
+    # Source-side wait for the target's ACK/NACK per PREPARE attempt.
+    # Each timed-out epoch is aborted before the retry re-sends.
+    ack_timeout_s: float = 2.0
+    # PREPARE retries per target candidate (utils.backoff.retry_async).
+    retry_attempts: int = 3
+    retry_backoff_base_s: float = 0.1
+    retry_backoff_max_s: float = 1.0
+    # Rooms migrated concurrently during a node drain.
+    drain_concurrency: int = 4
+    # Target-side: an adoption whose COMMIT never arrives (source died,
+    # bus severed mid-handoff) is released after this long — the device
+    # row must not leak.
+    adopt_ttl_s: float = 10.0
+    # Freeze-window bridge bound (packets). Audio always wins a slot:
+    # at budget the oldest buffered VIDEO packet is evicted first.
+    bridge_max_packets: int = 512
+    # Packets per BRIDGE bus message when flushing to the target.
+    bridge_chunk: int = 64
+
+
+@dataclass
 class FaultInjectConfig:
     """Deterministic fault injection (runtime/faultinject.py). OFF by
     default: the default config path constructs no injector — these knobs
@@ -241,6 +274,21 @@ class FaultInjectConfig:
     # Damage every Nth serialized checkpoint frame (0 = never): exercises
     # checksum verification + generation fallback on restore.
     corrupt_ckpt_every: int = 0
+    # Migration chaos drills (service/migration.py). Target-side:
+    # adopt the PREPARE'd room, then go silent — never ACK (the
+    # "target died mid-PREPARE" drill; source must time out + roll
+    # back, target must reap the row).
+    mig_drop_prepare: bool = False
+    # Target-side: sleep this long before ACKing — past ack_timeout_s
+    # the source has already aborted the epoch, so the late ACK must
+    # be ignored by the epoch guard (no double-commit).
+    mig_ack_delay_s: float = 0.0
+    # Source-side: damage the encoded snapshot inside PREPARE; the
+    # target's checksum verification must NACK, source rolls back.
+    mig_corrupt_handoff: bool = False
+    # Source-side: the first N commit phases raise ConnectionError on
+    # their bus ops (the "bus severed mid-handoff" drill).
+    mig_sever_handoffs: int = 0
 
 
 @dataclass
@@ -309,6 +357,7 @@ class Config:
     supervisor: SupervisorConfig = field(default_factory=SupervisorConfig)
     faults: FaultInjectConfig = field(default_factory=FaultInjectConfig)
     integrity: IntegrityConfig = field(default_factory=IntegrityConfig)
+    migration: MigrationConfig = field(default_factory=MigrationConfig)
 
 
 _SCALARS = (int, float, str, bool)
@@ -460,6 +509,12 @@ def _validate(cfg: Config) -> None:
         raise ConfigError(
             f"faults.corrupt_ckpt_every must be >= 0, got {f.corrupt_ckpt_every}"
         )
+    if f.mig_ack_delay_s < 0.0:
+        raise ConfigError(f"faults.mig_ack_delay_s must be >= 0, got {f.mig_ack_delay_s}")
+    if f.mig_sever_handoffs < 0:
+        raise ConfigError(
+            f"faults.mig_sever_handoffs must be >= 0, got {f.mig_sever_handoffs}"
+        )
     integ = cfg.integrity
     for name in ("audit_every_ticks", "max_row_repairs", "storm_threshold",
                  "checkpoint_generations"):
@@ -481,3 +536,10 @@ def _validate(cfg: Config) -> None:
             raise ConfigError(f"limits.{name} must be positive")
     if cfg.kv.lease_ttl_s <= 0:
         raise ConfigError("kv.lease_ttl_s must be positive")
+    mig = cfg.migration
+    for name in ("snapshot_ttl_s", "ack_timeout_s", "retry_attempts",
+                 "retry_backoff_base_s", "retry_backoff_max_s",
+                 "drain_concurrency", "adopt_ttl_s", "bridge_max_packets",
+                 "bridge_chunk"):
+        if getattr(mig, name) <= 0:
+            raise ConfigError(f"migration.{name} must be positive")

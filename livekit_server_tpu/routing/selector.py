@@ -16,7 +16,7 @@ import random
 from typing import Protocol
 
 from livekit_server_tpu.config.config import NodeSelectorConfig
-from livekit_server_tpu.routing.node import LocalNode
+from livekit_server_tpu.routing.node import LocalNode, NodeState
 
 
 class NoNodesAvailable(Exception):
@@ -28,7 +28,14 @@ class NodeSelector(Protocol):
 
 
 def _filter_available(nodes: list[LocalNode]) -> list[LocalNode]:
-    out = [n for n in nodes if n.is_available()]
+    # Draining/stopping nodes are excluded EXPLICITLY, not just via
+    # is_available()'s SERVING check: a node mid-drain (migration plane,
+    # service/migration.py) must receive no new rooms regardless of how
+    # the availability predicate evolves.
+    out = [
+        n for n in nodes
+        if n.state != NodeState.SHUTTING_DOWN and n.is_available()
+    ]
     # Plane capacity gate (TPU-specific; no reference equivalent).
     out = [
         n

@@ -64,6 +64,15 @@ class FaultSpec:
     # past its header (0 = never), so restore paths must catch it via
     # checksum verification, not a deserialize crash.
     corrupt_ckpt_every: int = 0
+    # Migration chaos drills (service/migration.py seams):
+    # target adopts the PREPARE'd room then goes silent — never ACKs.
+    mig_drop_prepare: bool = False
+    # target sleeps this long before ACKing (late-ACK epoch-guard drill).
+    mig_ack_delay_s: float = 0.0
+    # source damages the encoded snapshot inside PREPARE (target NACKs).
+    mig_corrupt_handoff: bool = False
+    # source's first N commit phases fail their bus ops (sever drill).
+    mig_sever_handoffs: int = 0
 
 
 @dataclass
@@ -77,6 +86,10 @@ class FaultStats:
     flooded: int = 0          # extra packet copies staged by flood mode
     bitflips: int = 0         # state elements corrupted by bitflip mode
     ckpt_corrupted: int = 0   # checkpoint frames damaged after encoding
+    mig_prepares_swallowed: int = 0  # adoptions that then went silent
+    mig_acks_delayed: int = 0        # ACKs slept past the source timeout
+    mig_handoffs_corrupted: int = 0  # PREPARE snapshots damaged in flight
+    mig_commits_severed: int = 0     # commit phases failed at the bus seam
 
 
 class FaultInjector:
@@ -97,6 +110,8 @@ class FaultInjector:
         self._held: dict[int, list] = {}
         self._step_count = 0
         self._ckpt_count = 0
+        self._mig_severed = 0
+        self._mig_handoff_count = 0
 
     @classmethod
     def from_config(cls, cfg) -> "FaultInjector":
@@ -109,6 +124,10 @@ class FaultInjector:
             bitflip_leaf=cfg.bitflip_leaf, bitflip_bit=cfg.bitflip_bit,
             bitflip_count=cfg.bitflip_count,
             corrupt_ckpt_every=cfg.corrupt_ckpt_every,
+            mig_drop_prepare=cfg.mig_drop_prepare,
+            mig_ack_delay_s=cfg.mig_ack_delay_s,
+            mig_corrupt_handoff=cfg.mig_corrupt_handoff,
+            mig_sever_handoffs=cfg.mig_sever_handoffs,
         ))
 
     # -- ingest-boundary packet faults -----------------------------------
@@ -221,6 +240,50 @@ class FaultInjector:
         out = bytearray(blob)
         out[pos] ^= 0xFF
         return bytes(out)
+
+    # -- migration chaos seams (service/migration.py) ---------------------
+    def mig_swallow_prepare(self) -> bool:
+        """Target seam: True = the PREPARE handler adopted the room but
+        must now go silent (no ACK, ever) — to the source this target
+        died mid-PREPARE. Exercises the source's timeout rollback and
+        the target's adoption reaper (no row leak)."""
+        if not self.spec.mig_drop_prepare:
+            return False
+        self.stats.mig_prepares_swallowed += 1
+        return True
+
+    async def mig_delay_ack(self) -> None:
+        """Target seam: hold the ACK past the source's timeout so the
+        epoch guard gets a genuinely late ACK to ignore."""
+        s = self.spec
+        if s.mig_ack_delay_s > 0:
+            self.stats.mig_acks_delayed += 1
+            import asyncio
+
+            await asyncio.sleep(s.mig_ack_delay_s)
+
+    def corrupt_handoff(self, payload: str) -> str:
+        """Source seam: damage the encoded snapshot riding in PREPARE the
+        same way corrupt_ckpt damages b64 checkpoint frames — header
+        intact, one payload char swapped, so only the target's CRC
+        verification catches it (⇒ NACK)."""
+        if not self.spec.mig_corrupt_handoff:
+            return payload
+        self.stats.mig_handoffs_corrupted += 1
+        self._mig_handoff_count += 1
+        pos = 28 + (self._mig_handoff_count * 7919) % max(1, len(payload) - 30)
+        repl = "A" if payload[pos] != "A" else "B"
+        return payload[:pos] + repl + payload[pos + 1:]
+
+    def mig_sever_commit(self) -> bool:
+        """Source seam: True = this commit phase's bus ops must fail
+        (the orchestrator raises ConnectionError and rolls back).
+        Consumes one of mig_sever_handoffs per handoff attempt."""
+        if self._mig_severed >= self.spec.mig_sever_handoffs:
+            return False
+        self._mig_severed += 1
+        self.stats.mig_commits_severed += 1
+        return True
 
     # -- infrastructure faults (chaos-test helpers) ----------------------
     def sever_bus(self, client) -> None:

@@ -85,6 +85,11 @@ class OverloadGovernor:
         # Admission rejections by kind ("room" / "join" / "publish");
         # RoomManager increments via note_rejection at each refusal.
         self.rejected: dict[str, int] = {}
+        # Node drain (service/migration.py): while held, the node sits at
+        # L_MAX and the sensor loop neither escalates nor recovers — a
+        # draining node must keep rejecting admissions no matter how calm
+        # its (emptying) plane looks.
+        self.drain_hold = False
         self._hot = 0                # consecutive pressured ticks
         self._calm = 0               # consecutive relaxed ticks
         self._stalls_seen = runtime.stats.get("pipeline_stalls", 0)
@@ -112,6 +117,9 @@ class OverloadGovernor:
         the exit threshold — the hysteresis band), or the middle band,
         which resets BOTH streaks: not bad enough to escalate, not calm
         enough to count toward dwell."""
+        if self.drain_hold:
+            self.ticks += 1
+            return
         rt = self.runtime
         stalls = rt.stats.get("pipeline_stalls", 0)
         cap_drops = rt.ingest.dropped_capacity
@@ -198,10 +206,22 @@ class OverloadGovernor:
         Existing sessions — including resumes — are never evicted by the
         governor; only NEW load is refused, and only at L4."""
         del kind  # one gate for all kinds today; the signature is the API
-        return self.level < L_REJECT
+        return not self.drain_hold and self.level < L_REJECT
 
     def note_rejection(self, kind: str) -> None:
         self.rejected[kind] = self.rejected.get(kind, 0) + 1
+
+    # -- drain hold (node drain, service/migration.py) --------------------
+    def hold_max(self, reason: str = "node draining") -> None:
+        """Pin the ladder at L_MAX and freeze the sensor loop: every
+        admission is refused until release_hold(). In practice a drain
+        ends in process shutdown and the hold is never released."""
+        self.drain_hold = True
+        if self.level < L_MAX:
+            self._set_level(L_MAX, reason)
+
+    def release_hold(self) -> None:
+        self.drain_hold = False
 
     # -- visibility -------------------------------------------------------
     def snapshot(self) -> dict:
@@ -209,6 +229,7 @@ class OverloadGovernor:
         ing = self.runtime.ingest
         return {
             "level": self.level,
+            "drain_hold": self.drain_hold,
             "ticks": self.ticks,
             "hot_streak": self._hot,
             "calm_streak": self._calm,
@@ -235,6 +256,7 @@ class OverloadGovernor:
         ing = self.runtime.ingest
         return {
             "level": self.level,
+            "drain_hold": self.drain_hold,
             "escalations": self.escalations,
             "transitions_total": self.transition_count,
             "dropped_capacity": ing.dropped_capacity,

@@ -91,6 +91,11 @@ class PlaneSupervisor:
         self.restarts = 0            # lifetime restart count (telemetry)
         self.restart_causes: dict[str, int] = {"stall": 0, "integrity": 0}
         self.gave_up = False
+        # Node drain (service/migration.py): a draining plane quiesces on
+        # purpose — rooms migrate away and tick progress may legitimately
+        # stop. The watchdog must not read that as a stall and "restore"
+        # rooms the drain just handed off.
+        self.draining = False
         self._attempts = 0           # consecutive restarts without health
         self._requested_restart = "" # set by request_restart(), watchdog-consumed
         self._watch_task: asyncio.Task | None = None
@@ -216,6 +221,8 @@ class PlaneSupervisor:
     async def _watchdog(self) -> None:
         while True:
             await asyncio.sleep(self.check_interval_s)
+            if self.draining:
+                continue  # quiescing on purpose: never restart a drain
             cause = "stall"
             reason = self._requested_restart
             if reason:

@@ -171,6 +171,14 @@ class RoomManager:
         self._ckpt_gens = max(1, integ.checkpoint_generations)
         self._ckpt_history: dict[str, list[str]] = {}
         self.ckpt_fallbacks = 0  # room-restore generations rejected
+        # Live migration plane (service/migration.py): two-phase room
+        # handoff + node drain. Needs a shared bus to talk to peers —
+        # a bus-less single-node router runs without it.
+        self.migration = None
+        if config.migration.enabled and getattr(router, "bus", None) is not None:
+            from livekit_server_tpu.service.migration import MigrationOrchestrator
+
+            self.migration = MigrationOrchestrator(self)
         router.on_new_session(self.start_session)
         self._update_node_stats()
 
@@ -392,7 +400,11 @@ class RoomManager:
         lim = self.config.limits
         st = self.router.local_node.stats
         reason = ""
-        if self.governor is not None and not self.governor.should_admit(kind):
+        if self.migration is not None and self.migration.draining:
+            # Drain works with the governor disabled too: the orchestrator
+            # itself refuses every admission kind while rooms move off.
+            reason = "node draining"
+        elif self.governor is not None and not self.governor.should_admit(kind):
             reason = "node overloaded"
         elif kind == "room" and lim.max_rooms and len(self.rooms) >= lim.max_rooms:
             reason = "max rooms on node"
@@ -485,15 +497,26 @@ class RoomManager:
         try:
             async with self.runtime.state_lock:  # vs. the donated device step
                 snap = self.runtime.snapshot_room(room.slots.row)
-            await bus.set(
-                f"room_snapshot:{name}",
-                self.runtime.encode_room_snapshot(snap),
-                120.0,
-            )
-            if target_node_id:
-                await self.router.set_node_for_room(name, target_node_id)
-            else:
-                await self.router.clear_room_state(name)
+            # Durability gate: the snapshot must be on the bus and the
+            # pin moved BEFORE any local teardown. A bus failure here
+            # leaves the room fully serving on this node — never pop a
+            # room whose state only exists in a packet that didn't land.
+            try:
+                await bus.set(
+                    f"room_snapshot:{name}",
+                    self.runtime.encode_room_snapshot(snap),
+                    self.config.migration.snapshot_ttl_s,
+                )
+                if target_node_id:
+                    await self.router.set_node_for_room(name, target_node_id)
+                else:
+                    await self.router.clear_room_state(name)
+            except (ConnectionError, OSError) as e:
+                self.log.warn(
+                    "handoff aborted; room keeps serving here",
+                    room=name, error=str(e),
+                )
+                return False
             # Local teardown only — the pin/store state now belongs to the
             # destination node (clients reconnect there, reason MIGRATION).
             self.rooms.pop(name, None)
@@ -501,10 +524,51 @@ class RoomManager:
             room.close(pm.DisconnectReason.MIGRATION)
             self.log.info("room handed off", room=name, target=target_node_id or "unpinned")
         finally:
-            # room.close released the row; its next tenant starts unfrozen.
+            # On success room.close released the row (its next tenant
+            # starts unfrozen); on an aborted handoff this resumes it.
             self.runtime.ingest.frozen_rows.discard(room.slots.row)
         self._update_node_stats()
         return True
+
+    async def migrate_room(self, name: str, target_node_id: str = "") -> bool:
+        """Supervised two-phase handoff (service/migration.py): the room
+        moves only after the target ACKs a restored replica, freeze-window
+        packets are bridged across, and any failure rolls back to serving
+        here. Falls back to the fire-and-forget bus handoff when the
+        migration plane is disabled."""
+        if self.migration is not None:
+            return await self.migration.migrate_room(name, target_node_id)
+        return await self.handoff_room(name, target_node_id)
+
+    def _on_room_adopted(self, room: Room) -> None:
+        """Post-adoption resync (the NACK blind-window satellite): the
+        host-side NACK replay ring does not travel in a snapshot, so
+        lost-packet recovery is blind until each video track's ring
+        repopulates. Shrink that window by soliciting an immediate
+        keyframe per migrated video track — a keyframe resets decode
+        state without needing history — and re-solicit when a publisher
+        reconnects and republishes."""
+        row = room.slots.row
+        meta = self.runtime.meta
+        cols = np.nonzero(meta.published[row] & meta.is_video[row])[0]
+        pending: set[int] = set()
+        for col in cols:
+            room.handle_keyframe_request(int(col))
+            pending.add(int(col))
+
+        def _resync(pub, track) -> None:
+            col = getattr(track, "track_col", None)
+            if col is None or col not in pending:
+                return
+            pending.discard(col)
+            # The adoption-time request above recorded _last_pli for this
+            # col even when no publisher was mapped yet; clear it so this
+            # republish-time request isn't throttled away.
+            room._last_pli.pop(col, None)
+            room.handle_keyframe_request(col)
+
+        if pending:
+            room.on_track_published.append(_resync)
 
     async def _maybe_restore_room(self, room: Room) -> None:
         """Adopt a migrated room's device state if a snapshot is waiting on
@@ -541,6 +605,9 @@ class RoomManager:
                 continue
             self.log.info("room restored from snapshot", room=room.name, key=key)
             await bus.delete(key)
+            # Same blind window as a two-phase adoption: solicit keyframes
+            # so video recovers before the NACK ring repopulates.
+            self._on_room_adopted(room)
             return
 
     # -- supervision & failover (tentpole of the supervised media plane) --
@@ -729,6 +796,8 @@ class RoomManager:
         # other nodes' leases (and to read their checkpoints from).
         if self._failover_task is None and getattr(self.router, "bus", None) is not None:
             self._failover_task = asyncio.ensure_future(self._failover_worker())
+        if self.migration is not None:
+            self.migration.start()
 
     async def _reaper(self) -> None:
         while True:
@@ -743,6 +812,8 @@ class RoomManager:
                     p.reap_stale_publications()
 
     async def stop(self) -> None:
+        if self.migration is not None:
+            await self.migration.stop()
         if self.supervisor is not None:
             await self.supervisor.stop()
         for attr in ("_reaper_task", "_failover_task"):

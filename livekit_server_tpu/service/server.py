@@ -78,6 +78,7 @@ class LivekitServer:
         self.app.router.add_get("/debug/ticks", self.debug_ticks)
         self.app.router.add_get("/debug/overload", self.debug_overload)
         self.app.router.add_get("/debug/integrity", self.debug_integrity)
+        self.app.router.add_get("/debug/migration", self.debug_migration)
         self._runner: web.AppRunner | None = None
         self._sites: list[web.TCPSite] = []
         self._stats_task: asyncio.Task | None = None
@@ -232,6 +233,19 @@ class LivekitServer:
                     rm.supervisor.restarts if rm.supervisor is not None else 0
                 ),
                 "limits": asdict(self.config.limits),
+            }
+        )
+
+    async def debug_migration(self, request: web.Request) -> web.Response:
+        """Migration-plane state: drain flag, in-flight handoffs with
+        their epochs, pending adoptions, and the lifetime counters
+        (commits, rollbacks, NACKs, bridged packets, stale-epoch drops)."""
+        mig = self.room_manager.migration
+        return web.json_response(
+            {
+                "enabled": mig is not None,
+                "migration": mig.snapshot() if mig is not None else None,
+                "frozen_rows": sorted(self.room_manager.runtime.ingest.frozen_rows),
             }
         )
 
@@ -424,8 +438,19 @@ class LivekitServer:
     async def stop(self, force: bool = False) -> None:
         self.router.local_node.state = NodeState.SHUTTING_DOWN
         await self.router.drain()
-        if not force:
-            # graceful: wait briefly for participants to drain (server.go:295)
+        mig = self.room_manager.migration
+        if not force and mig is not None:
+            # Graceful stop IS a node drain: every local room migrates to
+            # a peer through the two-phase handoff (bounded concurrency,
+            # admissions refused throughout); rooms with no willing peer
+            # stay and are torn down by room_manager.stop() below.
+            try:
+                await mig.drain_node()
+            except Exception as e:  # noqa: BLE001 — stopping anyway
+                self.log.warn("graceful drain failed", error=str(e))
+        elif not force:
+            # Bus-less single node: nobody to migrate to. Wait briefly for
+            # participants to leave on their own (server.go:295).
             for _ in range(50):
                 if not any(r.participants for r in self.room_manager.rooms.values()):
                     break
@@ -488,4 +513,8 @@ def create_server(config: Config, bus=None, mesh=None) -> LivekitServer:
     rm = RoomManager(config, router, store, mesh=mesh, telemetry=telemetry)
     server = LivekitServer(config, router, store, rm, telemetry)
     server._selector = create_selector(config.node_selector, config.region)
+    if rm.migration is not None:
+        # Drain-target ranking reuses the placement selector, so a drain
+        # spreads rooms the same way the router places new ones.
+        rm.migration.selector = server._selector
     return server

@@ -30,9 +30,9 @@ async def start_bus() -> BusServer:
     return bus
 
 
-async def start_node(bus_port: int):
+async def start_node(bus_port: int, **cfg_overrides):
     client = await TCPBusClient.connect("127.0.0.1", bus_port)
-    srv = create_server(make_config(free_port()), bus=client)
+    srv = create_server(make_config(free_port(), **cfg_overrides), bus=client)
     await srv.start()
     return srv, client
 
@@ -248,6 +248,103 @@ async def test_room_handoff_over_bus():
             # survives the hop (host-side state since the round-5 split).
             last_sn = int(rt_b.munger.last_sn[room_b.slots.row, 0, 1])
             assert last_sn == 102
+    finally:
+        for srv in (srv_a, srv_b):
+            if srv is not None:
+                await srv.stop(force=True)
+        bus.close()
+
+
+async def test_two_phase_migration_under_load_over_bus():
+    """The migration plane's tentpole drill over real TCP sockets: audio
+    flows while the room migrates A → B through the two-phase handoff.
+    Every pushed SN egresses exactly once — packets landing in the freeze
+    window are bridged to the target, not dropped — and the munger lane
+    continues contiguously on the target (no stream reset)."""
+    bus = await start_bus()
+    srv_a = srv_b = None
+    try:
+        # Deep per-tick packet slots: under full-suite CPU load a 10ms
+        # tick can stretch past several pump periods, and the default 4
+        # slots per (room, track) would capacity-drop legitimate audio
+        # with no migration involved at all.
+        srv_a, _ = await start_node(bus.port, pkts_per_track=16)
+        srv_b, _ = await start_node(bus.port, pkts_per_track=16)
+        rm_a, rm_b = srv_a.room_manager, srv_b.room_manager
+        rt_a, rt_b = rm_a.runtime, rm_b.runtime
+        assert rm_a.migration is not None and rm_b.migration is not None
+
+        async with aiohttp.ClientSession() as s:
+            alice = SignalClient(s, srv_a.port)
+            await alice.connect("live", "alice")
+            row_a = rm_a.rooms["live"].slots.row
+            rt_a.set_track(row_a, 0, published=True, is_video=False)
+            rt_a.set_subscription(row_a, 0, 1, subscribed=True)
+
+            got: list[int] = []   # audio SNs egressed to sub 1, either node
+
+            def collect(res):
+                got.extend(
+                    p.sn for p in res.egress if p.track == 0 and p.sub == 1
+                )
+
+            rt_a.on_tick(collect)
+            rt_b.on_tick(collect)
+            # Subscription masks don't travel; the adopting node re-arms
+            # the listener (stand-in for the client's reconnect).
+            rm_b.migration.on_adopt.append(
+                lambda r: rt_b.set_subscription(
+                    r.slots.row, 0, 1, subscribed=True
+                )
+            )
+
+            stop = asyncio.Event()
+            sent: list[int] = []
+
+            async def pump():
+                sn = 500
+                while not stop.is_set():
+                    for rm in (rm_a, rm_b):
+                        room = rm.rooms.get("live")
+                        if room is not None:
+                            rm.runtime.ingest.push(PacketIn(
+                                room=room.slots.row, track=0, sn=sn,
+                                ts=960 * (sn - 500), size=40, payload=b"s",
+                            ))
+                            sent.append(sn)
+                            sn += 1
+                            break
+                    await asyncio.sleep(0.004)
+
+            pump_task = asyncio.ensure_future(pump())
+            await asyncio.sleep(0.3)               # media flowing on A
+            assert await rm_a.migrate_room("live")
+            assert "live" not in rm_a.rooms and "live" in rm_b.rooms
+            assert (
+                await srv_a.router.get_node_for_room("live")
+                == srv_b.router.local_node.node_id
+            )
+            await asyncio.sleep(0.3)               # media flowing on B
+            stop.set()
+            await pump_task
+            await asyncio.sleep(0.2)               # drain the last ticks
+
+            # 100% audio continuity across the cutover: every pushed SN
+            # egressed exactly once — none dropped in the freeze window,
+            # none duplicated by the bridge replay. (Set equality, not
+            # order: a bridged straggler may share a tick with a direct
+            # push on the target.)
+            assert sorted(got) == sent, (
+                f"lost={sorted(set(sent) - set(got))[:10]} "
+                f"dup={sorted(sn for sn in set(got) if got.count(sn) > 1)[:10]}"
+            )
+            assert len(got) > 60, "pump never reached the plane"
+            # The lane continued — target's last SN is the last one sent.
+            row_b = rm_b.rooms["live"].slots.row
+            assert int(rt_b.munger.last_sn[row_b, 0, 1]) == sent[-1]
+            st = rm_a.migration.stats
+            assert st["commits"] == 1 and st["rollbacks"] == 0
+            await alice.close()
     finally:
         for srv in (srv_a, srv_b):
             if srv is not None:

@@ -323,6 +323,55 @@ def test_gc04_retry_async_managed(tmp_path):
     assert gc04.run(project, cfg_for("gc04")) == []
 
 
+# Periodic poll worker: the sleep is the SCHEDULE (top of body), not a
+# reaction to failure — the service-plane workers' shape. Must not fire.
+GC04_POLL = """\
+    import asyncio
+
+    class W:
+        async def worker(self):
+            while True:
+                await asyncio.sleep(5.0)
+                try:
+                    await self.scan()
+                except (ConnectionError, OSError):
+                    continue
+"""
+
+# Tail-sleep retry: the handler swallows the net error and the loop
+# sleeps AFTER the try — backoff in disguise. Must still fire.
+GC04_TAIL = """\
+    import asyncio
+
+    class W:
+        async def reconnect(self):
+            while True:
+                try:
+                    await self.dial()
+                    return
+                except OSError:
+                    pass
+                await asyncio.sleep(0.1)
+"""
+
+
+def test_gc04_periodic_poll_is_not_a_retry_loop(tmp_path):
+    project = make_project(tmp_path, {"pkg/worker.py": GC04_POLL})
+    assert gc04.run(project, cfg_for("gc04")) == []
+
+
+def test_gc04_tail_sleep_retry_still_fires(tmp_path):
+    project = make_project(tmp_path, {"pkg/worker.py": GC04_TAIL})
+    assert lines_of(gc04.run(project, cfg_for("gc04")), "GC04") == [5]
+
+
+def test_gc04_scope_covers_service_plane():
+    """The migration PR widened GC04 to the service plane: every bus
+    send in service/ (handoffs, drains, admin RPC) must ride retry_async
+    or tolerate-until-next-interval — never an ad-hoc backoff loop."""
+    assert "livekit_server_tpu/service" in core.DEFAULT_CONFIG["gc04"]["paths"]
+
+
 # -- GC05 bounded queues ----------------------------------------------------
 
 GC05_FIXTURE = """\
