@@ -168,6 +168,22 @@ class PlaneConfig:
 
 
 @dataclass
+class EgressConfig:
+    """Sharded native egress plane (runtime/egress_plane.py): per-core
+    shards of the munge→assemble→seal→send walk, with multicast-shaped
+    canonical staging for high-subscriber fan-out."""
+
+    # Worker shards for the native egress/munge walk. 0 = auto
+    # (min(8, cpu cores)); 1 pins everything inline on the caller thread.
+    shards: int = 0
+    # Stage each (room, track, packet) group's canonical datagram once and
+    # patch per-subscriber headers from it, instead of re-gathering payload
+    # + extensions per subscriber (P3FA-style constrained multicast).
+    # Sealing still runs per datagram — each has a unique counter/nonce.
+    multicast_seal: bool = True
+
+
+@dataclass
 class KeyValueConfig:
     """Shared KV for multi-node state (the reference's Redis seat,
     redisrouter.go / redisstore.go). kind=memory keeps single-node mode
@@ -351,6 +367,7 @@ class Config:
     limits: LimitsConfig = field(default_factory=LimitsConfig)
     node_selector: NodeSelectorConfig = field(default_factory=NodeSelectorConfig)
     plane: PlaneConfig = field(default_factory=PlaneConfig)
+    egress: EgressConfig = field(default_factory=EgressConfig)
     kv: KeyValueConfig = field(default_factory=KeyValueConfig)
     relay: RelayConfig = field(default_factory=RelayConfig)
     webhook: WebHookConfig = field(default_factory=WebHookConfig)
@@ -490,6 +507,9 @@ def _validate(cfg: Config) -> None:
     for name in ("tick_ms", "rooms", "tracks_per_room", "pkts_per_track", "subs_per_room"):
         if getattr(p, name) <= 0:
             raise ConfigError(f"plane.{name} must be positive")
+    eg = cfg.egress
+    if not 0 <= eg.shards <= 64:
+        raise ConfigError(f"egress.shards must be in [0, 64], got {eg.shards}")
     f = cfg.faults
     for name in ("drop_pct", "dup_pct", "delay_pct"):
         v = getattr(f, name)

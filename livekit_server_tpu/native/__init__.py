@@ -26,6 +26,14 @@ _SRC = Path(__file__).resolve().parents[2] / "native" / "rtp_parser.cpp"
 _EGRESS_SRC = Path(__file__).resolve().parents[2] / "native" / "egress.cpp"
 _CACHE = Path(__file__).resolve().parent / "_build"
 
+# Expected ABI of the compiled libraries; each .so exports an
+# *_abi_version() checked at load time. A mismatch (stale cached build
+# against newer Python bindings, or vice versa) forces one rebuild, then
+# degrades to the pure-Python path rather than calling through a wrong
+# signature. tools/check.py compares these strictly and fails the build.
+EGRESS_ABI = 3
+MUNGE_ABI = 2
+
 # Keep in sync with struct ParsedPacket in rtp_parser.cpp.
 PARSED_DTYPE = np.dtype(
     [
@@ -428,9 +436,27 @@ class _PythonRTP:
 
 
 def _build_egress() -> Path | None:
-    return _compile(
-        _EGRESS_SRC, "libegress.so", ("-pthread", "-l:libcrypto.so.3")
-    )
+    # The EVP_* subset used is ABI-stable across OpenSSL 1.1 and 3; link
+    # against whichever libcrypto the image actually ships (images differ).
+    for crypto in ("-l:libcrypto.so.3", "-l:libcrypto.so.1.1", "-lcrypto"):
+        so = _compile(_EGRESS_SRC, "libegress.so", ("-pthread", crypto))
+        if so is not None:
+            return so
+    return None
+
+
+def _check_abi(lib: ctypes.CDLL, symbol: str, want: int, what: str) -> None:
+    """Raise OSError unless the library reports the expected ABI version.
+    A missing symbol means a pre-versioning build — also a mismatch."""
+    try:
+        fn = getattr(lib, symbol)
+    except AttributeError as e:
+        raise OSError(f"{what}: no {symbol} symbol (pre-ABI build)") from e
+    fn.restype = ctypes.c_int32
+    fn.argtypes = []
+    got = int(fn())
+    if got != want:
+        raise OSError(f"{what}: ABI {got} != expected {want}")
 
 
 class NativeEgress:
@@ -443,12 +469,27 @@ class NativeEgress:
 
     def __init__(self, so: Path):
         self.lib = ctypes.CDLL(str(so))
+        _check_abi(self.lib, "egress_abi_version", EGRESS_ABI, "libegress")
         self.lib.egress_batch_send.restype = ctypes.c_int64
         self.lib.egress_batch_send.argtypes = (
             [ctypes.c_int, ctypes.c_int, ctypes.c_void_p, ctypes.c_int32]
             + [ctypes.c_void_p] * 24     # pay_off..out_len
             + [ctypes.c_int]             # pace_window_us
         )
+        self.lib.egress_plane_send.restype = ctypes.c_int64
+        self.lib.egress_plane_send.argtypes = (
+            [ctypes.c_int, ctypes.c_int,              # fd, n_shards
+             ctypes.c_void_p, ctypes.c_void_p,        # shard_lo, shard_hi
+             ctypes.c_void_p, ctypes.c_int32]         # slab, n
+            + [ctypes.c_void_p] * 24                  # pay_off..out_len
+            + [ctypes.c_void_p, ctypes.c_void_p,      # rooms, grp
+               ctypes.c_int32, ctypes.c_int]          # grp_slots, pace_us
+            + [ctypes.c_void_p] * 3                   # shard sent/built/ns
+        )
+        self.lib.egress_pool_ensure.restype = None
+        self.lib.egress_pool_ensure.argtypes = [ctypes.c_int]
+        self.lib.egress_pool_size.restype = ctypes.c_int32
+        self.lib.egress_pool_size.argtypes = []
         self.lib.rx_batch.restype = ctypes.c_int32
         self.lib.rx_batch.argtypes = [
             ctypes.c_int, ctypes.c_void_p, ctypes.c_int64, ctypes.c_void_p,
@@ -595,6 +636,86 @@ class NativeEgress:
         )
         return out, out_off, out_len, int(sent)
 
+    def pool_ensure(self, n: int) -> None:
+        """Pre-warm the persistent shard worker pool (idempotent)."""
+        self.lib.egress_pool_ensure(int(n))
+
+    def pool_size(self) -> int:
+        return int(self.lib.egress_pool_size())
+
+    def send_sharded(self, fd, shard_lo, shard_hi, slab, pay_off, pay_len,
+                     marker, pt, vp8, sn, ts, ssrc, pid, tl0, kidx, ip,
+                     port, seal, key_idx, keys, key_ids, counters, rooms,
+                     grp, grp_slots, ext_blob=b"", ext_off=None,
+                     ext_len=None, pace_window_us=0):
+        """Plane path: entries pre-sorted by (room, sub, track, k) and cut
+        into room-aligned shards [shard_lo[i], shard_hi[i]), each run by a
+        persistent pool worker (assemble + group-canonical reuse + seal +
+        GSO/sendmmsg on its own disjoint out range). `grp[i]` >= 0 names
+        the entry's canonical-cache slot (same (track, packet) group),
+        -1 forces a direct build; `rooms` scopes slot validity. Returns
+        (out, out_off, out_len, sent, shard_sent, shard_built, shard_ns);
+        with fd < 0 nothing hits the network and `sent` counts built
+        datagrams (tests / build-only mode)."""
+        n = len(pay_off)
+        n_shards = len(shard_lo)
+        if ext_off is None:
+            ext_off = np.zeros(n, np.int64)
+            ext_len = np.zeros(n, np.int32)
+        pay_len_c = np.ascontiguousarray(pay_len, np.int32)
+        ext_len_c = np.ascontiguousarray(ext_len, np.int32)
+        seal_c = np.ascontiguousarray(seal, np.uint8)
+        kix_c = np.ascontiguousarray(key_idx, np.int32)
+        clear_len = 12 + ext_len_c.astype(np.int64) + pay_len_c.astype(np.int64)
+        out_len = np.where(
+            (seal_c != 0) & (kix_c >= 0),
+            clear_len + self.SEAL_OVERHEAD, clear_len,
+        ).astype(np.int32)
+        out_off = np.zeros(n, np.int64)
+        np.cumsum(out_len[:-1], out=out_off[1:])
+        out = np.zeros(int(out_off[-1]) + int(out_len[-1]) if n else 0, np.uint8)
+        slab_arr = (
+            np.frombuffer(slab, np.uint8) if not isinstance(slab, np.ndarray)
+            else slab
+        )
+        if not len(slab_arr):
+            slab_arr = np.zeros(1, np.uint8)
+        ext_arr = (
+            np.frombuffer(ext_blob, np.uint8) if len(ext_blob)
+            else np.zeros(1, np.uint8)
+        )
+        shard_sent = np.zeros(n_shards, np.int64)
+        shard_built = np.zeros(n_shards, np.int64)
+        shard_ns = np.zeros(n_shards, np.int64)
+        # Bind every converted array to a keep-list: a temporary's buffer
+        # must outlive the C call (see open_batch's same caveat).
+        keep = []
+
+        def c(a, dt):
+            arr = np.ascontiguousarray(a, dt)
+            keep.append(arr)
+            return arr.ctypes.data
+
+        sent = self.lib.egress_plane_send(
+            int(fd), n_shards, c(shard_lo, np.int64), c(shard_hi, np.int64),
+            slab_arr.ctypes.data, n,
+            c(pay_off, np.int64), pay_len_c.ctypes.data,
+            c(marker, np.uint8), c(pt, np.uint8), c(vp8, np.uint8),
+            ext_arr.ctypes.data, c(ext_off, np.int64), ext_len_c.ctypes.data,
+            c(sn, np.uint16), c(ts, np.uint32), c(ssrc, np.uint32),
+            c(pid, np.int32), c(tl0, np.int32), c(kidx, np.int32),
+            c(ip, np.uint32), c(port, np.uint16),
+            seal_c.ctypes.data, kix_c.ctypes.data,
+            c(keys, np.uint8), c(key_ids, np.uint32), c(counters, np.uint64),
+            out.ctypes.data, out_off.ctypes.data, out_len.ctypes.data,
+            c(rooms, np.int32), c(grp, np.int32), int(grp_slots),
+            int(pace_window_us),
+            shard_sent.ctypes.data, shard_built.ctypes.data,
+            shard_ns.ctypes.data,
+        )
+        del keep
+        return out, out_off, out_len, int(sent), shard_sent, shard_built, shard_ns
+
     def send_raw(self, fd, blob, offs, lens, ips, ports) -> int:
         """GSO/sendmmsg pre-built datagrams (blob + per-entry offset/
         length/destination arrays). Load generators and relays use this to
@@ -630,9 +751,17 @@ class NativeMunge:
 
     def __init__(self, so: Path):
         self.lib = ctypes.CDLL(str(so))
+        _check_abi(self.lib, "munge_abi_version", MUNGE_ABI, "libmunge")
         self.lib.munge_walk.restype = ctypes.c_int64
         self.lib.munge_walk.argtypes = (
             [ctypes.c_int32] * 5 + [ctypes.c_void_p] * 11
+            + [ctypes.c_void_p] * 13 + [ctypes.c_void_p] * 9
+            + [ctypes.c_int64]
+        )
+        self.lib.munge_walk_multi.restype = ctypes.c_int64
+        self.lib.munge_walk_multi.argtypes = (
+            [ctypes.c_int32] + [ctypes.c_void_p] * 4   # n_shards, lo/hi/cnt/ns
+            + [ctypes.c_int32] * 5 + [ctypes.c_void_p] * 11
             + [ctypes.c_void_p] * 13 + [ctypes.c_void_p] * 9
             + [ctypes.c_int64]
         )
@@ -686,6 +815,61 @@ class NativeMunge:
             )
         return tuple(o[:n] for o in outs)
 
+    def walk_multi(self, sn, ts, ts_jump, pid, tl0, keyidx, begin_pic,
+                   valid, send_bits, drop_bits, switch_bits, state,
+                   cap: int, r_lo, r_hi):
+        """Sharded walk: each shard owns the contiguous room range
+        [r_lo[i], r_hi[i]) — state rows are room-indexed, so whole-room
+        ownership keeps every state write disjoint across shards. Output
+        is written at exact prefix-sum bases, bit-identical to a single
+        walk regardless of shard count. Returns (columns, shard_counts,
+        shard_ns) with the same columns as walk(); None on pre-pass
+        overflow (nothing mutated); raises on the -2 invariant code."""
+        R, T, K = sn.shape
+        S = state.sn_offset.shape[-1]
+        W = send_bits.shape[-1]
+        c32 = lambda x: np.ascontiguousarray(x, np.int32)  # noqa: E731
+        cw = lambda x: np.ascontiguousarray(x).view(np.uint32)  # noqa: E731
+        cu8 = lambda x: np.ascontiguousarray(x, np.uint8)  # noqa: E731
+        lo_c, hi_c = c32(r_lo), c32(r_hi)
+        n_shards = len(lo_c)
+        shard_counts = np.zeros(n_shards, np.int64)
+        shard_ns = np.zeros(n_shards, np.int64)
+        sn_c, ts_c, tj_c = c32(sn), c32(ts), c32(ts_jump)
+        pid_c, tl0_c, ki_c = c32(pid), c32(tl0), c32(keyidx)
+        bp_c, v_c = cu8(begin_pic), cu8(valid)
+        sb, db, wb = cw(c32(send_bits)), cw(c32(drop_bits)), cw(c32(switch_bits))
+        outs = [np.empty(cap, np.int32) for _ in range(9)]
+        st_ptrs = [
+            getattr(state, f).ctypes.data for f in (
+                "sn_offset", "ts_offset", "last_sn", "last_ts",
+                "started", "aligned",
+                "pid_offset", "tl0_offset", "ki_offset",
+                "last_pid", "last_tl0", "last_ki", "v_started",
+            )
+        ]
+        n = self.lib.munge_walk_multi(
+            n_shards, lo_c.ctypes.data, hi_c.ctypes.data,
+            shard_counts.ctypes.data, shard_ns.ctypes.data,
+            R, T, K, S, W,
+            sb.ctypes.data, db.ctypes.data, wb.ctypes.data,
+            sn_c.ctypes.data, ts_c.ctypes.data, tj_c.ctypes.data,
+            pid_c.ctypes.data, tl0_c.ctypes.data, ki_c.ctypes.data,
+            bp_c.ctypes.data, v_c.ctypes.data,
+            *st_ptrs,
+            *[o.ctypes.data for o in outs],
+            cap,
+        )
+        if n == -1:
+            return None  # pre-pass overflow: state untouched, safe fallback
+        if n < -1:
+            raise RuntimeError(
+                f"munge_walk_multi invariant violation (code {n}): "
+                "capacity overflow after state mutation; dense fallback "
+                "would double-apply this tick"
+            )
+        return tuple(o[:n] for o in outs), shard_counts, shard_ns
+
 
 def _load():
     so = _build()
@@ -697,24 +881,60 @@ def _load():
     return _PythonRTP()
 
 
-def _load_egress():
-    so = _build_egress()
-    if so is not None:
+def _load_versioned(build, cls):
+    """Load an ABI-versioned library; a mismatch (stale cached .so) gets
+    exactly one forced rebuild before degrading to the Python path."""
+    for attempt in (0, 1):
+        so = build()
+        if so is None:
+            return None
         try:
-            return NativeEgress(so)
+            return cls(so)
         except OSError:
+            if attempt == 0:
+                try:
+                    so.unlink()
+                except OSError:
+                    return None
+                continue
             return None
     return None
+
+
+def _load_egress():
+    return _load_versioned(_build_egress, NativeEgress)
 
 
 def _load_munge():
-    so = _build_munge()
-    if so is not None:
+    return _load_versioned(_build_munge, NativeMunge)
+
+
+def native_smoke() -> list[str]:
+    """Strict build/ABI check for tools/check.py: compile every native
+    library from source and verify its ABI version and self-test. Returns
+    a list of failure strings (empty = healthy). Unlike the import-time
+    loaders this does NOT fall back silently — a libegress regression
+    must surface in CI before the bench discovers it."""
+    failures: list[str] = []
+    if _build() is None:
+        failures.append("librtp_parser.so: build failed")
+    so = _build_egress()
+    if so is None:
+        failures.append("libegress.so: build failed")
+    else:
         try:
-            return NativeMunge(so)
-        except OSError:
-            return None
-    return None
+            NativeEgress(so)
+        except OSError as e:
+            failures.append(f"libegress.so: {e}")
+    so = _build_munge()
+    if so is None:
+        failures.append("libmunge.so: build failed")
+    else:
+        try:
+            NativeMunge(so)
+        except OSError as e:
+            failures.append(f"libmunge.so: {e}")
+    return failures
 
 
 rtp = _load()

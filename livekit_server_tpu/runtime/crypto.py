@@ -28,14 +28,127 @@ import secrets
 try:
     from cryptography.exceptions import InvalidTag
     from cryptography.hazmat.primitives.ciphers.aead import AESGCM
-except ImportError:  # optional dependency: a node without the package
-    # still runs — cleartext media only (RoomManager skips registry
-    # creation, join responses omit media_crypto). Constructing any
-    # session/endpoint without it raises RuntimeError instead.
+except ImportError:  # optional dependency: fall back to libcrypto below
     AESGCM = None
 
     class InvalidTag(Exception):
         pass
+
+
+if AESGCM is None:
+    # Without the `cryptography` package, drive OpenSSL's EVP interface
+    # directly via ctypes (the same libcrypto native/egress.cpp links
+    # against, and the EVP_* subset used is stable across 1.1/3). Only if
+    # libcrypto itself is missing does the node degrade to cleartext
+    # media (RoomManager skips registry creation, join responses omit
+    # media_crypto; constructing any session/endpoint raises).
+    import ctypes
+    import ctypes.util
+
+    def _find_libcrypto():
+        for name in (
+            ctypes.util.find_library("crypto"),
+            "libcrypto.so.3", "libcrypto.so.1.1", "libcrypto.so",
+        ):
+            if not name:
+                continue
+            try:
+                lib = ctypes.CDLL(name)
+                lib.EVP_aes_128_gcm.restype = ctypes.c_void_p
+                return lib
+            except (OSError, AttributeError):
+                continue
+        return None
+
+    _libcrypto = _find_libcrypto()
+
+    if _libcrypto is not None:
+        _libcrypto.EVP_CIPHER_CTX_new.restype = ctypes.c_void_p
+        _libcrypto.EVP_CIPHER_CTX_free.argtypes = [ctypes.c_void_p]
+        for _f in ("EVP_EncryptInit_ex", "EVP_DecryptInit_ex"):
+            getattr(_libcrypto, _f).argtypes = [ctypes.c_void_p] * 5
+            getattr(_libcrypto, _f).restype = ctypes.c_int
+        for _f in ("EVP_EncryptUpdate", "EVP_DecryptUpdate"):
+            getattr(_libcrypto, _f).argtypes = [
+                ctypes.c_void_p, ctypes.c_char_p,
+                ctypes.POINTER(ctypes.c_int), ctypes.c_char_p, ctypes.c_int,
+            ]
+            getattr(_libcrypto, _f).restype = ctypes.c_int
+        for _f in ("EVP_EncryptFinal_ex", "EVP_DecryptFinal_ex"):
+            getattr(_libcrypto, _f).argtypes = [
+                ctypes.c_void_p, ctypes.c_char_p, ctypes.POINTER(ctypes.c_int),
+            ]
+            getattr(_libcrypto, _f).restype = ctypes.c_int
+        _libcrypto.EVP_CIPHER_CTX_ctrl.argtypes = [
+            ctypes.c_void_p, ctypes.c_int, ctypes.c_int, ctypes.c_void_p,
+        ]
+        _libcrypto.EVP_CIPHER_CTX_ctrl.restype = ctypes.c_int
+        _EVP_CTRL_GCM_SET_TAG = 0x11
+        _EVP_CTRL_GCM_GET_TAG = 0x10
+
+        class AESGCM:  # type: ignore[no-redef]
+            """API-compatible stand-in for cryptography's AESGCM
+            (16-byte keys / 12-byte nonces, the only shapes used here)."""
+
+            def __init__(self, key: bytes):
+                if len(key) != 16:
+                    raise ValueError("AES-128-GCM needs a 16-byte key")
+                self._key = bytes(key)
+
+            def encrypt(self, nonce: bytes, data: bytes, aad: bytes | None) -> bytes:
+                lc = _libcrypto
+                ctx = lc.EVP_CIPHER_CTX_new()
+                try:
+                    lc.EVP_EncryptInit_ex(
+                        ctx, lc.EVP_aes_128_gcm(), None, self._key, nonce
+                    )
+                    outl = ctypes.c_int(0)
+                    if aad:
+                        lc.EVP_EncryptUpdate(
+                            ctx, None, ctypes.byref(outl), aad, len(aad)
+                        )
+                    ct = ctypes.create_string_buffer(len(data) or 1)
+                    lc.EVP_EncryptUpdate(
+                        ctx, ct, ctypes.byref(outl), data, len(data)
+                    )
+                    fin = ctypes.create_string_buffer(16)
+                    lc.EVP_EncryptFinal_ex(ctx, fin, ctypes.byref(outl))
+                    tag = ctypes.create_string_buffer(16)
+                    lc.EVP_CIPHER_CTX_ctrl(ctx, _EVP_CTRL_GCM_GET_TAG, 16, tag)
+                    return ct.raw[: len(data)] + tag.raw
+                finally:
+                    lc.EVP_CIPHER_CTX_free(ctx)
+
+            def decrypt(self, nonce: bytes, data: bytes, aad: bytes | None) -> bytes:
+                if len(data) < 16:
+                    raise InvalidTag("short frame")
+                lc = _libcrypto
+                ct, tag = data[:-16], data[-16:]
+                ctx = lc.EVP_CIPHER_CTX_new()
+                try:
+                    lc.EVP_DecryptInit_ex(
+                        ctx, lc.EVP_aes_128_gcm(), None, self._key, nonce
+                    )
+                    outl = ctypes.c_int(0)
+                    if aad:
+                        lc.EVP_DecryptUpdate(
+                            ctx, None, ctypes.byref(outl), aad, len(aad)
+                        )
+                    pt = ctypes.create_string_buffer(len(ct) or 1)
+                    lc.EVP_DecryptUpdate(
+                        ctx, pt, ctypes.byref(outl), ct, len(ct)
+                    )
+                    lc.EVP_CIPHER_CTX_ctrl(
+                        ctx, _EVP_CTRL_GCM_SET_TAG, 16,
+                        ctypes.create_string_buffer(tag, 16),
+                    )
+                    fin = ctypes.create_string_buffer(16)
+                    ok = lc.EVP_DecryptFinal_ex(ctx, fin, ctypes.byref(outl))
+                    if ok != 1:
+                        raise InvalidTag("GCM tag mismatch")
+                    return pt.raw[: len(ct)]
+                finally:
+                    lc.EVP_CIPHER_CTX_free(ctx)
 
 
 HAVE_AEAD = AESGCM is not None

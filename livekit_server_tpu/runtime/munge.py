@@ -92,6 +92,10 @@ class HostMunger:
         self.last_tl0 = z()
         self.last_ki = z()
         self.v_started = f()
+        # Per-shard walk stats of the last sharded apply_columns (scraped
+        # by EgressPlane.record_munge for /debug/egress).
+        self.last_shard_counts = np.zeros(0, np.int64)
+        self.last_shard_ns = np.zeros(0, np.int64)
 
     # -- tick application -------------------------------------------------
     def apply_dense(
@@ -213,25 +217,50 @@ class HostMunger:
         self,
         sn, ts, ts_jump, pid, tl0, keyidx, begin_pic, valid,  # [R, T, K]
         send_bits, drop_bits, switch_bits,                    # [R, T, K, W] i32
+        shard_plan=None,
     ):
         """One tick's rewrites straight from the device's bit-packed masks
         to egress COLUMN arrays (rooms, tracks, ks, subs, sn, ts, pid,
         tl0, keyidx) — the production fan-out path. Uses the native C++
-        walker when available; numpy apply_dense + nonzero otherwise."""
+        walker when available; numpy apply_dense + nonzero otherwise.
+
+        `shard_plan` = (r_lo, r_hi) contiguous room ranges (from
+        EgressPlane.room_plan) fans the walk across the native worker
+        shards. Rooms are the state-ownership unit — lanes are indexed
+        [room, track, sub] — so whole-room shards keep every state write
+        thread-private, and migration freezes/snapshots (snapshot_room /
+        clear_room) stay valid: a frozen room's lanes live entirely inside
+        one shard and are never half-written. Output is bit-identical to
+        the unsharded walk (exact per-shard prefix-sum bases)."""
         from livekit_server_tpu import native
 
         send_bits = np.asarray(send_bits)
         if native.munge is not None:
             cap = int(_popcount_u32(send_bits.astype(np.uint32)).sum(dtype=np.int64))
-            res = native.munge.walk(
-                np.asarray(sn), np.asarray(ts), np.asarray(ts_jump),
-                np.asarray(pid), np.asarray(tl0), np.asarray(keyidx),
-                np.asarray(begin_pic), np.asarray(valid),
-                send_bits, np.asarray(drop_bits), np.asarray(switch_bits),
-                self, cap,
-            )
-            if res is not None:
-                return res
+            if shard_plan is not None and len(shard_plan[0]) > 1:
+                res = native.munge.walk_multi(
+                    np.asarray(sn), np.asarray(ts), np.asarray(ts_jump),
+                    np.asarray(pid), np.asarray(tl0), np.asarray(keyidx),
+                    np.asarray(begin_pic), np.asarray(valid),
+                    send_bits, np.asarray(drop_bits),
+                    np.asarray(switch_bits),
+                    self, cap, shard_plan[0], shard_plan[1],
+                )
+                if res is not None:
+                    cols, counts, ns = res
+                    self.last_shard_counts = counts
+                    self.last_shard_ns = ns
+                    return cols
+            else:
+                res = native.munge.walk(
+                    np.asarray(sn), np.asarray(ts), np.asarray(ts_jump),
+                    np.asarray(pid), np.asarray(tl0), np.asarray(keyidx),
+                    np.asarray(begin_pic), np.asarray(valid),
+                    send_bits, np.asarray(drop_bits), np.asarray(switch_bits),
+                    self, cap,
+                )
+                if res is not None:
+                    return res
         S = self.dims.subs
         send = plane.unpack_bits(send_bits, S)
         drop = plane.unpack_bits(drop_bits, S)

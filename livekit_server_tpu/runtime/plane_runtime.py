@@ -292,6 +292,8 @@ class PlaneRuntime:
         bwe_params=None,
         red_enabled: bool = True,
         low_latency: bool = False,
+        egress_shards: int = 0,
+        egress_multicast: bool = True,
     ):
         from livekit_server_tpu.ops import audio as audio_ops, bwe as bwe_ops
 
@@ -347,6 +349,14 @@ class PlaneRuntime:
         # Host-owned SN/TS/VP8 rewrite state (the round-5 decide-on-
         # device / rewrite-on-host split; see runtime/munge.py).
         self.munger = HostMunger(dims)
+        # Sharded native egress plane (runtime/egress_plane.py): one
+        # shared instance plans the room-aligned shard cuts for BOTH the
+        # munge walk (here, _fan_out) and the send walk (udp.py attaches
+        # via attach_egress_plane) and aggregates per-shard stats.
+        from livekit_server_tpu.runtime.egress_plane import EgressPlane
+
+        self.egress_plane = EgressPlane(egress_shards, egress_multicast)
+        self._munge_shard_plan = self.egress_plane.room_plan(dims.rooms)
         self._mesh = mesh
         if mesh is not None:
             from livekit_server_tpu.parallel import make_sharded_tick, shard_tree
@@ -707,14 +717,24 @@ class PlaneRuntime:
         late = bool(st.deadline) and time.perf_counter() > st.deadline
         if late:
             self.stats["late_ticks"] += 1
-        self.recent_ticks.append({
+        tick_rec = {
             "idx": st.idx, "depth": st.depth,
             "stage_ms": round(st.stage_s * 1000.0, 3),
             "device_ms": round(st.device_s * 1000.0, 3),
             "fanout_ms": round(fanout_s * 1000.0, 3),
             "total_ms": round(result.tick_s * 1000.0, 3),
             "late": late,
-        })
+        }
+        # Per-shard egress timing: the send callbacks above just ran, so
+        # the plane's last-send snapshot is THIS tick's (munge likewise).
+        ep = self.egress_plane
+        if ep.last_munge:
+            tick_rec["munge_shard_ms"] = ep.last_munge.get("ms")
+        if ep.last_send:
+            tick_rec["egress_shard_ms"] = [
+                s["ms"] for s in ep.last_send.get("shards", [])
+            ]
+        self.recent_ticks.append(tick_rec)
         if self.governor is not None:
             # Close the overload loop on the finished tick's verdict.
             self.governor.on_tick(self.recent_ticks[-1])
@@ -866,8 +886,14 @@ class PlaneRuntime:
                 inp.sn, inp.ts, inp.ts_jump, inp.pid, inp.tl0, inp.keyidx,
                 inp.begin_pic, inp.valid,
                 send_bits, drop_bits, switch_bits,
+                shard_plan=self._munge_shard_plan,
             )
         )
+        if len(self.munger.last_shard_ns):
+            self.egress_plane.record_munge(
+                self.munger.last_shard_counts, self.munger.last_shard_ns
+            )
+            self.munger.last_shard_ns = self.munger.last_shard_ns[:0]
         batch = EgressBatch(
             rooms=rr, tracks=tt, ks=kk, subs=ss,
             sn=b_sn, ts=b_ts, pid=b_pid, tl0=b_tl0, keyidx=b_ki,
@@ -924,6 +950,7 @@ class PlaneRuntime:
     # -- loop ------------------------------------------------------------
     def start(self) -> None:
         if self._task is None:
+            self.egress_plane.warm()  # spawn shard workers off the hot path
             self._task = asyncio.ensure_future(self._run())
 
     @staticmethod

@@ -511,6 +511,11 @@ class UDPMediaTransport(asyncio.DatagramProtocol):
         self._twcc_last_send = np.zeros((R, S), np.float64)
         self._twcc_last_recv = np.zeros((R, S), np.float64)
         self.egress_threads = 4
+        # Sharded egress orchestrator (runtime/egress_plane.py). Attached
+        # by the room manager after PlaneRuntime construction; when set,
+        # send_egress_batch routes through the native sharded fan-out
+        # (egress_plane_send) instead of the flat n_threads pool.
+        self._egress_plane = None
         # Always-on packet-in→wire-out latency histogram (stamps: rx_batch
         # return → native egress send return; includes tick-queue wait).
         self.fwd_latency = ForwardLatencyProbe()
@@ -1903,6 +1908,17 @@ class UDPMediaTransport(asyncio.DatagramProtocol):
             )
         return mask
 
+    def attach_egress_plane(self, plane) -> None:
+        """Adopt the runtime's sharded egress orchestrator
+        (runtime/egress_plane.py). From the next tick on,
+        send_egress_batch routes through the native plane path —
+        room-aligned shards on the persistent worker pool with
+        multicast-shaped canonical staging — and reports per-shard
+        stage timings back through `plane.record_send`."""
+        self._egress_plane = plane
+        if plane is not None:
+            plane.warm()
+
     def send_egress_batch(self, batch, red_plan=None, layer_caps=None,
                           pacer_allowed=None) -> np.ndarray:
         """Vectorized tick egress (the hot half of DownTrack.WriteRTP +
@@ -1972,8 +1988,15 @@ class UDPMediaTransport(asyncio.DatagramProtocol):
             # collapses into single GSO messages — the syscall count drops
             # from per-datagram to per-(subscriber, track) burst. Within a
             # (room, sub, track) stream k-order is preserved, so SNs still
-            # leave the host in order.
-            idx = idx[np.lexsort((k[idx], t[idx], s[idx], r[idx]))]
+            # leave the host in order. One composite-key argsort instead of
+            # a 4-key lexsort: each lexsort pass re-permutes all keys, the
+            # fused int64 key sorts once (dims bound each factor).
+            _S = self._sub_port.shape[1]
+            composite = (
+                ((r[idx].astype(np.int64) * _S + s[idx]) * _T + t[idx]) * _K
+                + k[idx]
+            )
+            idx = idx[np.argsort(composite, kind="stable")]
             rr_, tt_, ss_ = r[idx], t[idx], s[idx]
             kk_ = k[idx]
             ssrc = self._egress_ssrc_arr[rr_, ss_, tt_].copy()
@@ -2041,7 +2064,7 @@ class UDPMediaTransport(asyncio.DatagramProtocol):
                 if self._pace_pending is not None and not self._pace_pending.done():
                     pace_us = 0
             send_args = dict(
-                fd=fd, n_threads=self.egress_threads,
+                fd=fd,
                 slab=batch.payloads.data,
                 pay_off=po[idx], pay_len=pl[idx],
                 marker=batch.payloads.marker.reshape(-1)[
@@ -2062,13 +2085,42 @@ class UDPMediaTransport(asyncio.DatagramProtocol):
                 pace_window_us=pace_us,
             )
             n_entries = len(idx)
+            plane = self._egress_plane
+            use_plane = plane is not None and hasattr(native_egress, "send_sharded")
+            if use_plane:
+                # Sharded plane path: room-aligned entry ranges on the
+                # persistent pool, canonical-group slots for the
+                # multicast-shaped assembly, per-shard timings recorded.
+                sh_lo, sh_hi = plane.entry_plan(rr_)
+                grp, grp_slots = plane.group_slots(
+                    flat_rtk[idx], tt_, kk_, _T, _K
+                )
+                if grp is None:
+                    grp = np.full(n_entries, -1, np.int32)
+                    grp_slots = 0
+                send_args.update(
+                    shard_lo=sh_lo, shard_hi=sh_hi,
+                    rooms=rr_.astype(np.int32), grp=grp, grp_slots=grp_slots,
+                )
+                n_grouped = int((grp >= 0).sum())
+            else:
+                send_args["n_threads"] = self.egress_threads
             t_arr = (
                 batch.payloads.t_arr.reshape(-1)[flat_rtk[idx]]
                 if batch.payloads.t_arr is not None else None
             )
 
             def do_send(args=send_args, n_entries=n_entries, t_arr=t_arr):
-                _, _, _, sent = native_egress.send(**args)
+                if use_plane:
+                    (_, _, _, sent, sh_sent, sh_built,
+                     sh_ns) = native_egress.send_sharded(**args)
+                    plane.record_send(
+                        n_entries, n_grouped, sent,
+                        args["shard_lo"], args["shard_hi"],
+                        sh_sent, sh_built, sh_ns,
+                    )
+                else:
+                    _, _, _, sent = native_egress.send(**args)
                 self.stats["tx"] += sent
                 if sent < n_entries:
                     self.stats["tx_drop"] = (

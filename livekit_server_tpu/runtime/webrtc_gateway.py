@@ -38,6 +38,10 @@ __all__ = ["WebRtcGateway", "GatewayPeer"]
 
 # Handshake retransmit cadence (DTLS timer service).
 TIMER_MS = 100.0
+# Abandoned-handshake TTL (pion ICE disconnectedTimeout neighborhood): a
+# peer created at answer time that never reaches SRTP keys within this
+# window is torn down by service_timers.
+PEER_HANDSHAKE_TTL_S = 30.0
 
 
 class GatewayPeer:
@@ -286,7 +290,13 @@ class WebRtcGateway:
         return True
 
     def service_timers(self) -> None:
-        """DTLS retransmission timers (call ~100 ms cadence)."""
+        """DTLS retransmission timers (call ~100 ms cadence) + abandoned
+        handshake reaping: a peer that never completes DTLS within
+        PEER_HANDSHAKE_TTL_S holds an ufrag slot, a DTLS endpoint, and a
+        minted crypto session forever (the signalling side has no
+        disconnect to observe for a client that answered the offer and
+        vanished) — reap it. Peers with established SRTP are NEVER
+        reaped here; their lifetime belongs to the signalling plane."""
         now = time.monotonic()
         for peer in list(self.peers_by_ufrag.values()):
             if (
@@ -298,6 +308,14 @@ class WebRtcGateway:
                 peer._last_timer = now
                 for d in peer.dtls.handle_timeout():
                     self._raw_send(d, peer.addr)
+            if (
+                not peer.srtp_ready
+                and now - peer.created_s >= PEER_HANDSHAKE_TTL_S
+            ):
+                self.stats["peers_reaped"] = (
+                    self.stats.get("peers_reaped", 0) + 1
+                )
+                self.close_peer(peer)
 
     # -- SRTP media -------------------------------------------------------
 

@@ -76,6 +76,8 @@ class RoomManager:
                 estimate_required_downgrades=config.rtc.congestion_control.estimate_required_downgrades,
                 congested_min_estimate=config.rtc.congestion_control.min_channel_capacity,
             ),
+            egress_shards=config.egress.shards,
+            egress_multicast=config.egress.multicast_seal,
         )
         self.rooms: dict[str, Room] = {}
         self._row_to_room: dict[int, Room] = {}
@@ -772,6 +774,7 @@ class RoomManager:
                 self.telemetry.observe_overload(self.governor.stats_dict())
             if self.integrity is not None:
                 self.telemetry.observe_integrity(self.integrity_stats())
+            self.telemetry.observe_egress(self.runtime.egress_plane.observe())
 
     def integrity_stats(self) -> dict:
         """IntegrityMonitor stats + the checkpoint-generation fallback

@@ -78,6 +78,7 @@ class LivekitServer:
         self.app.router.add_get("/debug/ticks", self.debug_ticks)
         self.app.router.add_get("/debug/overload", self.debug_overload)
         self.app.router.add_get("/debug/integrity", self.debug_integrity)
+        self.app.router.add_get("/debug/egress", self.debug_egress)
         self.app.router.add_get("/debug/migration", self.debug_migration)
         self._runner: web.AppRunner | None = None
         self._sites: list[web.TCPSite] = []
@@ -249,6 +250,17 @@ class LivekitServer:
             }
         )
 
+    async def debug_egress(self, request: web.Request) -> web.Response:
+        """Sharded egress plane: host_egress_pps, shard plan, canonical
+        grouping rates, per-shard sent/busy totals, and the last tick's
+        per-shard send + munge breakdowns."""
+        rm = self.room_manager
+        snap = rm.runtime.egress_plane.observe()
+        if rm.udp is not None:
+            snap["tx_total"] = rm.udp.stats.get("tx", 0)
+            snap["tx_drop_total"] = rm.udp.stats.get("tx_drop", 0)
+        return web.json_response(snap)
+
     async def debug_integrity(self, request: web.Request) -> web.Response:
         """State-integrity plane: audits run, violations by rule, the
         quarantine/repair ladder's outcomes, checkpoint checksum failures
@@ -338,6 +350,12 @@ class LivekitServer:
                 )
                 # Client PLIs over RTCP reach signal-plane publishers too.
                 self.room_manager.udp.on_pli = self.room_manager.handle_pli
+                # Sharded egress plane: the runtime owns the orchestrator
+                # (shard plans, canonical grouping, per-shard stats); the
+                # transport routes tick egress through it from here on.
+                self.room_manager.udp.attach_egress_plane(
+                    self.room_manager.runtime.egress_plane
+                )
                 self.room_manager.udp.send_side_bwe = (
                     self.config.rtc.congestion_control.send_side_bwe
                 )

@@ -165,6 +165,25 @@ class TelemetryService:
             snap.get("generation_fallbacks", 0),
         )
 
+    def observe_egress(self, snap: dict[str, Any]) -> None:
+        """Sharded egress plane (runtime/egress_plane.py observe()):
+        host-side datagram throughput over critical-path send time, total
+        volumes, and per-shard sent/busy breakdowns."""
+        self.set_gauge("livekit_host_egress_pps", snap.get("host_egress_pps", 0.0))
+        self.set_gauge("livekit_egress_shards", snap.get("shards", 0))
+        for k in ("entries", "grouped_entries", "datagrams"):
+            self.set_gauge(f"livekit_egress_{k}_total", snap.get(k, 0))
+        self.set_gauge(
+            "livekit_egress_send_ms_total", snap.get("send_ms_total", 0.0)
+        )
+        self.set_gauge(
+            "livekit_egress_munge_ms_total", snap.get("munge_ms_total", 0.0)
+        )
+        for i, sent in enumerate(snap.get("shard_sent", [])):
+            self.set_gauge("livekit_egress_shard_sent_total", sent, shard=str(i))
+        for i, ms in enumerate(snap.get("shard_send_ms", [])):
+            self.set_gauge("livekit_egress_shard_busy_ms_total", ms, shard=str(i))
+
     def observe_queue_drops(self) -> None:
         """Bus/signal back-pressure drops (the QueueFull paths that used
         to lose messages with at most a local count): process-wide

@@ -25,14 +25,17 @@
 // ABI: plain C, loaded via ctypes (no pybind11 in this image).
 
 #include <atomic>
+#include <condition_variable>
 #include <cstdint>
 #include <cstring>
+#include <mutex>
 #include <thread>
 #include <vector>
 
 #include <errno.h>
 #include <netinet/in.h>
 #include <sys/socket.h>
+#include <time.h>
 #include <unistd.h>
 
 // UDP generic segmentation offload (Linux ≥ 4.18): one sendmsg carries a
@@ -79,6 +82,9 @@ constexpr uint8_t SEAL_MAGIC = 0x01;
 constexpr uint8_t DIR_S2C = 1;
 constexpr int MAX_DGRAM = 2048;
 constexpr int MMSG_CHUNK = 512;
+// Bump when the exported symbol set or any signature changes; the ctypes
+// loader and tools/check.py compare it against the Python-side constant.
+constexpr int32_t EGRESS_ABI = 3;
 // Kernel cap is UDP_MAX_SEGMENTS (64); stay under it and under 64 KB.
 constexpr int GSO_MAX_SEGS = 60;
 constexpr int64_t GSO_MAX_BYTES = 64000;
@@ -324,13 +330,61 @@ int64_t send_gso(const Args& a, int lo, int hi, int* resume) {
   return sent;
 }
 
+inline int64_t now_ns() {
+  timespec ts;
+  clock_gettime(CLOCK_MONOTONIC, &ts);
+  return (int64_t)ts.tv_sec * 1000000000ll + ts.tv_nsec;
+}
+
+// Multicast-shaped grouping (P3FA): entries that fan one source packet out
+// to many subscribers share a canonical staging of its bytes. The group
+// key is the packet's (track, k) slot; rooms are walked in order, so a
+// slot is valid only for the room it was staged in. Matching on
+// (room, pay_off, ext section) keeps the reuse sound when subscribers get
+// different extension sections (per-sub layer caps).
+struct CanonSlot {
+  int32_t room = -1;     // staging scope; -1 = never staged
+  int64_t pay_off = -1;
+  int64_t ext_off = -1;
+  int32_t ext_len = -1;
+  int32_t clear_len = 0;
+};
+
+// Per-worker scratch that persists across jobs on pool threads — the
+// canonical slab stays cache-hot between ticks.
+struct WorkerScratch {
+  std::vector<uint8_t> canon;
+  std::vector<CanonSlot> slots;
+  void ensure(int32_t n_slots) {
+    if ((int32_t)slots.size() < n_slots) {
+      slots.assign(n_slots, CanonSlot{});
+      canon.assign((size_t)n_slots * MAX_DGRAM, 0);
+    } else {
+      for (auto& s : slots) s.room = -1;
+    }
+  }
+};
+
 // Build entries [lo, hi) into the shared out buffer (disjoint ranges) and
-// send them. Returns datagrams handed to the kernel.
-int64_t worker(const Args& a, int lo, int hi) {
+// send them. Returns datagrams handed to the kernel. When `grp` is given
+// (multicast-shaped mode), entry i with grp[i] >= 0 stages its packet's
+// bytes once per group in `scr` and later fan-out members copy from that
+// hot canonical instead of re-gathering slab + extension bytes; the
+// 12-byte RTP header (SN/TS/SSRC) and VP8 descriptor fields are patched
+// per subscriber. The AEAD seal itself necessarily runs per datagram —
+// every sealed frame carries its own counter, and a GCM nonce must never
+// repeat under one key — so what the group shares is the staged
+// cleartext, not the tag.
+int64_t worker(const Args& a, int lo, int hi, const int32_t* grp,
+               const int32_t* rooms, int32_t grp_slots,
+               WorkerScratch* scr, int64_t* built_out) {
   EVP_CIPHER_CTX* ctx = EVP_CIPHER_CTX_new();
   const EVP_CIPHER* cipher = EVP_aes_128_gcm();
   bool ctx_inited = false;
+  int32_t ctx_key = -1;
   uint8_t scratch[MAX_DGRAM];
+  if (grp && scr) scr->ensure(grp_slots);
+  int64_t built = 0;
 
   for (int i = lo; i < hi; i++) {
     uint8_t* dst = a.out + a.out_off[i];
@@ -346,14 +400,40 @@ int64_t worker(const Args& a, int lo, int hi) {
       continue;
     }
     uint8_t* build = sealed ? scratch : dst;
-    build[0] = 0x80 | (ext_len ? 0x10 : 0);
-    build[1] = (a.marker[i] ? 0x80 : 0) | (a.pt[i] & 0x7F);
-    be16(build + 2, a.sn[i]);
-    be32(build + 4, a.ts[i]);
-    be32(build + 8, a.ssrc[i]);
-    if (ext_len) std::memcpy(build + 12, a.ext_blob + a.ext_off[i], ext_len);
-    std::memcpy(build + hdr_len, a.slab + a.pay_off[i], plen);
+    const int32_t slot = (grp && scr) ? grp[i] : -1;
+    if (slot >= 0 && slot < grp_slots && clear_len <= MAX_DGRAM) {
+      CanonSlot& cs = scr->slots[slot];
+      uint8_t* cb = scr->canon.data() + (size_t)slot * MAX_DGRAM;
+      const int64_t eo = ext_len ? a.ext_off[i] : -1;
+      if (cs.room != rooms[i] || cs.pay_off != a.pay_off[i] ||
+          cs.ext_off != eo || cs.ext_len != ext_len) {
+        // Stage the canonical once per (room, track, k[, ext]) group.
+        cb[0] = 0x80 | (ext_len ? 0x10 : 0);
+        cb[1] = (a.marker[i] ? 0x80 : 0) | (a.pt[i] & 0x7F);
+        std::memset(cb + 2, 0, 10);  // SN/TS/SSRC are per-subscriber
+        if (ext_len) std::memcpy(cb + 12, a.ext_blob + a.ext_off[i], ext_len);
+        std::memcpy(cb + hdr_len, a.slab + a.pay_off[i], plen);
+        cs.room = rooms[i];
+        cs.pay_off = a.pay_off[i];
+        cs.ext_off = eo;
+        cs.ext_len = ext_len;
+        cs.clear_len = clear_len;
+      }
+      std::memcpy(build, cb, clear_len);
+      be16(build + 2, a.sn[i]);
+      be32(build + 4, a.ts[i]);
+      be32(build + 8, a.ssrc[i]);
+    } else {
+      build[0] = 0x80 | (ext_len ? 0x10 : 0);
+      build[1] = (a.marker[i] ? 0x80 : 0) | (a.pt[i] & 0x7F);
+      be16(build + 2, a.sn[i]);
+      be32(build + 4, a.ts[i]);
+      be32(build + 8, a.ssrc[i]);
+      if (ext_len) std::memcpy(build + 12, a.ext_blob + a.ext_off[i], ext_len);
+      std::memcpy(build + hdr_len, a.slab + a.pay_off[i], plen);
+    }
     if (a.vp8[i]) patch_vp8(build + hdr_len, plen, a.pid[i], a.tl0[i], a.kidx[i]);
+    built++;
 
     if (sealed) {
       const uint8_t* key = a.keys + 16 * a.key_idx[i];
@@ -367,8 +447,16 @@ int64_t worker(const Args& a, int lo, int hi) {
       std::memcpy(nonce + 1, h + 6, 8);
       std::memset(nonce + 9, 0, 3);
       int outl = 0, fl = 0;
-      // First init binds the cipher; later inits reuse it (key/IV only).
-      EVP_EncryptInit_ex(ctx, ctx_inited ? nullptr : cipher, nullptr, key, nonce);
+      // First init binds the cipher. Entries are destination-major, so
+      // consecutive datagrams usually share a session key: re-initing
+      // with IV only skips the AES key-schedule expansion per datagram.
+      if (a.key_idx[i] != ctx_key) {
+        EVP_EncryptInit_ex(ctx, ctx_inited ? nullptr : cipher, nullptr, key,
+                           nonce);
+        ctx_key = a.key_idx[i];
+      } else {
+        EVP_EncryptInit_ex(ctx, nullptr, nullptr, nullptr, nonce);
+      }
       ctx_inited = true;
       EVP_EncryptUpdate(ctx, nullptr, &outl, h, SEAL_HEADER);  // AAD
       EVP_EncryptUpdate(ctx, dst + SEAL_HEADER, &outl, build, clear_len);
@@ -378,6 +466,7 @@ int64_t worker(const Args& a, int lo, int hi) {
     }
   }
   EVP_CIPHER_CTX_free(ctx);
+  if (built_out) *built_out = built;
 
   int64_t sent = 0;
   if (a.fd >= 0) {
@@ -396,6 +485,121 @@ int64_t worker(const Args& a, int lo, int hi) {
   }
   return sent;
 }
+
+int64_t worker(const Args& a, int lo, int hi) {
+  return worker(a, lo, hi, nullptr, nullptr, 0, nullptr, nullptr);
+}
+
+// ---- persistent shard pool -------------------------------------------------
+//
+// The one-shot egress_batch_send spawns threads per call; at a 5 ms tick
+// that spawn/join overhead is a few percent of the window. The plane path
+// instead parks a fixed crew of workers on a condvar and hands each tick's
+// shard list to them: shard i owns entries [shard_lo[i], shard_hi[i]) —
+// room-aligned, so group canonicals never straddle workers — and writes
+// only its own disjoint out ranges. Workers keep their canonical slabs
+// across ticks (cache-warm).
+
+struct PlaneJob {
+  const Args* a = nullptr;
+  const int64_t* shard_lo = nullptr;
+  const int64_t* shard_hi = nullptr;
+  const int32_t* grp = nullptr;
+  const int32_t* rooms = nullptr;
+  int32_t grp_slots = 0;
+  int n_shards = 0;
+  int64_t* shard_sent = nullptr;
+  int64_t* shard_built = nullptr;
+  int64_t* shard_ns = nullptr;
+};
+
+class Pool {
+ public:
+  ~Pool() {
+    {
+      std::unique_lock<std::mutex> lk(mu_);
+      stop_ = true;
+      cv_.notify_all();
+    }
+    for (auto& t : ths_) t.join();
+  }
+
+  void ensure(int n) {
+    if (n > 16) n = 16;
+    std::unique_lock<std::mutex> lk(mu_);
+    while ((int)ths_.size() < n) {
+      int id = (int)ths_.size();
+      ths_.emplace_back([this, id] { loop(id); });
+    }
+  }
+
+  int size() {
+    std::unique_lock<std::mutex> lk(mu_);
+    return (int)ths_.size();
+  }
+
+  // Runs the job on the pool and blocks until every shard completed.
+  void run(PlaneJob& job) {
+    std::unique_lock<std::mutex> lk(mu_);
+    job_ = &job;
+    next_.store(0, std::memory_order_relaxed);
+    done_ = 0;
+    gen_++;
+    cv_.notify_all();
+    cv_done_.wait(lk, [&] { return done_ >= job.n_shards; });
+    job_ = nullptr;
+  }
+
+ private:
+  void loop(int id) {
+    (void)id;
+    uint64_t seen = 0;
+    WorkerScratch scr;
+    for (;;) {
+      // Copy the job descriptor under the lock: a straggler that loses the
+      // last-shard race must never dereference the caller's stack frame
+      // after run() returned. Claimed shards (s < n_shards) are always
+      // processed before done_ releases the caller, so the pointed-to
+      // arrays are alive wherever they are actually read.
+      PlaneJob job;
+      {
+        std::unique_lock<std::mutex> lk(mu_);
+        cv_.wait(lk, [&] { return stop_ || gen_ != seen; });
+        if (stop_) return;
+        seen = gen_;
+        if (!job_) continue;
+        job = *job_;
+      }
+      for (;;) {
+        int s = next_.fetch_add(1, std::memory_order_relaxed);
+        if (s >= job.n_shards) break;
+        const int64_t t0 = now_ns();
+        int64_t built = 0;
+        int64_t sent = worker(*job.a, (int)job.shard_lo[s],
+                              (int)job.shard_hi[s], job.grp, job.rooms,
+                              job.grp_slots, &scr, &built);
+        job.shard_sent[s] = sent;
+        job.shard_built[s] = built;
+        job.shard_ns[s] = now_ns() - t0;
+        {
+          std::unique_lock<std::mutex> lk(mu_);
+          if (++done_ >= job.n_shards) cv_done_.notify_all();
+        }
+      }
+    }
+  }
+
+  std::vector<std::thread> ths_;
+  std::mutex mu_;
+  std::condition_variable cv_, cv_done_;
+  uint64_t gen_ = 0;
+  bool stop_ = false;
+  PlaneJob* job_ = nullptr;
+  int done_ = 0;
+  std::atomic<int> next_{0};
+};
+
+Pool g_pool;
 
 }  // namespace
 
@@ -448,6 +652,84 @@ int64_t egress_batch_send(
   int64_t built = 0;
   for (int i = 0; i < n; i++) built += skip[i] ? 0 : 1;
   return built;
+}
+
+int32_t egress_abi_version(void) { return EGRESS_ABI; }
+
+// Pre-warm the persistent worker pool (idempotent; capped at 16). The
+// plane path also calls this lazily, so warming is an optimization only.
+void egress_pool_ensure(int n) { g_pool.ensure(n); }
+
+int32_t egress_pool_size(void) { return g_pool.size(); }
+
+// Sharded, multicast-shaped egress: the plane path. Entries arrive sorted
+// by (room, sub, track, k); shards are contiguous, room-aligned entry
+// ranges [shard_lo[i], shard_hi[i]) with disjoint out ranges, each run by
+// one persistent pool worker (build + group-canonical reuse + seal +
+// per-shard GSO/sendmmsg). `grp[i]` >= 0 names the entry's canonical
+// cache slot (its packet's t*K+k), -1 forces the direct build; `rooms`
+// scopes slot validity. Per-shard datagrams-sent / built / wall-ns land
+// in shard_sent/shard_built/shard_ns. Returns total datagrams handed to
+// the kernel, or total built when fd < 0 (build-only mode, used by the
+// parity and determinism tests).
+int64_t egress_plane_send(
+    int fd, int n_shards, const int64_t* shard_lo, const int64_t* shard_hi,
+    const uint8_t* slab, int32_t n,
+    const int64_t* pay_off, const int32_t* pay_len, const uint8_t* marker,
+    const uint8_t* pt, const uint8_t* vp8,
+    const uint8_t* ext_blob, const int64_t* ext_off, const int32_t* ext_len,
+    const uint16_t* sn,
+    const uint32_t* ts, const uint32_t* ssrc, const int32_t* pid,
+    const int32_t* tl0, const int32_t* kidx, const uint32_t* ip,
+    const uint16_t* port, const uint8_t* seal, const int32_t* key_idx,
+    const uint8_t* keys, const uint32_t* key_ids, const uint64_t* counters,
+    uint8_t* out, const int64_t* out_off, const int32_t* out_len,
+    const int32_t* rooms, const int32_t* grp, int32_t grp_slots,
+    int pace_window_us,
+    int64_t* shard_sent, int64_t* shard_built, int64_t* shard_ns) {
+  if (n <= 0 || n_shards <= 0) return 0;
+  std::vector<uint8_t> skip(n, 0);
+  Args a{skip.data(), slab, pay_off, pay_len, marker, pt, vp8,
+         ext_blob, ext_off, ext_len,
+         sn,  ts,
+         ssrc,  pid,     tl0,     kidx,   ip,       port,    seal, key_idx,
+         keys,  key_ids, counters, out,   out_off,  out_len, fd,
+         pace_window_us};
+  for (int s = 0; s < n_shards; s++) {
+    shard_sent[s] = 0;
+    shard_built[s] = 0;
+    shard_ns[s] = 0;
+  }
+  if (n_shards == 1) {
+    // Single shard runs inline on the caller's thread: on small hosts the
+    // cross-thread handoff would cost more than it buys.
+    static thread_local WorkerScratch scr;
+    const int64_t t0 = now_ns();
+    int64_t built = 0;
+    shard_sent[0] = worker(a, (int)shard_lo[0], (int)shard_hi[0], grp, rooms,
+                           grp_slots, &scr, &built);
+    shard_built[0] = built;
+    shard_ns[0] = now_ns() - t0;
+  } else {
+    g_pool.ensure(n_shards);
+    PlaneJob job;
+    job.a = &a;
+    job.shard_lo = shard_lo;
+    job.shard_hi = shard_hi;
+    job.grp = grp;
+    job.rooms = rooms;
+    job.grp_slots = grp_slots;
+    job.n_shards = n_shards;
+    job.shard_sent = shard_sent;
+    job.shard_built = shard_built;
+    job.shard_ns = shard_ns;
+    g_pool.run(job);
+  }
+  int64_t total = 0;
+  for (int s = 0; s < n_shards; s++) {
+    total += fd >= 0 ? shard_sent[s] : shard_built[s];
+  }
+  return total;
 }
 
 // Send pre-built datagrams (contiguous blob + per-entry offset/length/

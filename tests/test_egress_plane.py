@@ -1,0 +1,437 @@
+"""Sharded egress plane: seal parity, shard determinism, wire order.
+
+The egress plane (runtime/egress_plane.py + native/egress.cpp's
+egress_plane_send + native/munge.cpp's munge_walk_multi) re-runs the
+one-shot native egress walk as room-aligned shards on a persistent
+worker pool, with multicast-shaped canonical staging (stage the packet
+bytes once per (room, track, k) group, patch per-subscriber headers,
+seal per datagram). None of that may change a single wire byte:
+
+* seal parity — every sealed datagram must be bit-identical to the
+  Python reference seal in runtime/crypto.py (frame layout, nonce
+  derivation, AAD coverage);
+* shard determinism — the output buffer must be identical across shard
+  plans and with canonical grouping on or off;
+* wire order — within one (room, sub, track) stream, datagrams must
+  leave in k (packet) order so SNs never reorder on the host;
+* walk_multi ≡ walk — the sharded munge walker must produce the same
+  columns AND the same evolved state as the single walk.
+"""
+
+import time
+
+import numpy as np
+import pytest
+
+from livekit_server_tpu import native
+from livekit_server_tpu.models import plane
+from livekit_server_tpu.runtime.egress_plane import EgressPlane, resolve_shards
+from livekit_server_tpu.runtime.munge import HostMunger
+
+SEAL_OVERHEAD = 30
+HDR = 12
+
+
+def _batch(n_rooms=4, subs=3, tracks=2, pkts=3, payload_len=48, sealed=True):
+    """Destination-major synthetic batch (the udp staging order) in the
+    exact argument shape of NativeEgress.send_sharded."""
+    rng = np.random.default_rng(5)
+    n = n_rooms * subs * tracks * pkts
+    slab = rng.integers(0, 256, pkts * payload_len, np.uint8)
+    rr = np.repeat(np.arange(n_rooms, dtype=np.int32), subs * tracks * pkts)
+    ss = np.tile(np.repeat(np.arange(subs, dtype=np.int32), tracks * pkts),
+                 n_rooms)
+    tt = np.tile(np.repeat(np.arange(tracks, dtype=np.int32), pkts),
+                 n_rooms * subs)
+    kk = np.tile(np.arange(pkts, dtype=np.int32), n_rooms * subs * tracks)
+    n_sess = n_rooms * subs
+    keys = rng.integers(0, 256, (n_sess, 16), np.uint8)
+    args = dict(
+        slab=slab,
+        pay_off=(kk.astype(np.int64) * payload_len),
+        pay_len=np.full(n, payload_len, np.int32),
+        marker=(kk == pkts - 1).astype(np.uint8),
+        pt=np.full(n, 96, np.uint8),
+        vp8=np.zeros(n, np.uint8),  # parity tests want untouched payloads
+        sn=((rr.astype(np.int64) * 131 + tt * 17 + kk) & 0xFFFF).astype(np.uint16),
+        ts=(kk.astype(np.uint32) * 3000 + rr.astype(np.uint32)),
+        ssrc=((rr.astype(np.uint32) << 16) | (ss.astype(np.uint32) << 4)
+              | tt.astype(np.uint32)),
+        pid=np.full(n, 77, np.int32), tl0=np.full(n, 3, np.int32),
+        kidx=np.full(n, 1, np.int32),
+        ip=np.full(n, 0x7F000001, np.uint32),
+        port=np.full(n, 50555, np.uint16),
+        seal=np.full(n, 1 if sealed else 0, np.uint8),
+        key_idx=(rr * subs + ss).astype(np.int32),
+        keys=keys,
+        key_ids=np.arange(100, 100 + n_sess, dtype=np.uint32),
+        counters=(np.arange(n, dtype=np.uint64) % np.uint64(pkts * tracks)),
+        rooms=rr,
+    )
+    return args, (rr, ss, tt, kk), keys
+
+
+def _send(plane_obj, args, cols):
+    rr, ss, tt, kk = cols
+    tracks = int(tt.max()) + 1
+    pkts = int(kk.max()) + 1
+    flat_rtk = rr.astype(np.int64) * (tracks * pkts) + tt * pkts + kk
+    grp, grp_slots = plane_obj.group_slots(flat_rtk, tt, kk, tracks, pkts)
+    if grp is None:
+        grp = np.full(len(rr), -1, np.int32)
+        grp_slots = 0
+    lo, hi = plane_obj.entry_plan(rr)
+    return native.egress.send_sharded(
+        fd=-1, shard_lo=lo, shard_hi=hi, grp=grp, grp_slots=grp_slots,
+        **args,
+    )
+
+
+needs_native = pytest.mark.skipif(
+    native.egress is None or native.munge is None,
+    reason="native toolchain unavailable",
+)
+
+
+@needs_native
+def test_native_smoke_clean():
+    """The CI gate's native smoke (tools.check) must be clean here too:
+    builds load, ABI version symbols match the ctypes layer, and a tiny
+    build/walk runs through each library."""
+    assert native.native_smoke() == []
+
+
+@needs_native
+def test_seal_parity_native_vs_python():
+    """Every sealed datagram out of the sharded native walk must be
+    byte-identical to runtime/crypto.py's reference seal: same 14-byte
+    header (magic, key_id, dir=S2C, counter), same nonce derivation,
+    same AAD coverage, same AES-GCM tag."""
+    from livekit_server_tpu.runtime import crypto
+
+    if not crypto.HAVE_AEAD:
+        pytest.skip("no AEAD backend")
+    args, cols, keys = _batch(sealed=True)
+    ep = EgressPlane(shards=2)
+    out, out_off, out_len, sent, *_ = _send(ep, args, cols)
+    n = len(args["pay_off"])
+    assert sent == n
+    for i in range(n):
+        dgram = bytes(out[out_off[i]:out_off[i] + out_len[i]])
+        pay_off = int(args["pay_off"][i])
+        payload = bytes(args["slab"][pay_off:pay_off + int(args["pay_len"][i])])
+        hdr = bytes([
+            0x80, int(args["pt"][i]) | (int(args["marker"][i]) << 7),
+        ]) + int(args["sn"][i]).to_bytes(2, "big") \
+            + int(args["ts"][i]).to_bytes(4, "big") \
+            + int(args["ssrc"][i]).to_bytes(4, "big")
+        sess = int(args["key_idx"][i])
+        aead = crypto.AESGCM(bytes(keys[sess]))
+        expect = crypto._seal(
+            aead, int(args["key_ids"][sess]), crypto.DIR_S2C,
+            int(args["counters"][i]), hdr + payload,
+        )
+        assert dgram == expect, f"entry {i}: sealed frame mismatch"
+
+
+@needs_native
+def test_seal_parity_client_opens():
+    """The client side of the same contract: MediaCryptoClient.open()
+    accepts every native-sealed datagram and returns the clear packet."""
+    from livekit_server_tpu.runtime import crypto
+
+    if not crypto.HAVE_AEAD:
+        pytest.skip("no AEAD backend")
+    args, cols, keys = _batch(n_rooms=2, subs=2, sealed=True)
+    ep = EgressPlane(shards=2)
+    out, out_off, out_len, sent, *_ = _send(ep, args, cols)
+    clients = {
+        s: crypto.MediaCryptoClient(int(args["key_ids"][s]), bytes(keys[s]))
+        for s in range(len(keys))
+    }
+    for i in range(len(args["pay_off"])):
+        dgram = bytes(out[out_off[i]:out_off[i] + out_len[i]])
+        clear = clients[int(args["key_idx"][i])].open(dgram)
+        assert clear is not None, f"entry {i}: client rejected native seal"
+        assert clear[2:4] == int(args["sn"][i]).to_bytes(2, "big")
+
+
+@needs_native
+@pytest.mark.parametrize("sealed", [False, True])
+def test_shard_determinism(sealed):
+    """The output buffer must be bit-identical across shard plans and
+    with canonical grouping on or off — sharding and the multicast-shaped
+    staging are pure execution strategies, never semantics."""
+    ref = None
+    for shards in (1, 2, 3):
+        for multicast in (False, True):
+            args, cols, _ = _batch(n_rooms=5, subs=4, pkts=4, sealed=sealed)
+            ep = EgressPlane(shards=shards, multicast_seal=multicast)
+            out, out_off, out_len, sent, s_sent, s_built, s_ns = _send(
+                ep, args, cols
+            )
+            assert sent == len(args["pay_off"])
+            assert int(s_built.sum()) == sent
+            cur = (bytes(out), out_off.tobytes(), out_len.tobytes())
+            if ref is None:
+                ref = cur
+            else:
+                assert cur == ref, (
+                    f"shards={shards} multicast={multicast} diverged"
+                )
+
+
+@needs_native
+def test_wire_order_preserved_per_stream():
+    """Within one (room, sub, track) stream the out buffer must hold
+    datagrams in k order (ascending offsets == send order within a
+    shard), so sequence numbers never leave the host reordered."""
+    args, cols, _ = _batch(n_rooms=3, subs=3, tracks=2, pkts=5, sealed=False)
+    rr, ss, tt, kk = cols
+    ep = EgressPlane(shards=3)
+    out, out_off, out_len, sent, *_ = _send(ep, args, cols)
+    for r in range(3):
+        for s in range(3):
+            for t in range(2):
+                m = (rr == r) & (ss == s) & (tt == t)
+                offs = out_off[m]
+                ks = kk[m]
+                # Entries are staged k-ascending; their buffer offsets
+                # (== send order) must be k-ascending too.
+                assert (np.diff(ks[np.argsort(offs)]) > 0).all()
+                # And the wire SN at each offset matches the staged SN.
+                for off, sn in zip(offs, args["sn"][m]):
+                    assert bytes(out[off + 2:off + 4]) == int(sn).to_bytes(2, "big")
+
+
+@needs_native
+def test_walk_multi_matches_single_walk():
+    """The sharded munge walker must produce identical egress columns AND
+    identical evolved state to the single-threaded walk — rooms are the
+    state-ownership unit, so whole-room shards may never change a bit."""
+    import jax.numpy as jnp
+
+    from livekit_server_tpu.models.plane import _pack_bits
+    from tests.test_host_munge import _random_tick
+
+    R, T, K, S = 6, 3, 4, 37
+    dims = plane.PlaneDims(R, T, K, S)
+    rng = np.random.default_rng(23)
+    h_one = HostMunger(dims)
+    h_multi = HostMunger(dims)
+    ep = EgressPlane(shards=3)
+    r_lo, r_hi = ep.room_plan(R)
+    assert len(r_lo) == 3
+    for _ in range(4):
+        sn, ts, ts_jump, pid, tl0, ki, begin, valid, fwd, drop, switch = (
+            _random_tick(rng, R, T, K, S)
+        )
+        fwd &= valid[..., None]
+        drop &= valid[..., None] & ~fwd
+        switch &= fwd
+        bits = [
+            np.asarray(_pack_bits(jnp.asarray(m))) for m in (fwd, drop, switch)
+        ]
+        a = h_one.apply_columns(sn, ts, ts_jump, pid, tl0, ki, begin, valid,
+                                *bits)
+        b = h_multi.apply_columns(sn, ts, ts_jump, pid, tl0, ki, begin, valid,
+                                  *bits, shard_plan=(r_lo, r_hi))
+        for col_a, col_b in zip(a, b):
+            np.testing.assert_array_equal(col_a, col_b)
+        # Per-shard counts partition the total and cover every entry.
+        assert int(h_multi.last_shard_counts.sum()) == len(b[0])
+    for f in HostMunger.FIELDS:
+        np.testing.assert_array_equal(
+            getattr(h_one, f), getattr(h_multi, f), err_msg=f
+        )
+
+
+# -- plan + orchestrator unit behavior ---------------------------------------
+
+def test_room_plan_covers_all_rooms():
+    ep = EgressPlane(shards=4)
+    lo, hi = ep.room_plan(10)
+    assert lo[0] == 0 and hi[-1] == 10
+    assert (lo[1:] == hi[:-1]).all()          # contiguous
+    assert ((hi - lo) >= 1).all()
+
+
+def test_entry_plan_is_room_aligned():
+    ep = EgressPlane(shards=3)
+    rooms = np.repeat(np.arange(5, dtype=np.int32), [1, 7, 2, 9, 3])
+    lo, hi = ep.entry_plan(rooms)
+    assert lo[0] == 0 and hi[-1] == len(rooms)
+    assert (lo[1:] == hi[:-1]).all()
+    for cut in lo[1:]:
+        # Every interior cut lands on the first entry of a room.
+        assert rooms[cut] != rooms[cut - 1]
+
+
+def test_entry_plan_single_room_collapses():
+    ep = EgressPlane(shards=4)
+    rooms = np.zeros(50, np.int32)
+    lo, hi = ep.entry_plan(rooms)
+    assert len(lo) == 1 and lo[0] == 0 and hi[0] == 50
+
+
+def test_group_slots_marks_reused_packets():
+    ep = EgressPlane(shards=1, multicast_seal=True)
+    tracks, pkts = 2, 2
+    # room 0: two subs share (t0, k0); room 1: one lone sub.
+    rr = np.array([0, 0, 1], np.int32)
+    tt = np.array([0, 0, 1], np.int32)
+    kk = np.array([0, 0, 0], np.int32)
+    flat = rr.astype(np.int64) * (tracks * pkts) + tt * pkts + kk
+    grp, slots = ep.group_slots(flat, tt, kk, tracks, pkts)
+    assert slots == tracks * pkts
+    assert grp[0] == grp[1] == 0          # shared canonical slot t*K+k
+    assert grp[2] == -1                   # lone entry: direct build
+    off = EgressPlane(shards=1, multicast_seal=False)
+    assert off.group_slots(flat, tt, kk, tracks, pkts) == (None, 0)
+
+
+def test_resolve_shards_bounds():
+    assert resolve_shards(1) == 1
+    assert resolve_shards(16) == 16
+    assert resolve_shards(64) == 16       # hard cap
+    assert 1 <= resolve_shards(0) <= 8    # auto: local cores, capped
+
+
+def test_record_send_feeds_pps_and_observe():
+    ep = EgressPlane(shards=2)
+    lo = np.array([0, 3], np.int64)
+    hi = np.array([3, 6], np.int64)
+    ep.record_send(6, 4, 6, lo, hi,
+                   np.array([3, 3], np.int64), np.array([3, 3], np.int64),
+                   np.array([1_000_000, 2_000_000], np.int64))
+    obs = ep.observe()
+    assert obs["entries"] == 6 and obs["datagrams"] == 6
+    assert obs["grouped_entries"] == 4
+    # EMA pps over the CRITICAL PATH (max shard ns), not the sum.
+    assert obs["host_egress_pps"] == pytest.approx(6 / 2e-3, rel=0.01)
+    assert len(obs["last_send"]["shards"]) == 2
+
+
+def test_config_egress_section():
+    from livekit_server_tpu.config.config import (
+        Config,
+        ConfigError,
+        _validate,
+    )
+
+    cfg = Config()
+    assert cfg.egress.shards == 0
+    assert cfg.egress.multicast_seal is True
+    cfg.egress.shards = 65
+    with pytest.raises(ConfigError):
+        _validate(cfg)
+
+
+# -- gateway handshake TTL ---------------------------------------------------
+
+def test_gateway_reap_unit():
+    """TTL reap logic without the full DTLS handshake: an aged
+    handshake-incomplete peer is torn down by service_timers, an
+    established one never is."""
+    # The gateway module imports the interop stack (OpenSSL-backed) at
+    # module level; absent in slim images like the e2e tests above.
+    pytest.importorskip("cryptography")
+    from livekit_server_tpu.runtime.webrtc_gateway import (
+        PEER_HANDSHAKE_TTL_S,
+        GatewayPeer,
+        WebRtcGateway,
+    )
+
+    class _StubTransport:
+        crypto = None
+
+        def release_subscriber(self, *a):
+            pass
+
+        def release_ssrc(self, *a):
+            pass
+
+    gw = object.__new__(WebRtcGateway)
+    gw.transport = _StubTransport()
+    gw.peers_by_ufrag, gw.peers_by_addr, gw.peers_by_tuple = {}, {}, {}
+    gw.stats = {}
+
+    def mk_peer(ufrag, established):
+        p = object.__new__(GatewayPeer)
+        p.gateway, p.ufrag, p.pwd = gw, ufrag, "pw"
+        p.dtls = None
+        p.srtp_tx = object() if established else None
+        p.srtp_rx = p.srtp_tx
+        p.addr, p.addr_code = None, 0
+        p.publish, p.sub, p.sub_registered = [], None, False
+        p.pin_session = None
+        p.created_s = time.monotonic()
+        p._last_timer = 0.0
+        gw.peers_by_ufrag[ufrag] = p
+        return p
+
+    fresh = mk_peer("fresh", established=False)
+    stale = mk_peer("stale", established=False)
+    done = mk_peer("done", established=True)
+    stale.created_s -= PEER_HANDSHAKE_TTL_S + 1
+    done.created_s -= PEER_HANDSHAKE_TTL_S * 10
+    gw.service_timers()
+    assert "fresh" in gw.peers_by_ufrag          # inside the TTL window
+    assert "stale" not in gw.peers_by_ufrag      # abandoned: reaped
+    assert "done" in gw.peers_by_ufrag           # established: never reaped
+    assert gw.stats["peers_reaped"] == 1
+    assert fresh is gw.peers_by_ufrag["fresh"]
+
+
+async def test_gateway_reaps_abandoned_handshakes():
+    """A peer that answered the offer but never completed DTLS must not
+    hold its ufrag slot / DTLS endpoint / minted crypto session forever:
+    service_timers reaps it after PEER_HANDSHAKE_TTL_S."""
+    pytest.importorskip("cryptography")  # gateway DTLS needs the interop lane
+    from livekit_server_tpu.runtime import webrtc_gateway
+    from tests.test_gateway import _setup
+
+    runtime, udp, gw, cli, answer, peer = await _setup(subscribe=True)
+    try:
+        assert peer.ufrag in gw.peers_by_ufrag
+        assert not peer.srtp_ready
+        # Fresh peer: within TTL, timers must NOT reap it.
+        gw.service_timers()
+        assert peer.ufrag in gw.peers_by_ufrag
+        # Age it past the TTL; the next timer pass tears it down.
+        peer.created_s = time.monotonic() - (
+            webrtc_gateway.PEER_HANDSHAKE_TTL_S + 1.0
+        )
+        gw.service_timers()
+        assert peer.ufrag not in gw.peers_by_ufrag
+        assert gw.stats["peers_reaped"] == 1
+        if peer.pin_session is not None:
+            assert peer.pin_session.key_id not in udp.crypto.sessions
+    finally:
+        cli.close()
+        await runtime.stop()
+
+
+async def test_gateway_never_reaps_established_peers():
+    """Established SRTP peers belong to the signalling plane — the TTL
+    only covers the handshake window."""
+    pytest.importorskip("cryptography")  # gateway DTLS needs the interop lane
+    from livekit_server_tpu.runtime import webrtc_gateway
+    from tests.test_gateway import _setup
+
+    runtime, udp, gw, cli, answer, peer = await _setup(subscribe=True)
+    try:
+        import asyncio
+
+        await cli.connect(answer)
+        assert peer.srtp_ready
+        peer.created_s = time.monotonic() - (
+            webrtc_gateway.PEER_HANDSHAKE_TTL_S * 10
+        )
+        gw.service_timers()
+        assert peer.ufrag in gw.peers_by_ufrag
+        assert gw.stats.get("peers_reaped", 0) == 0
+        await asyncio.sleep(0)
+    finally:
+        cli.close()
+        await runtime.stop()

@@ -30,6 +30,8 @@ def main(argv: list[str] | None = None) -> int:
                     help="emit findings as JSON")
     ap.add_argument("--no-compile", action="store_true",
                     help="skip the compileall pass (pure lint)")
+    ap.add_argument("--no-native", action="store_true",
+                    help="skip the native toolchain smoke (build + ABI)")
     ap.add_argument("--rules", default=None,
                     help="comma-separated subset, e.g. GC01,GC04")
     args = ap.parse_args(argv)
@@ -73,11 +75,26 @@ def main(argv: list[str] | None = None) -> int:
             str(REPO_ROOT / "livekit_server_tpu"), quiet=2, force=False
         )
 
+    # Native toolchain smoke: compile every native/*.cpp, load the .so's,
+    # cross-check the baked ABI version symbols against the ctypes layer,
+    # and run one tiny build/walk through each library. Catches a broken
+    # compiler, a stale .so after an ABI bump, and signature drift —
+    # failures the pure-Python gates above can't see.
+    native_failures: list[str] = []
+    if not args.no_native:
+        try:
+            from livekit_server_tpu import native as native_mod
+
+            native_failures = native_mod.native_smoke()
+        except Exception as exc:  # toolchain totally absent ⇒ report, fail
+            native_failures = [f"native smoke crashed: {exc!r}"]
+
     if args.as_json:
         print(json.dumps({
             "findings": [vars(f) for f in new],
             "stale_baseline": stale,
             "compile_ok": bool(compiled_ok),
+            "native_failures": native_failures,
         }, indent=1))
     else:
         for f in new:
@@ -87,15 +104,19 @@ def main(argv: list[str] | None = None) -> int:
                   f"{e.get('rule')} {e.get('path')}: {e.get('content')}")
         if not compiled_ok:
             print("compileall: errors (see above)")
+        for msg in native_failures:
+            print(f"native: {msg}")
         dt = time.perf_counter() - t0
-        status = "clean" if not (new or stale) and compiled_ok else "FAILED"
+        ok = not (new or stale or native_failures) and compiled_ok
+        status = "clean" if ok else "FAILED"
         print(f"graftcheck: {len(new)} finding(s), {len(stale)} stale "
-              f"baseline entr(ies), {len(project.files)} files in "
+              f"baseline entr(ies), {len(native_failures)} native "
+              f"failure(s), {len(project.files)} files in "
               f"{dt:.2f}s — {status}")
 
     if stale:
         return 2
-    if new or not compiled_ok:
+    if new or not compiled_ok or native_failures:
         return 1
     return 0
 
