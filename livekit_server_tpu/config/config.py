@@ -165,6 +165,17 @@ class PlaneConfig:
     # latency, at the cost of the wall budget being the SUM of device +
     # host egress instead of their max. Worth it when both fit the tick.
     low_latency: bool = False
+    # Express lane (two-tier latency plane): rooms with at most this many
+    # subscribers forward on packet ARRIVAL from the last device selector
+    # mirror (≤1-tick-stale, bit-equivalent decisions) instead of waiting
+    # for the batched tick — wire latency becomes receive-loop latency.
+    # 0 disables the lane; rooms above the bound ride the batched tick.
+    # PlaneRuntime.set_express_pin overrides per room in either direction.
+    express_max_subs: int = 0
+    # Hard cap on rooms simultaneously on the express lane (arrival-path
+    # work is per-room; bound it so a flood of small rooms cannot starve
+    # the tick loop). Only meaningful when express_max_subs > 0.
+    express_max_rooms: int = 16
 
 
 @dataclass
@@ -507,6 +518,19 @@ def _validate(cfg: Config) -> None:
     for name in ("tick_ms", "rooms", "tracks_per_room", "pkts_per_track", "subs_per_room"):
         if getattr(p, name) <= 0:
             raise ConfigError(f"plane.{name} must be positive")
+    if p.express_max_subs < 0:
+        raise ConfigError(
+            f"plane.express_max_subs must be >= 0, got {p.express_max_subs}"
+        )
+    if p.express_max_subs > p.subs_per_room:
+        raise ConfigError(
+            "plane.express_max_subs must not exceed plane.subs_per_room "
+            f"({p.subs_per_room}), got {p.express_max_subs}"
+        )
+    if p.express_max_rooms <= 0:
+        raise ConfigError(
+            f"plane.express_max_rooms must be positive, got {p.express_max_rooms}"
+        )
     eg = cfg.egress
     if not 0 <= eg.shards <= 64:
         raise ConfigError(f"egress.shards must be in [0, 64], got {eg.shards}")

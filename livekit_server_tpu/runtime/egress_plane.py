@@ -335,3 +335,46 @@ def bench_plane(
         "sealed": sealed,
         "bytes_per_dgram": payload_len + 12 + (30 if sealed else 0),
     }
+
+
+def bench_plane_scaling(
+    payload_len: int = 1100,
+    sealed: bool = True,
+    seconds_per_point: float = 1.5,
+    max_shards: int = 0,
+    **shape: Any,
+) -> dict[str, Any]:
+    """pps vs shard count on THIS host: one bench_plane point per shard
+    count (1, 2, 4, ... up to the core budget). Room-aligned shards share
+    no state, so an N-core host should scale the sealed walk near
+    linearly until the memory bus saturates — the curve makes the actual
+    knee visible instead of leaving "multiply by cores" as an untested
+    claim. On a 1-CPU rig this degenerates to the single-shard point
+    (flagged in the result; see BASELINE.md)."""
+    cores = os.cpu_count() or 1
+    budget = max_shards or min(cores, 8)
+    ks: list[int] = []
+    k = 1
+    while k <= budget:
+        ks.append(k)
+        k *= 2
+    if budget not in ks:
+        ks.append(budget)
+    points = []
+    for k in ks:
+        ep = EgressPlane(k)
+        r = bench_plane(
+            ep, payload_len=payload_len, sealed=sealed,
+            seconds=seconds_per_point, **shape,
+        )
+        if "error" in r:
+            return {"error": r["error"], "cores": cores}
+        points.append({"shards": k, "pps": r["pps"]})
+    base = points[0]["pps"] or 1.0
+    return {
+        "cores": cores,
+        "single_core_rig": cores <= 1,
+        "sealed": sealed,
+        "points": points,
+        "speedup": [round(p["pps"] / base, 2) for p in points],
+    }

@@ -230,6 +230,17 @@ class IngestBuffer:
         self._police_burst = 0.0
         self._police_tokens = np.zeros((R, T), np.float64)
         self._police_video = None
+        # Staging coordinates of the last push_batch (diagnostics/tests;
+        # None after any path that staged nothing vectorized — chaos,
+        # frozen-only, policed/capacity-empty).
+        self.last_put: tuple | None = None
+        # Arrival hook: called with (rooms, tracks, ks) staging coordinates
+        # after EVERY successful staging — vectorized (push_batch) and
+        # per-packet (push) alike, so the express lane sees TCP/gateway/
+        # bridge-replayed packets too, not just the UDP fast path. The
+        # fan-out masks express rooms' rows wholesale; an ingest path that
+        # bypassed this hook would silently drop their media.
+        self.on_put = None
         self._sets = (_StagingSet(dims), _StagingSet(dims))
         self._active = 0
         self._bind(self._sets[0])
@@ -394,6 +405,9 @@ class IngestBuffer:
             self.marker[r, t, k] = pkt.marker
             self._slab += pkt.payload
         self.t_arr[r, t, k] = t_rx
+        if self.on_put is not None:
+            self.on_put(np.array([r], np.int64), np.array([t], np.int64),
+                        np.array([k], np.int64))
         return True
 
     def extract_row(self, room: int) -> list:
@@ -454,6 +468,7 @@ class IngestBuffer:
         native-parse → tensor-staging path this module documents). All
         args are equal-length arrays; payload bytes are sliced out of
         `blob` by (pay_start, pay_length). Returns packets staged."""
+        self.last_put = None
         n = len(room)
         if n == 0:
             return 0
@@ -651,6 +666,9 @@ class IngestBuffer:
         self._count.reshape(-1)[uniq_rt] = np.minimum(
             K, base[order][grp_start] + sizes
         )
+        self.last_put = (r_, t_, k_)
+        if self.on_put is not None:
+            self.on_put(r_, t_, k_)
         return len(r_)
 
     def push_twcc_feedback(

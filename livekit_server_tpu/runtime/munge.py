@@ -213,6 +213,128 @@ class HostMunger:
             out_ki[:, :, k, :] = np.where(fwd, o_ki, 0)
         return out_sn, out_ts, out_pid, out_tl0, out_ki
 
+    def apply_arrivals(
+        self,
+        gr, gt,                                               # [G] lane coords
+        sn, ts, ts_jump, pid, tl0, keyidx, begin_pic, valid,  # [G, Kb]
+        send, drop, switch,                                   # [G, Kb, S] bool
+    ):
+        """Express-lane munging: the apply_dense scan applied to G
+        gathered (room, track) lanes over one receive batch, in arrival
+        order. apply_dense REBINDS the state arrays via np.where, so the
+        lanes are pulled into [G, S] locals, advanced packet by packet,
+        and scattered back — the SAME per-(room, track, sub) state the
+        batched fan-out walks, which is what keeps a subscriber's SN/TS
+        space continuous across tier promotion/demotion. (gr, gt) must
+        name distinct lanes. Returns (out_sn, out_ts, out_pid, out_tl0,
+        out_ki) [G, Kb, S] (defined where `send & valid`; zero
+        elsewhere)."""
+        G, Kb = np.asarray(sn).shape
+        S = send.shape[-1]
+        sn = np.asarray(sn, np.int64) & M16
+        ts = np.asarray(ts, np.int64) & M32
+        pid = np.asarray(pid, np.int64) & M15
+        tl0 = np.asarray(tl0, np.int64) & M8
+        ki = np.asarray(keyidx, np.int64) & M5
+        jump = np.asarray(ts_jump, np.int64)
+        bp = np.asarray(begin_pic, bool)
+        val = np.asarray(valid, bool)
+
+        st = {name: getattr(self, name)[gr, gt] for name in self.FIELDS}
+        out_sn = np.zeros((G, Kb, S), np.int32)
+        out_ts = np.zeros((G, Kb, S), np.int64)
+        out_pid = np.zeros((G, Kb, S), np.int32)
+        out_tl0 = np.zeros((G, Kb, S), np.int32)
+        out_ki = np.zeros((G, Kb, S), np.int32)
+
+        for k in range(Kb):
+            v = val[:, k][:, None]
+            fwd = send[:, k, :] & v
+            drp = drop[:, k, :] & v & ~fwd
+            sw = switch[:, k, :] & fwd
+            sn_k = sn[:, k][:, None]
+            ts_k = ts[:, k][:, None]
+            jump_k = jump[:, k][:, None]
+            pkt_aligned = jump_k < 0
+            jump_eff = np.where(pkt_aligned, FALLBACK_TS_JUMP, jump_k)
+
+            # --- rtpmunger step (mirrors apply_dense) --------------------
+            sw_sn_off = (sn_k - ((st["last_sn"] + 1) & M16)) & M16
+            sw_ts_off = (ts_k - ((st["last_ts"] + jump_eff) & M32)) & M32
+            carry_through = pkt_aligned & st["aligned"]
+            sw_ts_off = np.where(carry_through, st["ts_offset"], sw_ts_off)
+            fresh = fwd & ~st["started"]
+            resync = sw & st["started"]
+            cur_out_ts = (ts_k - st["ts_offset"]) & M32
+            shear = _sdiff(cur_out_ts, st["last_ts"], M32, 1 << 31)
+            sheared = (
+                fwd & ~sw & st["started"] & (np.abs(shear) > REANCHOR_TS_THRESH)
+            )
+            shear_ts_off = (
+                ts_k - ((st["last_ts"] + FALLBACK_TS_JUMP) & M32)
+            ) & M32
+            anchor = fresh | resync | sheared
+            st["sn_offset"] = np.where(
+                resync, sw_sn_off, np.where(fresh, 0, st["sn_offset"])
+            )
+            st["ts_offset"] = np.where(
+                sheared, shear_ts_off,
+                np.where(resync, sw_ts_off, np.where(fresh, 0, st["ts_offset"])),
+            )
+            st["aligned"] = np.where(anchor, pkt_aligned, st["aligned"])
+            o_sn = (sn_k - st["sn_offset"]) & M16
+            o_ts = (ts_k - st["ts_offset"]) & M32
+            st["last_sn"] = np.where(fwd, o_sn, st["last_sn"])
+            st["last_ts"] = np.where(fwd, o_ts, st["last_ts"])
+            st["sn_offset"] = np.where(
+                drp & st["started"], (st["sn_offset"] + 1) & M16,
+                st["sn_offset"],
+            )
+            st["started"] = st["started"] | fwd
+
+            # --- vp8 step ------------------------------------------------
+            drp_pic = drp & bp[:, k][:, None]
+            pid_k = pid[:, k][:, None]
+            tl0_k = tl0[:, k][:, None]
+            ki_k = ki[:, k][:, None]
+            sw_pid_off = (pid_k - ((st["last_pid"] + 1) & M15)) & M15
+            sw_tl0_off = (tl0_k - st["last_tl0"] - 1) & M8
+            sw_ki_off = (ki_k - st["last_ki"] - 1) & M5
+            v_fresh = fwd & ~st["v_started"]
+            v_resync = sw & st["v_started"]
+            st["pid_offset"] = np.where(
+                v_resync, sw_pid_off, np.where(v_fresh, 0, st["pid_offset"])
+            )
+            st["tl0_offset"] = np.where(
+                v_resync, sw_tl0_off, np.where(v_fresh, 0, st["tl0_offset"])
+            )
+            st["ki_offset"] = np.where(
+                v_resync, sw_ki_off, np.where(v_fresh, 0, st["ki_offset"])
+            )
+            o_pid = (pid_k - st["pid_offset"]) & M15
+            o_tl0 = (tl0_k - st["tl0_offset"]) & M8
+            o_ki = (ki_k - st["ki_offset"]) & M5
+            fwd_bp = fwd & bp[:, k][:, None]
+            st["last_pid"] = np.where(fwd_bp, o_pid, st["last_pid"])
+            st["last_tl0"] = np.where(fwd_bp, o_tl0, st["last_tl0"])
+            st["last_ki"] = np.where(fwd_bp, o_ki, st["last_ki"])
+            st["pid_offset"] = np.where(
+                drp_pic & st["v_started"], (st["pid_offset"] + 1) & M15,
+                st["pid_offset"],
+            )
+            st["v_started"] = st["v_started"] | fwd
+
+            out_sn[:, k, :] = np.where(fwd, o_sn, 0)
+            out_ts[:, k, :] = np.where(fwd, o_ts, 0)
+            out_pid[:, k, :] = np.where(fwd, o_pid, 0)
+            out_tl0[:, k, :] = np.where(fwd, o_tl0, 0)
+            out_ki[:, k, :] = np.where(fwd, o_ki, 0)
+
+        for name in self.FIELDS:
+            dst = getattr(self, name)
+            dst[gr, gt] = st[name].astype(dst.dtype, copy=False)
+        return out_sn, out_ts, out_pid, out_tl0, out_ki
+
     def apply_columns(
         self,
         sn, ts, ts_jump, pid, tl0, keyidx, begin_pic, valid,  # [R, T, K]

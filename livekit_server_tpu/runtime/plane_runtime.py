@@ -278,6 +278,14 @@ class StagedTick:
     edge: float = 0.0      # scheduled dispatch edge (perf_counter)
     deadline: float = 0.0  # owning-tick egress deadline; 0 = unaccounted
     depth: int = 0         # pipeline depth this tick ran at
+    # Express-lane handoff (runtime/express.py): rooms whose fast-path
+    # subscribers were already served on arrival during this tick's
+    # window (their bits are masked at fan-out), the packed sub-bit
+    # words to clear, and the window's send log for the replay ring.
+    express_rows: Any = None
+    express_words: Any = None
+    express_log: Any = None
+    edge_over_us: float = 0.0  # wake overshoot past the dispatch edge
 
 
 class PlaneRuntime:
@@ -294,6 +302,8 @@ class PlaneRuntime:
         low_latency: bool = False,
         egress_shards: int = 0,
         egress_multicast: bool = True,
+        express_max_subs: int = 0,
+        express_max_rooms: int = 16,
     ):
         from livekit_server_tpu.ops import audio as audio_ops, bwe as bwe_ops
 
@@ -357,6 +367,15 @@ class PlaneRuntime:
 
         self.egress_plane = EgressPlane(egress_shards, egress_multicast)
         self._munge_shard_plan = self.egress_plane.room_plan(dims.rooms)
+        # Two-tier latency plane (runtime/express.py): when enabled,
+        # small/interactive rooms are forwarded on packet arrival from
+        # the last device selector mirror instead of waiting for the
+        # batched tick. None when express_max_subs == 0.
+        self.express = None
+        if express_max_subs > 0:
+            from livekit_server_tpu.runtime.express import ExpressLane
+
+            self.express = ExpressLane(self, express_max_subs, express_max_rooms)
         self._mesh = mesh
         if mesh is not None:
             from livekit_server_tpu.parallel import make_sharded_tick, shard_tree
@@ -421,6 +440,12 @@ class PlaneRuntime:
         # Per-tick stage breakdown dicts (idx/stage_ms/device_ms/fanout_ms/
         # total_ms/depth/late) — the /debug/ticks pipeline view.
         self.recent_ticks: deque = deque(maxlen=120)
+        # Tick-edge sleep calibration: measured coarse-sleep overshoot
+        # for this host (seconds; <0 = not yet calibrated — falls back
+        # to the historical fixed 1.5 ms margin), and the last wake's
+        # overshoot past its edge (surfaced per tick in recent_ticks).
+        self._sleep_bias = -1.0
+        self._edge_overshoot_us = 0.0
         # Single worker: device steps are strictly ordered (donated state).
         from concurrent.futures import ThreadPoolExecutor
 
@@ -463,6 +488,14 @@ class PlaneRuntime:
         the pin participates in the effective upload."""
         self.pinned[room, track, sub] = pinned
         self._dirty_rows.add(room)
+
+    def set_express_pin(self, room: int, pin: bool | None) -> None:
+        """Pin one room's latency tier: True = express lane, False =
+        batched tick, None = automatic (subscriber-count eligibility).
+        No-op when the express lane is disabled. Takes effect at the
+        next tick boundary (re-tier runs with staging)."""
+        if self.express is not None:
+            self.express.set_pin(room, pin)
 
     def set_shed(self, *, spatial_cap: int | None = None,
                  pause_video: bool | None = None) -> None:
@@ -526,6 +559,10 @@ class PlaneRuntime:
         # Munger offsets likewise: the next tenant's streams must anchor
         # fresh, not continue a dead room's SN/TS spaces.
         self.munger.clear_room(room)
+        if self.express is not None:
+            # Tier state (pin, activation, selector mirror) must not leak
+            # to the next tenant or past a migration snapshot.
+            self.express.clear_room(room)
         self._dirty_rows.add(room)
 
     def on_tick(self, cb: Callable[[TickResult], Awaitable[None] | None]) -> None:
@@ -608,6 +645,18 @@ class PlaneRuntime:
         if epoch != self.run_epoch:
             return None  # restarted mid-step: result belongs to a dead run
         self.state = state
+        if self.express is not None and self.express.wants_mirror():
+            # Post-commit selector mirror for the express lane: fetched
+            # here (same device sync as `out`), consumed at the next
+            # retier on the event loop — decisions made from it are
+            # bounded ≤1 tick stale.
+            sel = state.sel
+            self.express.post_mirror(
+                np.asarray(sel.current_spatial),
+                np.asarray(sel.current_temporal),
+                np.asarray(sel.target_spatial),
+                np.asarray(sel.target_temporal),
+            )
         if self.integrity is not None:
             # Audit the committed state on the cadence; the fetched mask
             # is a few dozen bytes riding the same device sync as `out`.
@@ -629,6 +678,14 @@ class PlaneRuntime:
         # (connectionquality windows; room.go:1318 worker cadence).
         q_ticks = max(1, 1000 // self.tick_ms)
         roll = (idx + 1) % q_ticks == 0
+        ex_rows = ex_words = ex_log = None
+        if self.express is not None:
+            # Tier boundary, in the same synchronous event-loop slice as
+            # the drain (atomic w.r.t. arrivals and migration freezes):
+            # close the ending window, re-tier, and take over the closing
+            # window for freshly promoted rooms. Returns the rooms whose
+            # fast-path subscriber bits this tick's fan-out must skip.
+            ex_rows, ex_words, ex_log = self.express.tick_boundary(self.ingest)
         inp, payloads = self.ingest.drain(
             roll_quality=roll, tick_index=idx,
             reuse_fields=(self._mesh is None),
@@ -643,7 +700,8 @@ class PlaneRuntime:
             # and the packing memcpys overlap the previous device step.
             packed = plane.pack_tick_inputs(inp)
         st = StagedTick(inp=inp, payloads=payloads, idx=idx, roll=roll,
-                        packed=packed)
+                        packed=packed, express_rows=ex_rows,
+                        express_words=ex_words, express_log=ex_log)
         st.stage_s = time.perf_counter() - t0
         return st
 
@@ -696,7 +754,10 @@ class PlaneRuntime:
         edge + (1 + depth) periods), checked after the delivery callbacks
         have actually run."""
         c0 = time.perf_counter()
-        result = self._fan_out(out, st.payloads, st.inp, 0.0, st.idx)
+        result = self._fan_out(
+            out, st.payloads, st.inp, 0.0, st.idx,
+            express=(st.express_rows, st.express_words, st.express_log),
+        )
         fanout_s = time.perf_counter() - c0
         result.tick_s = st.stage_s + st.device_s + fanout_s
         result.quality_window_closed = st.roll
@@ -724,6 +785,7 @@ class PlaneRuntime:
             "fanout_ms": round(fanout_s * 1000.0, 3),
             "total_ms": round(result.tick_s * 1000.0, 3),
             "late": late,
+            "edge_overshoot_us": round(st.edge_over_us, 1),
         }
         # Per-shard egress timing: the send callbacks above just ran, so
         # the plane's last-send snapshot is THIS tick's (munge likewise).
@@ -854,7 +916,8 @@ class PlaneRuntime:
             for (r, t, s, sn, ts) in pads
         ]
 
-    def _fan_out(self, out, payloads, inp, tick_s: float, tick_idx: int | None = None) -> TickResult:
+    def _fan_out(self, out, payloads, inp, tick_s: float, tick_idx: int | None = None,
+                 express: tuple | None = None) -> TickResult:
         # Bit-packed egress masks → host munge (runtime/munge.py) →
         # column arrays. The device ships one bit per (track, pkt, sub)
         # send; the SN/TS/VP8 value rewrites run here with host-owned
@@ -881,6 +944,22 @@ class PlaneRuntime:
                 send_bits[rows] = 0
                 drop_bits[rows] = 0
                 switch_bits[rows] = 0
+        ex_rows = ex_words = ex_log = None
+        if express is not None:
+            ex_rows, ex_words, ex_log = express
+        if ex_rows is not None and len(ex_rows):
+            # Express-handled rooms: their fast-path subscribers were
+            # served (and their munger lanes advanced) on arrival during
+            # this tick's window — clear exactly those subscriber bits so
+            # the batched walk neither re-sends nor re-advances them.
+            # WS/TCP/RED subscribers of the same rooms keep their bits.
+            send_bits = np.array(send_bits)
+            drop_bits = np.array(drop_bits)
+            switch_bits = np.array(switch_bits)
+            clear = ~ex_words[:, None, None, :]
+            send_bits[ex_rows] &= clear
+            drop_bits[ex_rows] &= clear
+            switch_bits[ex_rows] &= clear
         rr, tt, kk, ss, b_sn, b_ts, b_pid, b_tl0, b_ki = (
             self.munger.apply_columns(
                 inp.sn, inp.ts, inp.ts_jump, inp.pid, inp.tl0, inp.keyidx,
@@ -918,7 +997,27 @@ class PlaneRuntime:
             congested.setdefault(int(r), []).append(int(s))
         # Feed the host replay ring from this tick's sends (the push half
         # of the sequencer, now host-side — NACKs resolve at RTCP time).
-        self.host_seq.record(batch, self.tick_index if tick_idx is None else tick_idx)
+        eff_idx = self.tick_index if tick_idx is None else tick_idx
+        self.host_seq.record(batch, eff_idx)
+        if ex_log is not None and len(ex_log):
+            # Express sends of this window, recorded against the SAME
+            # slab now that it is retained in _slab_history. The drain's
+            # reorder pass can permute staging slots within a (room,
+            # track) after the log was written, so entries whose slot no
+            # longer holds their wire SN are dropped — a replay miss the
+            # client re-NACKs, never a wrong payload.
+            T, K = self.dims.tracks, self.dims.pkts
+            lflat = (
+                ex_log.rooms.astype(np.int64) * T + ex_log.tracks
+            ) * K + ex_log.ks
+            ok = (
+                np.asarray(inp.sn).reshape(-1)[lflat] & 0xFFFF
+            ) == ex_log.orig_sn
+            if not ok.all():
+                if self.express is not None:
+                    self.express.stats["replay_drops"] += int((~ok).sum())
+                ex_log = ex_log.take(ok)
+            self.host_seq.record(ex_log, eff_idx)
         padding = self._assemble_padding(inp)
         if padding:
             self.stats["pad_packets"] = self.stats.get("pad_packets", 0) + len(padding)
@@ -953,20 +1052,44 @@ class PlaneRuntime:
             self.egress_plane.warm()  # spawn shard workers off the hot path
             self._task = asyncio.ensure_future(self._run())
 
-    @staticmethod
-    async def _sleep_until(when: float) -> None:
+    async def _calibrate_sleep(self) -> None:
+        """Measure this host's asyncio coarse-sleep overshoot once at
+        loop start: epoll timer slop + event-loop lag, typically
+        0.3-2 ms, previously approximated by a fixed 1.5 ms margin. The
+        median of a short burst (plus a small spin cushion) becomes the
+        pre-edge margin _sleep_until subtracts before its yield-spin
+        tail — a low-slop host stops burning 1.5 ms of spin per tick,
+        and a high-slop host stops self-inflicting lateness at tick 2."""
+        if self._sleep_bias >= 0:
+            return
+        samples = []
+        for _ in range(8):
+            t0 = time.perf_counter()
+            await asyncio.sleep(0.001)
+            samples.append(time.perf_counter() - t0 - 0.001)
+        self._sleep_bias = min(max(float(np.median(samples)) + 2e-4, 3e-4), 4e-3)
+
+    async def _sleep_until(self, when: float) -> None:
         """Window-edge sleep: coarse asyncio.sleep to just short of the
         edge, then a yield loop for the tail. An epoll-backed sleep
         overshoots by the event-loop lag (hundreds of µs under rx load)
         — at a 5 ms tick that alone costs 5-10% of the cadence. The
         sleep(0) tail keeps rx/feedback callbacks running while landing
         the dispatch within ~50 µs of the edge; the spin is bounded by
-        the 1.5 ms margin and only burns the window's idle slack."""
-        delay = when - time.perf_counter() - 0.0015
+        the calibrated margin and only burns the window's idle slack.
+        The wake overshoot is recorded (edge_overshoot_us per tick in
+        recent_ticks) and a coarse sleep that blows THROUGH the edge
+        widens the margin for the next windows (EWMA, capped)."""
+        bias = self._sleep_bias if self._sleep_bias >= 0 else 0.0015
+        delay = when - time.perf_counter() - bias
         if delay > 0:
             await asyncio.sleep(delay)
         while time.perf_counter() < when:
             await asyncio.sleep(0)
+        over = time.perf_counter() - when
+        self._edge_overshoot_us = over * 1e6
+        if over > 2.5e-4 and self._sleep_bias >= 0:
+            self._sleep_bias = min(self._sleep_bias + 0.25 * over, 4e-3)
 
     async def _run(self) -> None:
         """Three-stage pipelined serving loop (the 'double-buffered DMA'
@@ -995,6 +1118,7 @@ class PlaneRuntime:
         lock (the GC01 split: _upload_ctrl/_device_step keep the
         lock-held contract, _stage_host is lock-free)."""
         period = self.tick_ms / 1000.0
+        await self._calibrate_sleep()
         next_at = time.perf_counter() + period
         loop = asyncio.get_running_loop()
         pending: tuple | None = None   # (out, StagedTick) awaiting fan-out
@@ -1003,12 +1127,24 @@ class PlaneRuntime:
         depth = 0 if self.low_latency else 1
         try:
             while True:
+                if staged is not None:
+                    # Edge surgery: deadline accounting and probe
+                    # scheduling for a pre-staged tick happen BEFORE the
+                    # sleep — no device step completes while the loop
+                    # sleeps, so the mirrors _schedule_probe reads cannot
+                    # change — leaving the post-wake path dispatch-only.
+                    staged.depth = depth
+                    staged.edge = next_at
+                    staged.deadline = next_at + (1 + depth) * period
+                    self._schedule_probe(staged)
                 await self._sleep_until(next_at)
-                if self.integrity is not None:
+                if self.integrity is not None and self.integrity._pending_repair:
                     # Drain the row-repair queue filled by the last audit,
                     # at the window edge and OUTSIDE the lock region below:
                     # each repair takes state_lock itself, and the repaired
                     # row's dirtied ctrl re-uploads in this very tick.
+                    # (Guarded: the empty-queue case stays off the wake
+                    # path.)
                     await self.integrity.process()
                 if pending_task is not None:
                     # Backpressure: previous fan-out still running ⇒ wait
@@ -1022,11 +1158,18 @@ class PlaneRuntime:
                     # at the window edge (low latency keeps the freshest
                     # possible drain at the cost of serializing it).
                     staged = self._stage_host()
+                    staged.depth = depth
+                    staged.edge = next_at
+                    staged.deadline = next_at + (1 + depth) * period
+                    self._schedule_probe(staged)
                 cur, staged = staged, None
-                cur.depth = depth
-                cur.edge = next_at
-                cur.deadline = next_at + (1 + depth) * period
-                self._schedule_probe(cur)
+                cur.edge_over_us = self._edge_overshoot_us
+                if self.ingest.frozen_rows:
+                    # A migration freeze can land during the sleep, after
+                    # the pre-edge probe scheduling: re-zero frozen rows'
+                    # probe padding at dispatch (pads advance munger
+                    # lanes; a frozen row must stay at its snapshot).
+                    np.asarray(cur.inp.pad_num)[list(self.ingest.frozen_rows)] = 0
                 await self.state_lock.acquire()
                 try:
                     self._upload_ctrl()

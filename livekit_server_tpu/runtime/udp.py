@@ -163,6 +163,7 @@ class ForwardLatencyProbe:
             "p50_ms": round(self._quantile_from(counts, n, max_s, 0.50) * 1000.0, 3),
             "p90_ms": round(self._quantile_from(counts, n, max_s, 0.90) * 1000.0, 3),
             "p99_ms": round(self._quantile_from(counts, n, max_s, 0.99) * 1000.0, 3),
+            "p999_ms": round(self._quantile_from(counts, n, max_s, 0.999) * 1000.0, 3),
             "max_ms": round(max_s * 1000.0, 3),
         }
 
@@ -519,6 +520,15 @@ class UDPMediaTransport(asyncio.DatagramProtocol):
         # Always-on packet-in→wire-out latency histogram (stamps: rx_batch
         # return → native egress send return; includes tick-queue wait).
         self.fwd_latency = ForwardLatencyProbe()
+        # Express-lane twin: arrival-driven sends skip the tick queue, so
+        # their latency distribution answers a different question (decide+
+        # munge+seal cost) — kept separate or the batched tail would bury
+        # the express p99 (and vice versa).
+        self.fwd_latency_express = ForwardLatencyProbe()
+        # Express lane (runtime/express.py): attached by the room manager
+        # when plane.express_max_subs > 0; rx_batch hands each receive
+        # batch to it right after staging.
+        self._express = None
         # config rtc.congestion_control.send_side_bwe — set ONCE at
         # startup (before any subscriber registers): flipping it later
         # does not refresh already-registered subscribers' fb_enabled
@@ -1777,6 +1787,10 @@ class UDPMediaTransport(asyncio.DatagramProtocol):
                 dd_version=dd_ver,
                 t_rx=t_rx if t_rx else time.perf_counter(),
             )
+            # (Express lane hand-off happens inside push_batch via
+            # ingest.on_put — active rooms' arrivals are decided/munged/
+            # sealed on arrival there, covering TCP/gateway/bridge
+            # staging paths too, not just this one.)
             # MCU tap: audio payloads of mix-enabled rooms feed the Opus
             # decoders (per-packet work, gated to enabled rooms only).
             if self.audio_mixer is not None and self.audio_mixer.rooms:
@@ -1918,6 +1932,187 @@ class UDPMediaTransport(asyncio.DatagramProtocol):
         self._egress_plane = plane
         if plane is not None:
             plane.warm()
+
+    def attach_express(self, lane) -> None:
+        """Bind an ExpressLane (runtime/express.py): this transport
+        supplies its UDP-fast-path subscriber set and carries its wire
+        sends; rx_batch hands each receive batch to the lane right after
+        staging."""
+        self._express = lane
+        lane.sub_provider = self._express_sub_provider
+        lane.sender = self._send_express
+
+    def _express_sub_provider(self) -> np.ndarray:
+        """[R, S] bool — subscribers the express lane may own: plain UDP
+        fast-path only. TCP-fallback, SRTP-gateway, WebSocket, and RED
+        subscribers keep riding the batched tick (their egress paths
+        re-encapsulate per frame and don't fit the small-batch seal)."""
+        self._maybe_resync_subs()
+        return (self._sub_port != 0) & ~self._sub_tcp & ~self._sub_red_arr
+
+    def _send_express(self, cols) -> int:
+        """Express-lane egress: one receive batch's forwarding decisions
+        → wire, now.
+
+        The small-batch twin of send_egress_batch: same destination
+        gathers, seal/counter discipline, TWCC stamping, and SR/tx
+        bookkeeping, but no shard planning, no pacer gate, and no RED/DD
+        handling (RED subs and SVC rooms are express-ineligible). The
+        native egress_express_send entry reuses the persistent worker
+        pool, key-schedule cache, and P3FA staging of the batch path.
+        Returns datagrams handed to the kernel."""
+        n = len(cols)
+        if n == 0:
+            return 0
+        self._maybe_resync_subs()
+        r, t, s = cols.rooms, cols.tracks, cols.subs
+        e_port = self._sub_port[r, s]
+        # Re-filter against live destination state: a sub can churn (or
+        # flip to TCP fallback) between the lane's retier and this
+        # arrival; the batched tier will NOT cover it (the room row is
+        # masked), so a dropped entry here is at worst one lost datagram
+        # to a disconnecting sub.
+        idx = np.nonzero(
+            (e_port != 0) & ~self._sub_tcp[r, s] & (cols.pay_len > 0)
+        )[0]
+        if not len(idx):
+            return 0
+        use_native = (
+            native_egress is not None and self.transport is not None
+            and hasattr(native_egress, "send_express")
+        )
+        if not use_native:
+            # Toolchain-free fallback: per-packet Python path (sealing
+            # and protection happen inside send_egress).
+            from livekit_server_tpu.runtime.plane_runtime import EgressPacket
+
+            slab = cols.slab
+            pkts = []
+            for j in idx:
+                off, ln = int(cols.pay_off[j]), int(cols.pay_len[j])
+                pkts.append(EgressPacket(
+                    room=int(r[j]), track=int(t[j]), sub=int(s[j]),
+                    sn=int(cols.sn[j]) & 0xFFFF,
+                    ts=int(cols.ts[j]) & 0xFFFFFFFF,
+                    pid=int(cols.pid[j]), tl0=int(cols.tl0[j]),
+                    keyidx=int(cols.keyidx[j]), size=ln,
+                    payload=bytes(slab[off:off + ln]),
+                    marker=bool(cols.marker[j]),
+                    t_arr=float(cols.t_arr[j]),
+                ))
+            self.send_egress(pkts)
+            return len(pkts)
+        # Destination-major stable order (GSO runs in the native sender);
+        # entries arrive in k-order per stream, the stable sort keeps it.
+        _S = self._sub_port.shape[1]
+        _T = self.ingest.dims.tracks
+        composite = (r[idx].astype(np.int64) * _S + s[idx]) * _T + t[idx]
+        idx = idx[np.argsort(composite, kind="stable")]
+        rr_, tt_, ss_ = r[idx], t[idx], s[idx]
+        ssrc = self._egress_ssrc_arr[rr_, ss_, tt_].copy()
+        for m_ in np.nonzero(ssrc == 0)[0]:  # first send of a new sub only
+            ssrc[m_] = self.subscriber_ssrc(
+                int(rr_[m_]), int(ss_[m_]), int(tt_[m_])
+            )
+        try:
+            now_ms = asyncio.get_event_loop().time() * 1000.0
+        except RuntimeError:
+            now_ms = time.monotonic() * 1000.0
+        # Seal + per-session counter blocks: identical discipline to the
+        # batch path — counters come from the SAME per-session array, so
+        # express and batched sends never collide on a nonce.
+        e_sess = self._sub_sess_idx[rr_, ss_]
+        n_sess = len(self._sessions)
+        if n_sess:
+            seal = (e_sess >= 0) & (
+                self.require_encryption
+                | (self._sess_active[np.maximum(e_sess, 0)] > 0)
+            )
+        else:
+            seal = np.zeros(len(idx), bool)
+        key_idx = np.where(seal, e_sess, -1).astype(np.int32)
+        ctr = np.zeros(len(idx), np.uint64)
+        if seal.any():
+            sealed_pos = np.nonzero(seal)[0]
+            es = e_sess[sealed_pos]
+            u, cnts = np.unique(es, return_counts=True)
+            base = np.zeros(n_sess, np.uint64)
+            base[u] = self._sess_ctr[u]
+            self._sess_ctr[u] += cnts.astype(np.uint64)
+            order = np.argsort(es, kind="stable")
+            sorted_es = es[order]
+            grp_start = np.r_[0, np.nonzero(np.diff(sorted_es))[0] + 1]
+            sizes = np.diff(np.r_[grp_start, len(es)])
+            ranks = np.empty(len(es), np.int64)
+            ranks[order] = np.arange(len(es)) - np.repeat(grp_start, sizes)
+            ctr[sealed_pos] = base[es] + ranks.astype(np.uint64)
+            sp_r, sp_s = rr_[sealed_pos], ss_[sealed_pos]
+            sp_slot = (ctr[sealed_pos] & np.uint64(TWCC_RING - 1)).astype(np.int64)
+            self._twcc_ms[sp_r, sp_s, sp_slot] = now_ms
+            self._twcc_ctr[sp_r, sp_s, sp_slot] = ctr[sealed_pos].astype(np.int64)
+            self._twcc_len[sp_r, sp_s, sp_slot] = (
+                cols.pay_len[idx][sealed_pos] + WIRE_OVERHEAD_BYTES
+            )
+        keys = self._sess_keys if n_sess else np.zeros((1, 16), np.uint8)
+        key_ids = self._sess_keyids if n_sess else np.zeros(1, np.uint32)
+        # Header extensions: playout-delay only (one shared 3-byte
+        # section). SVC rooms are express-ineligible, so no DD patching.
+        ext_blob, ext_off, ext_len = b"", None, None
+        if self.playout_delay is not None:
+            is_vid = self._track_is_video[rr_, tt_]
+            if is_vid.any():
+                mn, mx = self.playout_delay
+                val = (min(mn // 10, 4095) << 12) | min(mx // 10, 4095)
+                sec = build_ext_section(
+                    [(PLAYOUT_DELAY_EXT_ID, val.to_bytes(3, "big"))]
+                )
+                ext_blob = sec
+                ext_off = np.zeros(len(idx), np.int64)
+                ext_len = np.where(is_vid, len(sec), 0).astype(np.int32)
+        fd = self.transport.get_extra_info("socket").fileno()
+        _, _, _, sent, _ = native_egress.send_express(
+            fd=fd, slab=cols.slab,
+            pay_off=cols.pay_off[idx], pay_len=cols.pay_len[idx],
+            marker=cols.marker[idx],
+            pt=self._track_pt[rr_, tt_],
+            vp8=(
+                self._track_is_video[rr_, tt_] & ~self._track_svc[rr_, tt_]
+            ).astype(np.uint8),
+            sn=(cols.sn[idx] & 0xFFFF).astype(np.uint16),
+            ts=(cols.ts[idx].astype(np.int64) & 0xFFFFFFFF).astype(np.uint32),
+            ssrc=ssrc,
+            pid=cols.pid[idx], tl0=cols.tl0[idx], kidx=cols.keyidx[idx],
+            ip=self._sub_ip[rr_, ss_], port=e_port[idx],
+            seal=seal.astype(np.uint8), key_idx=key_idx,
+            keys=keys, key_ids=key_ids, counters=ctr,
+            ext_blob=ext_blob, ext_off=ext_off, ext_len=ext_len,
+        )
+        self.stats["tx"] += sent
+        if sent < len(idx):
+            self.stats["tx_drop"] = (
+                self.stats.get("tx_drop", 0) + len(idx) - sent
+            )
+        t_arr = cols.t_arr[idx]
+        stamped = t_arr[t_arr > 0.0]
+        if stamped.size:
+            self.fwd_latency_express.observe(time.perf_counter() - stamped)
+        # SR/tx bookkeeping (add.at — express batches are tiny relative
+        # to the plane, bincount temporaries never pay off here).
+        S = self.ingest.dims.subs
+        flat = (rr_.astype(np.int64) * S + ss_) * _T + tt_
+        np.add.at(self._txsr_pkts.reshape(-1), flat, 1)
+        np.add.at(self._txsr_oct.reshape(-1), flat, cols.pay_len[idx])
+        self._txsr_ts[rr_, ss_, tt_] = (
+            cols.ts[idx].astype(np.int64) & 0xFFFFFFFF
+        ).astype(np.uint32)
+        self._txsr_ms[rr_, ss_, tt_] = now_ms
+        flat_rs = rr_.astype(np.int64) * S + ss_
+        np.add.at(self.tx_pkts.reshape(-1), flat_rs, 1)
+        np.add.at(
+            self.tx_bytes.reshape(-1), flat_rs,
+            cols.pay_len[idx].astype(np.int64) + WIRE_OVERHEAD_BYTES,
+        )
+        return int(sent)
 
     def send_egress_batch(self, batch, red_plan=None, layer_caps=None,
                           pacer_allowed=None) -> np.ndarray:

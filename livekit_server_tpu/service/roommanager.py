@@ -78,6 +78,8 @@ class RoomManager:
             ),
             egress_shards=config.egress.shards,
             egress_multicast=config.egress.multicast_seal,
+            express_max_subs=p.express_max_subs,
+            express_max_rooms=p.express_max_rooms,
         )
         self.rooms: dict[str, Room] = {}
         self._row_to_room: dict[int, Room] = {}

@@ -184,11 +184,21 @@ class LivekitServer:
             "recent_tick_s": list(getattr(rt, "recent_tick_s", [])),
             "recent_ticks": list(getattr(rt, "recent_ticks", [])),
         }
+        body["sleep_bias_us"] = round(
+            max(getattr(rt, "_sleep_bias", 0.0), 0.0) * 1e6, 1
+        )
         udp = getattr(self.room_manager, "udp", None)
         if udp is not None and getattr(udp, "fwd_latency", None) is not None:
             # Measured wall-clock packet-in→wire-out latency (includes
             # tick-queueing wait) — the probe in runtime/udp.py.
             body["forward_latency"] = udp.fwd_latency.summary()
+        if rt.express is not None:
+            body["express"] = rt.express.debug()
+            if udp is not None:
+                # Express twin: arrival-driven, no tick-queue wait.
+                body["forward_latency_express"] = (
+                    udp.fwd_latency_express.summary()
+                )
         return web.json_response(body)
 
     async def metrics(self, request: web.Request) -> web.Response:
@@ -356,6 +366,13 @@ class LivekitServer:
                 self.room_manager.udp.attach_egress_plane(
                     self.room_manager.runtime.egress_plane
                 )
+                # Express lane (plane.express_max_subs > 0): interactive
+                # rooms forward on packet arrival through this transport
+                # instead of the batched tick (runtime/express.py).
+                if self.room_manager.runtime.express is not None:
+                    self.room_manager.udp.attach_express(
+                        self.room_manager.runtime.express
+                    )
                 self.room_manager.udp.send_side_bwe = (
                     self.config.rtc.congestion_control.send_side_bwe
                 )

@@ -84,7 +84,7 @@ constexpr int MAX_DGRAM = 2048;
 constexpr int MMSG_CHUNK = 512;
 // Bump when the exported symbol set or any signature changes; the ctypes
 // loader and tools/check.py compare it against the Python-side constant.
-constexpr int32_t EGRESS_ABI = 3;
+constexpr int32_t EGRESS_ABI = 4;
 // Kernel cap is UDP_MAX_SEGMENTS (64); stay under it and under 64 KB.
 constexpr int GSO_MAX_SEGS = 60;
 constexpr int64_t GSO_MAX_BYTES = 64000;
@@ -730,6 +730,46 @@ int64_t egress_plane_send(
     total += fd >= 0 ? shard_sent[s] : shard_built[s];
   }
   return total;
+}
+
+// Express-lane egress: assemble+seal(+send) a SMALL batch (one receive
+// window's worth of packets for interactive rooms) inline on the caller's
+// thread, with none of the plane machinery — no shard planning, no pool
+// handoff, no pacing. Reuses the same worker() walk as the sharded path,
+// so the canonical-group staging (grp/rooms/grp_slots, may be null/0) and
+// the per-thread key-schedule cache apply unchanged; output frames are
+// byte-identical to what the batched path would build for the same
+// entries. Returns datagrams handed to the kernel, or datagrams built
+// when fd < 0; *built_out (optional) always receives the built count.
+int64_t egress_express_send(
+    int fd, const uint8_t* slab, int32_t n,
+    const int64_t* pay_off, const int32_t* pay_len, const uint8_t* marker,
+    const uint8_t* pt, const uint8_t* vp8,
+    const uint8_t* ext_blob, const int64_t* ext_off, const int32_t* ext_len,
+    const uint16_t* sn,
+    const uint32_t* ts, const uint32_t* ssrc, const int32_t* pid,
+    const int32_t* tl0, const int32_t* kidx, const uint32_t* ip,
+    const uint16_t* port, const uint8_t* seal, const int32_t* key_idx,
+    const uint8_t* keys, const uint32_t* key_ids, const uint64_t* counters,
+    uint8_t* out, const int64_t* out_off, const int32_t* out_len,
+    const int32_t* rooms, const int32_t* grp, int32_t grp_slots,
+    int64_t* built_out) {
+  if (n <= 0) {
+    if (built_out) *built_out = 0;
+    return 0;
+  }
+  std::vector<uint8_t> skip(n, 0);
+  Args a{skip.data(), slab, pay_off, pay_len, marker, pt, vp8,
+         ext_blob, ext_off, ext_len,
+         sn,  ts,
+         ssrc,  pid,     tl0,     kidx,   ip,       port,    seal, key_idx,
+         keys,  key_ids, counters, out,   out_off,  out_len, fd,
+         /*pace_window_us=*/0};
+  static thread_local WorkerScratch scr;
+  int64_t built = 0;
+  int64_t sent = worker(a, 0, n, grp, rooms, grp_slots, &scr, &built);
+  if (built_out) *built_out = built;
+  return fd >= 0 ? sent : built;
 }
 
 // Send pre-built datagrams (contiguous blob + per-entry offset/length/

@@ -1,0 +1,508 @@
+"""Two-tier latency plane: the express lane (runtime/express.py).
+
+The load-bearing claim is bit-equivalence — an express room's wire
+output (SN/TS/VP8 descriptor rewrites, payload bytes, marker) must be
+byte-identical to what the batched tick would have produced for the
+same packets against the same mirror state. The rest of the suite
+pins the seams the lane must honor exactly like the batched tier:
+governor shedding, integrity quarantine, migration freeze, NACK
+replay, and the fast-path/slow-path subscriber split. The migration
+drill at the bottom is the cross-plane version: an express room
+freezes, hands off two-phase, and replays its freeze window with zero
+SN loss while the source's tier state resets.
+"""
+
+import asyncio
+import socket
+
+import numpy as np
+import pytest
+
+from livekit_server_tpu.config.config import ConfigError
+from livekit_server_tpu.models import plane
+from livekit_server_tpu.native import rtp as parser
+from livekit_server_tpu.routing import MemoryBus
+from livekit_server_tpu.runtime import PlaneRuntime
+from livekit_server_tpu.runtime.ingest import PacketIn
+from livekit_server_tpu.runtime.udp import start_udp_transport
+from tests.conftest import free_port
+from tests.test_migration import (
+    make_cfg,
+    pump_until,
+    start_node,
+    stop_all,
+    wait_for,
+)
+
+DIMS = plane.PlaneDims(rooms=2, tracks=2, pkts=4, subs=4)
+
+
+def tap_express(rt):
+    """Install a sender hook that materializes every express entry into
+    plain dicts (payload bytes copied out of the live slab at send time,
+    exactly when a real sender would read them)."""
+    out = []
+
+    def sender(cols):
+        for i in range(len(cols)):
+            off, ln = int(cols.pay_off[i]), int(cols.pay_len[i])
+            out.append({
+                "room": int(cols.rooms[i]), "track": int(cols.tracks[i]),
+                "sub": int(cols.subs[i]),
+                "sn": int(cols.sn[i]) & 0xFFFF,
+                "ts": int(cols.ts[i]) & 0xFFFFFFFF,
+                "pid": int(cols.pid[i]), "tl0": int(cols.tl0[i]),
+                "keyidx": int(cols.keyidx[i]),
+                "payload": bytes(cols.slab[off:off + ln]),
+                "marker": bool(cols.marker[i]),
+            })
+        return len(cols)
+
+    rt.express.sender = sender
+    return out
+
+
+def _ekey(e: dict):
+    return (e["room"], e["track"], e["sub"], e["sn"], e["ts"], e["pid"],
+            e["tl0"], e["keyidx"], e["payload"], e["marker"])
+
+
+def _pkey(p):
+    return (p.room, p.track, p.sub, p.sn, p.ts, p.pid, p.tl0, p.keyidx,
+            p.payload, p.marker)
+
+
+def _push_av(rt, w: int) -> None:
+    """One video (layer 2 = default target, keyframe on w=0) + one audio
+    packet for window w — the same bytes on every runtime under test."""
+    rt.ingest.push(PacketIn(
+        room=0, track=0, sn=500 + w, ts=3000 * w, size=60,
+        payload=b"vid-%d-payload" % w, marker=True, layer=2, temporal=0,
+        keyframe=(w == 0), layer_sync=(w == 0), begin_pic=True,
+        pid=700 + w, tl0=w, keyidx=w % 32))
+    rt.ingest.push(PacketIn(
+        room=0, track=1, sn=100 + w, ts=960 * w, size=20,
+        payload=b"aud-%d" % w, audio_level=30))
+
+
+def _setup_av(rt) -> None:
+    rt.set_track(0, 0, published=True, is_video=True)
+    rt.set_track(0, 1, published=True, is_video=False)
+    for s in (1, 2):
+        rt.set_subscription(0, 0, s, subscribed=True)
+        rt.set_subscription(0, 1, s, subscribed=True)
+
+
+# -- bit-equivalence ----------------------------------------------------------
+
+async def test_express_wire_output_byte_identical_to_batched():
+    """The same packet sequence through an express-tier runtime and a
+    batched-only runtime must produce the identical multiset of wire
+    tuples (munged SN/TS/pid/tl0/keyidx, payload bytes, marker) per
+    subscriber — the decision scan, munger lanes, and payload gathering
+    are one algebra in two places."""
+    rt_ex = PlaneRuntime(DIMS, tick_ms=10, express_max_subs=2)
+    rt_ba = PlaneRuntime(DIMS, tick_ms=10)
+    _setup_av(rt_ex)
+    _setup_av(rt_ba)
+    ex_entries = tap_express(rt_ex)
+    out_ex, out_ba = [], []
+    for w in range(6):
+        _push_av(rt_ex, w)
+        _push_av(rt_ba, w)
+        res_ex = await rt_ex.step_once()
+        res_ba = await rt_ba.step_once()
+        out_ex.extend(_pkey(p) for p in res_ex.egress if not p.padding)
+        out_ba.extend(_pkey(p) for p in res_ba.egress if not p.padding)
+    assert rt_ex.express.active[0], "room never promoted"
+    assert rt_ex.express.stats["promotes"] >= 1
+    assert len(ex_entries) > 0, "express tier never carried a packet"
+    # Batched-runtime totals: 6 windows × 2 tracks × 2 subs.
+    assert len(out_ba) == 24
+    combined = sorted(out_ex + [_ekey(e) for e in ex_entries])
+    assert combined == sorted(out_ba)
+    # The lanes ended at the same point too (shared sequencing space).
+    assert np.array_equal(rt_ex.munger.last_sn, rt_ba.munger.last_sn)
+
+
+# -- promote → overload → demote continuity -----------------------------------
+
+async def test_promote_shed_demote_audio_continuity():
+    """Audio continuity 100% across the whole tier lifecycle: batched
+    warm-up, promotion takeover, governor L3 shed (overload), and the
+    demotion back to batched — every SN exactly once, in order, for
+    every subscriber."""
+    rt = PlaneRuntime(DIMS, tick_ms=10, express_max_subs=2)
+    rt.set_track(0, 0, published=True, is_video=False)
+    for s in (1, 2):
+        rt.set_subscription(0, 0, s, subscribed=True)
+    ex = tap_express(rt)
+    got = {1: [], 2: []}
+    express_sns = set()
+    sn = 100
+
+    async def run_windows(n):
+        nonlocal sn
+        for _ in range(n):
+            mark = len(ex)
+            rt.ingest.push(PacketIn(room=0, track=0, sn=sn, ts=0, size=10,
+                                    payload=b"x"))
+            res = await rt.step_once()
+            for p in res.egress:
+                if not p.padding and p.track == 0:
+                    got[p.sub].append(p.sn)
+            for e in ex[mark:]:
+                got[e["sub"]].append(e["sn"])
+                express_sns.add(e["sn"])
+            sn += 1
+
+    await run_windows(2)                 # batched; 2nd boundary promotes
+    assert rt.express.active[0]
+    await run_windows(3)                 # express steady state
+    rt.set_shed(pause_video=True)        # overload: audio is never shed
+    await run_windows(2)
+    rt.set_shed(pause_video=False)
+    rt.set_express_pin(0, False)         # force back to batched
+    await run_windows(2)
+    assert not rt.express.active[0]
+    for s in (1, 2):
+        assert got[s] == list(range(100, sn)), f"sub {s} lost or reordered"
+    assert express_sns, "express tier never carried audio"
+    assert rt.express.stats["promotes"] >= 1
+    assert rt.express.stats["demotes"] >= 1
+
+
+# -- governor seam ------------------------------------------------------------
+
+async def test_governor_shed_mutes_express_video_audio_flows():
+    """set_shed(pause_video=True) must bind on the express tier at the
+    next retier exactly as it binds the batched upload: video entries
+    stop, audio keeps flowing on-arrival."""
+    rt = PlaneRuntime(DIMS, tick_ms=10, express_max_subs=2)
+    rt.set_track(0, 0, published=True, is_video=True)
+    rt.set_track(0, 1, published=True, is_video=False)
+    rt.set_subscription(0, 0, 1, subscribed=True)
+    rt.set_subscription(0, 1, 1, subscribed=True)
+    ex = tap_express(rt)
+
+    async def window(w):
+        _push_av(rt, w)
+        return await rt.step_once()
+
+    await window(0)
+    await window(1)
+    assert rt.express.active[0]
+    mark = len(ex)
+    await window(2)
+    assert {e["track"] for e in ex[mark:]} == {0, 1}
+    rt.set_shed(pause_video=True)
+    await rt.step_once()                 # boundary rebuilds the express base
+    mark = len(ex)
+    res = await window(3)
+    tracks = {e["track"] for e in ex[mark:]}
+    assert tracks == {1}, f"video must shed on the express tier, got {tracks}"
+    # And the batched tier didn't sneak the video out either.
+    assert not any(p.track == 0 and not p.padding for p in res.egress)
+
+
+# -- integrity seam -----------------------------------------------------------
+
+class _StubIntegrity:
+    """The quarantine surface the runtime and lane consume, without the
+    audit kernel: a mutable `quarantined` set plus the no-op hooks the
+    tick loop calls."""
+
+    def __init__(self):
+        self.quarantined = set()
+        self._pending_repair = set()
+
+    def maybe_audit(self, tick_index):
+        pass
+
+    async def process(self):
+        pass
+
+
+async def test_quarantine_blocks_express_mid_window():
+    """Quarantine lands on the worker thread mid-window; the lane's live
+    check must stop on-arrival sends IMMEDIATELY — not one retier later —
+    and the batched fan-out masks the room the same tick."""
+    rt = PlaneRuntime(DIMS, tick_ms=10, express_max_subs=2)
+    rt.integrity = _StubIntegrity()
+    rt.set_track(0, 0, published=True, is_video=False)
+    rt.set_subscription(0, 0, 1, subscribed=True)
+    ex = tap_express(rt)
+    for w in range(2):
+        rt.ingest.push(PacketIn(room=0, track=0, sn=100 + w, ts=0, size=10,
+                                payload=b"x"))
+        await rt.step_once()
+    assert rt.express.active[0]
+    mark = len(ex)
+    rt.ingest.push(PacketIn(room=0, track=0, sn=102, ts=0, size=10,
+                            payload=b"x"))
+    assert len(ex) > mark, "express should be flowing pre-quarantine"
+
+    rt.integrity.quarantined.add(0)
+    mark, n0 = len(ex), rt.express.stats["express_pkts"]
+    rt.ingest.push(PacketIn(room=0, track=0, sn=103, ts=0, size=10,
+                            payload=b"x"))
+    assert len(ex) == mark, "quarantined room must not express-send"
+    assert rt.express.stats["express_pkts"] == n0
+    res = await rt.step_once()
+    assert not any(p.room == 0 and not p.padding for p in res.egress)
+
+    rt.integrity.quarantined.clear()
+    await rt.step_once()                 # boundary drops the quarantine mute
+    mark = len(ex)
+    rt.ingest.push(PacketIn(room=0, track=0, sn=104, ts=0, size=10,
+                            payload=b"x"))
+    assert len(ex) > mark, "express should resume after the quarantine lifts"
+    await rt.step_once()
+
+
+# -- migration-freeze seam + teardown -----------------------------------------
+
+async def test_freeze_demotes_and_clear_room_resets():
+    """A frozen row demotes at the next boundary (its packets route to
+    the bridge sink, never the lane), re-promotion after unfreeze waits
+    for a FRESH device mirror, and clear_room leaves no tier state for
+    the next tenant."""
+    rt = PlaneRuntime(DIMS, tick_ms=10, express_max_subs=2)
+    rt.set_track(0, 0, published=True, is_video=False)
+    rt.set_subscription(0, 0, 1, subscribed=True)
+    ex = tap_express(rt)
+    for w in range(2):
+        rt.ingest.push(PacketIn(room=0, track=0, sn=100 + w, ts=0, size=10,
+                                payload=b"x"))
+        await rt.step_once()
+    assert rt.express.active[0]
+
+    bridged = []
+    rt.ingest.frozen_rows.add(0)
+    rt.ingest.freeze_sinks[0] = bridged.append
+    await rt.step_once()
+    assert not rt.express.active[0] and not rt.express.desired[0]
+    mark = len(ex)
+    rt.ingest.push(PacketIn(room=0, track=0, sn=102, ts=0, size=10,
+                            payload=b"x"))
+    assert len(ex) == mark, "nothing may express past the freeze snapshot"
+    assert len(bridged) == 1 and bridged[0].sn == 102
+
+    rt.ingest.frozen_rows.discard(0)
+    rt.ingest.freeze_sinks.pop(0)
+    await rt.step_once()                 # eligible again, but mirror is stale
+    assert not rt.express.active[0], "re-promotion must wait for a fresh mirror"
+    await rt.step_once()
+    assert rt.express.active[0]
+
+    rt.clear_room(0)
+    lane = rt.express
+    assert not lane.active[0] and not lane.desired[0] and not lane.mirror_ok[0]
+    assert lane.pin[0] == 0
+    assert (lane.cur_sp[0] == -1).all() and (lane.tgt_sp[0] == -1).all()
+    assert (lane.words[0] == 0).all() and not lane.express_subs[0].any()
+
+
+# -- NACK replay --------------------------------------------------------------
+
+async def test_nack_replay_covers_express_sends():
+    """An express send must be NACK-replayable exactly like a batched
+    send: the window's express log lands in the host replay ring at the
+    boundary, keyed by the munged SN, payload bytes intact."""
+    rt = PlaneRuntime(DIMS, tick_ms=10, express_max_subs=2)
+    rt.set_track(0, 0, published=True, is_video=False)
+    rt.set_subscription(0, 0, 1, subscribed=True)
+    ex = tap_express(rt)
+    for w in range(2):
+        rt.ingest.push(PacketIn(room=0, track=0, sn=100 + w, ts=0, size=10,
+                                payload=b"seed"))
+        await rt.step_once()
+    assert rt.express.active[0]
+    mark = len(ex)
+    rt.ingest.push(PacketIn(room=0, track=0, sn=102, ts=0, size=12,
+                            payload=b"express-pay"))
+    assert len(ex) == mark + 1
+    entry = ex[mark]
+    await rt.step_once()                 # log → replay ring
+    reps = rt.resolve_nacks(0, 1, 0, [entry["sn"]])
+    assert len(reps) == 1
+    assert reps[0].sn == entry["sn"]
+    assert reps[0].payload == b"express-pay"
+
+
+# -- fast-path / slow-path subscriber split -----------------------------------
+
+async def test_sub_provider_splits_tiers_disjoint_and_complete():
+    """Only the provider's fast-path subscribers ride the lane; the rest
+    of the room's subscribers keep riding the batched tick. Union
+    complete, intersection empty."""
+    rt = PlaneRuntime(DIMS, tick_ms=10, express_max_subs=2)
+    rt.set_track(0, 0, published=True, is_video=False)
+    for s in (1, 2):
+        rt.set_subscription(0, 0, s, subscribed=True)
+    fast = np.zeros((DIMS.rooms, DIMS.subs), bool)
+    fast[0, 1] = True
+    rt.express.sub_provider = lambda: fast
+    ex = tap_express(rt)
+    for w in range(2):
+        rt.ingest.push(PacketIn(room=0, track=0, sn=100 + w, ts=0, size=10,
+                                payload=b"x"))
+        await rt.step_once()
+    assert rt.express.active[0]
+    assert rt.express.express_subs[0, 1] and not rt.express.express_subs[0, 2]
+    mark = len(ex)
+    rt.ingest.push(PacketIn(room=0, track=0, sn=102, ts=0, size=10,
+                            payload=b"y"))
+    res = await rt.step_once()
+    ex_subs = {e["sub"] for e in ex[mark:] if e["sn"] == 102}
+    ba_subs = {p.sub for p in res.egress if not p.padding and p.sn == 102}
+    assert ex_subs == {1} and ba_subs == {2}
+
+
+# -- end to end over real UDP -------------------------------------------------
+
+async def test_express_udp_wire_end_to_end():
+    """Express sends leave through the real transport (_send_express →
+    native egress_express_send, or the per-packet fallback) and arrive
+    at the subscriber's socket: every SN exactly once across both tiers,
+    payload bytes intact."""
+    udims = plane.PlaneDims(rooms=2, tracks=4, pkts=8, subs=4)
+    runtime = PlaneRuntime(udims, tick_ms=10, express_max_subs=2)
+    s = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    transport = await start_udp_transport(runtime.ingest, "127.0.0.1", port)
+    sub = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
+    try:
+        transport.attach_express(runtime.express)
+        runtime.set_track(0, 0, published=True, is_video=False)
+        runtime.set_subscription(0, 0, 1, subscribed=True)
+        transport.assign_ssrc(room=0, track=0, is_video=False)
+        sub.bind(("127.0.0.1", 0))
+        sub.setblocking(False)
+        transport.register_subscriber(0, 1, sub.getsockname())
+
+        got, batched_sns = [], []
+        for i in range(6):
+            runtime.ingest.push(PacketIn(room=0, track=0, sn=600 + i,
+                                         ts=960 * i, size=10,
+                                         payload=b"opus" + bytes([i])))
+            res = await runtime.step_once()
+            batched_sns.extend(p.sn for p in res.egress if not p.padding)
+            transport.send_egress(res.egress)
+            await asyncio.sleep(0.02)
+            while True:
+                try:
+                    data, _ = sub.recvfrom(2048)
+                    if not (192 <= data[1] <= 223):  # skip interleaved RTCP
+                        got.append(data)
+                except BlockingIOError:
+                    break
+
+        assert runtime.express.active[0]
+        assert runtime.express.stats["express_dgrams"] >= 4
+        assert len(got) == 6
+        sns = []
+        for data in got:
+            out = parser.parse_batch(
+                data, np.asarray([0], np.int32),
+                np.asarray([len(data)], np.int32))[0]
+            sn = int(out["sn"])
+            sns.append(sn)
+            off, ln = int(out["payload_off"]), int(out["payload_len"])
+            assert data[off:off + ln] == b"opus" + bytes([sn - 600])
+        assert sorted(sns) == [600 + i for i in range(6)]
+        # The tiers never overlapped: every datagram left through exactly
+        # one of them.
+        assert len(batched_sns) + runtime.express.stats["express_dgrams"] == 6
+    finally:
+        sub.close()
+        transport.transport.close()
+
+
+# -- express ↔ migration ------------------------------------------------------
+
+async def test_express_room_migrates_with_zero_loss():
+    """An express-tier room freezes, hands off two-phase, and replays
+    its freeze window on the target with zero SN loss — and the source's
+    tier state (activation, selector mirror, sub words) resets with the
+    row so nothing leaks past the snapshot."""
+    bus = MemoryBus()
+    a = b = None
+    sub_sock = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
+    try:
+        a = await start_node(bus, plane={"express_max_subs": 2})
+        b = await start_node(bus)
+        rm_a, rm_b = a.room_manager, b.room_manager
+        rt_a, rt_b = rm_a.runtime, rm_b.runtime
+        assert rt_a.express is not None and rt_b.express is None
+
+        room = await rm_a.get_or_create_room("exmig")
+        row_a = room.slots.row
+        rt_a.set_track(row_a, 0, published=True, is_video=False)
+        rt_a.set_subscription(row_a, 0, 1, subscribed=True)
+        sub_sock.bind(("127.0.0.1", 0))
+        sub_sock.setblocking(False)
+        rm_a.udp.register_subscriber(row_a, 1, sub_sock.getsockname())
+        await wait_for(lambda: bool(rt_a.express.active[row_a]),
+                       what="express promotion on the source")
+
+        for i in range(3):
+            rt_a.ingest.push(PacketIn(room=row_a, track=0, sn=100 + i, ts=0,
+                                      size=10, payload=b"x"))
+        await pump_until(rt_a, row_a, 102)
+        assert rt_a.express.stats["express_pkts"] >= 3
+        # Express munges at PUSH time, so the lane is at 102 before the
+        # serving loop has drained the staging window. Wait the drain out:
+        # packets still staged at freeze time would (correctly, see
+        # express.py's freeze notes) be bridged and re-delivered on the
+        # target — at-most-once duplicates, which this test pins to zero
+        # by freezing only on an empty window.
+        await wait_for(
+            lambda: not bool(np.asarray(rt_a.ingest.valid[row_a]).any()),
+            what="staging drain before freeze")
+
+        got_b = []
+        rt_b.on_tick(lambda res: got_b.extend(
+            p.sn for p in res.egress if p.track == 0 and p.sub == 1))
+        rm_b.migration.on_adopt.append(
+            lambda r: rt_b.set_subscription(r.slots.row, 0, 1,
+                                            subscribed=True))
+
+        def feed_window(r):
+            # Freeze-window arrivals: the row is frozen on the source, so
+            # these must route to the bridge (never the lane) and replay
+            # on the target.
+            for i in range(3, 6):
+                rt_a.ingest.push(PacketIn(room=row_a, track=0, sn=100 + i,
+                                          ts=0, size=10, payload=b"w"))
+        rm_b.migration.on_adopt.append(feed_window)
+
+        assert await rm_a.migrate_room("exmig")
+        row_b = rm_b.rooms["exmig"].slots.row
+        await pump_until(rt_b, row_b, 105)
+        await asyncio.sleep(0.05)
+        assert sorted(got_b) == [103, 104, 105], \
+            "freeze window lost or duplicated"
+        # Source tier state fully reset with the row.
+        lane = rt_a.express
+        assert not lane.active.any() and not lane.desired[row_a]
+        assert (lane.cur_sp[row_a] == -1).all()
+        assert (lane.words[row_a] == 0).all()
+        assert rt_a.ingest.frozen_rows == set()
+    finally:
+        sub_sock.close()
+        await stop_all(a, b)
+
+
+# -- config validation --------------------------------------------------------
+
+def test_express_config_validation():
+    with pytest.raises(ConfigError, match="express_max_subs"):
+        make_cfg(free_port(), plane={"express_max_subs": 8})   # > subs_per_room
+    with pytest.raises(ConfigError, match="express_max_subs"):
+        make_cfg(free_port(), plane={"express_max_subs": -1})
+    with pytest.raises(ConfigError, match="express_max_rooms"):
+        make_cfg(free_port(), plane={"express_max_subs": 2,
+                                     "express_max_rooms": 0})

@@ -32,6 +32,9 @@ def main(argv: list[str] | None = None) -> int:
                     help="skip the compileall pass (pure lint)")
     ap.add_argument("--no-native", action="store_true",
                     help="skip the native toolchain smoke (build + ABI)")
+    ap.add_argument("--latency", action="store_true",
+                    help="also run the slow express-lane latency smoke "
+                         "(tests/test_latency_smoke.py; real sockets, ~30s)")
     ap.add_argument("--rules", default=None,
                     help="comma-separated subset, e.g. GC01,GC04")
     args = ap.parse_args(argv)
@@ -88,6 +91,28 @@ def main(argv: list[str] | None = None) -> int:
             native_failures = native_mod.native_smoke()
         except Exception as exc:  # toolchain totally absent ⇒ report, fail
             native_failures = [f"native smoke crashed: {exc!r}"]
+
+    # Opt-in latency smoke: the slow-marked express-lane wire-p99 test
+    # (excluded from tier-1 by the `slow` marker). Runs in a subprocess
+    # so a hung serving loop can't wedge the gate.
+    latency_failures: list[str] = []
+    if args.latency:
+        import os
+        import subprocess
+
+        proc = subprocess.run(
+            [sys.executable, "-m", "pytest",
+             "tests/test_latency_smoke.py", "-q", "-m", "slow",
+             "-p", "no:cacheprovider"],
+            cwd=REPO_ROOT, capture_output=True, text=True, timeout=300,
+            env={**os.environ, "JAX_PLATFORMS": os.environ.get(
+                "JAX_PLATFORMS", "cpu")},
+        )
+        if proc.returncode != 0:
+            tail = "\n".join((proc.stdout or "").splitlines()[-15:])
+            latency_failures = [f"latency smoke failed "
+                                f"(exit {proc.returncode}):\n{tail}"]
+    native_failures.extend(latency_failures)
 
     if args.as_json:
         print(json.dumps({
