@@ -415,6 +415,9 @@ async def wire_bench(
     # Production egress path: the sharded plane orchestrator (room-aligned
     # shards + canonical-group staging), same wiring as service/server.py.
     udp.attach_egress_plane(runtime.egress_plane)
+    # Flight-recorder attribution: sampled arrival→wire stage split
+    # (same wiring as service/server.py start()).
+    udp.wire_stages = runtime.wire_stages
     if runtime.express is not None:
         # Two-tier latency plane: eligible rooms forward on arrival.
         udp.attach_express(runtime.express)
@@ -667,6 +670,11 @@ async def wire_bench(
         # Measurement window: reset every counter the report reads.
         udp.fwd_latency.reset()
         udp.fwd_latency_express.reset()
+        if runtime.wire_stages is not None:
+            # Same window discipline as the probes: compile/warmup-era
+            # samples (a 2+ s first device step) would poison the stage
+            # percentiles.
+            runtime.wire_stages.reset()
         dev_s[0] = 0.0
         tick_acc[0], tick_acc[1] = 0, 0.0
         for key in late_cause:
@@ -758,8 +766,23 @@ async def wire_bench(
         "ingest_dropped_pct": round(100.0 * dropped / max(rx, 1), 2),
         "fwd_packets": runtime.stats["fwd_packets"] - base["fwd"],
         "pub_skipped_ticks": pub_stats["skipped_ticks"],
+        # Sampled per-stage wire-latency decomposition (trace.py
+        # LatencyAttribution): where the batched tier's arrival→wire
+        # time actually goes — staging wait vs device step vs egress.
+        "stages": (runtime.wire_stages.summary()
+                   if runtime.wire_stages is not None else {}),
         **({"task_errors": task_errors} if task_errors else {}),
     }
+    trace_out = os.environ.get("BENCH_TRACE_OUT")
+    if trace_out and runtime.trace is not None:
+        # Perfetto-loadable dump of the tick-span ring for this wire run
+        # (same format as /debug/trace; validated by tools/trace).
+        from livekit_server_tpu.telemetry import trace_export
+
+        with open(trace_out, "w", encoding="utf-8") as fh:
+            fh.write(trace_export.export_json(
+                runtime.trace.snapshot(), tick_ms
+            ))
     if runtime.express is not None:
         # Express-tier wire latency (arrival-driven sends; no tick-queue
         # wait) beside the batched tier's, plus the lane's own counters —
@@ -1323,6 +1346,16 @@ def main() -> None:
         summary["wire_ramp_max_rooms_ok"] = RESULT["wire_ramp"].get(
             "max_rooms_ok", 0
         )
+    # Sampled wire-latency stage decomposition (flight-recorder plane):
+    # p50/p99 per stage from the preferred wire section that ran.
+    for wk in ("wire_local", "wire"):
+        st = (RESULT.get(wk) or {}).get("stages")
+        if st:
+            summary["wire_stages"] = {
+                s: {"p50_ms": v.get("p50_ms"), "p99_ms": v.get("p99_ms")}
+                for s, v in st.items()
+            }
+            break
     if "skipped" in RESULT:
         summary["skipped"] = sorted(RESULT["skipped"])
     sys.stdout.write(json.dumps(summary) + "\n")

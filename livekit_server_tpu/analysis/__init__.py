@@ -22,6 +22,13 @@ analyzers wired into the tier-1 gate:
        constructed in runtime/ and routing/ carries an explicit bound
        (maxsize=/maxlen=); unbounded buffers turn overload into memory
        growth instead of counted drops.
+  GC06 checkpoint-hygiene — serialization in the checkpoint-bearing
+       modules must pair with the utils/checksum codec in the same
+       function; unverified bytes never scatter into donated state.
+  GC07 emit-hygiene — flight-recorder emits on the tick hot path
+       (record_tick / set_shard / BlackBox.emit / observe_*) must pass
+       scalars only: no f-string, container display, comprehension, or
+       .format in the emit's arguments outside a sampled branch.
 
 Suppressions: `# graftcheck: disable=GC01` on the finding's exact line
 (with a justification comment), `# graftcheck: disable-file=GC02` for a

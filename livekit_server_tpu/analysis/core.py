@@ -18,7 +18,7 @@ from typing import Callable
 
 # -- finding model ----------------------------------------------------------
 
-RULES = ("GC01", "GC02", "GC03", "GC04", "GC05", "GC06")
+RULES = ("GC01", "GC02", "GC03", "GC04", "GC05", "GC06", "GC07")
 
 # Parse/config failures surface as findings too (rule GC00) so the runner
 # has one reporting path; compileall in tools/check.py catches the rest.
@@ -256,6 +256,24 @@ DEFAULT_CONFIG: dict = {
             "decode_frame", "decode_frame_b64",
         ],
     },
+    "gc07": {
+        # Flight-recorder emit hygiene: the tick loop and the planes it
+        # drives synchronously. service/ is included because roommanager
+        # emits lifecycle events from the dispatch path.
+        "paths": [
+            "livekit_server_tpu/runtime",
+            "livekit_server_tpu/service",
+        ],
+        # method tails that are bounded non-allocating recorders — their
+        # ARGUMENTS must not allocate either.
+        "emit_calls": [
+            "record_tick", "set_shard", "emit",
+            "observe_batch", "observe_express",
+        ],
+        # identifier substrings that mark a decimating `if` — inside such
+        # a branch the allocation is paid 1-in-K times, which is fine.
+        "sample_guards": ["sample", "sampled", "mask", "stamped"],
+    },
 }
 
 
@@ -306,7 +324,15 @@ def run_all(
     project: Project, config: Config, rules: list[str] | None = None
 ) -> list[Finding]:
     """Run the analyzers, apply per-line/file suppressions, sort."""
-    from livekit_server_tpu.analysis import gc01, gc02, gc03, gc04, gc05, gc06
+    from livekit_server_tpu.analysis import (
+        gc01,
+        gc02,
+        gc03,
+        gc04,
+        gc05,
+        gc06,
+        gc07,
+    )
 
     impls: dict[str, Callable[[Project, dict], list[Finding]]] = {
         "GC01": gc01.run,
@@ -315,6 +341,7 @@ def run_all(
         "GC04": gc04.run,
         "GC05": gc05.run,
         "GC06": gc06.run,
+        "GC07": gc07.run,
     }
     findings: list[Finding] = []
     for f in project.files:

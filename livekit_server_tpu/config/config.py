@@ -362,6 +362,19 @@ class WebHookConfig:
 
 
 @dataclass
+class TraceConfig:
+    """Flight-recorder tracing plane (runtime/trace.py): per-tick span
+    ring, sampled wire-latency attribution, and the per-room black-box
+    event recorder. Always-on by design — the defaults are sized for a
+    bounded (<2%) tick-time overhead."""
+
+    enabled: bool = True
+    ring_ticks: int = 512        # tick-span ring capacity (/debug/trace window)
+    sample_every: int = 64       # 1-in-K deterministic packet latency sample
+    blackbox_events: int = 64    # per-room black-box ring length
+
+
+@dataclass
 class Config:
     """Top-level server config (pkg/config/config.go Config)."""
 
@@ -386,6 +399,7 @@ class Config:
     faults: FaultInjectConfig = field(default_factory=FaultInjectConfig)
     integrity: IntegrityConfig = field(default_factory=IntegrityConfig)
     migration: MigrationConfig = field(default_factory=MigrationConfig)
+    trace: TraceConfig = field(default_factory=TraceConfig)
 
 
 _SCALARS = (int, float, str, bool)
@@ -587,3 +601,7 @@ def _validate(cfg: Config) -> None:
                  "bridge_chunk"):
         if getattr(mig, name) <= 0:
             raise ConfigError(f"migration.{name} must be positive")
+    tr = cfg.trace
+    for name in ("ring_ticks", "sample_every", "blackbox_events"):
+        if getattr(tr, name) <= 0:
+            raise ConfigError(f"trace.{name} must be positive")

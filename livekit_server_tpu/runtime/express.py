@@ -252,8 +252,21 @@ class ExpressLane:
         # Re-promotion after a demotion waits for a FRESH mirror (posted
         # after at least one more device step) so currents re-seed.
         self.mirror_ok[dropped] = False
-        self.stats["promotes"] += int(newly.sum())
-        self.stats["demotes"] += int(dropped.sum())
+        n_pro = int(newly.sum())
+        n_dem = int(dropped.sum())
+        self.stats["promotes"] += n_pro
+        self.stats["demotes"] += n_dem
+        if n_pro or n_dem:
+            # Tier transitions are rare (churn events) — black-box them
+            # per room. The no-transition tick stays allocation-free.
+            bb = getattr(rt, "blackbox", None)
+            if bb is not None:
+                from livekit_server_tpu.runtime.trace import EV_DEMOTE, EV_PROMOTE
+
+                for r in np.nonzero(newly)[0]:
+                    bb.emit(int(r), EV_PROMOTE)
+                for r in np.nonzero(dropped)[0]:
+                    bb.emit(int(r), EV_DEMOTE)
         self.active = new_active
         self._active_any = bool(new_active.any())
         sub_ok = eff.subscribed.any(axis=1)  # [R, S]

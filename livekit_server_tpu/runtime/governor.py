@@ -197,6 +197,12 @@ class OverloadGovernor:
         self.transitions.append(
             {"tick": self.ticks, "from": old, "to": new, "reason": reason}
         )
+        bb = getattr(rt, "blackbox", None)
+        if bb is not None:
+            # Node-lane black-box event (cold path: level transitions).
+            from livekit_server_tpu.runtime.trace import EV_GOV_LEVEL
+
+            bb.emit(bb.NODE, EV_GOV_LEVEL, float(old), float(new))
         log = self.log.warn if new > old else self.log.info
         log("overload governor level change", level=new, was=old, reason=reason)
 

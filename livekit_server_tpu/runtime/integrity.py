@@ -255,10 +255,16 @@ class IntegrityMonitor:
         )
         # Quarantine first — even when escalating, flagged rooms stop
         # fanning out corrupt media the same tick.
+        from livekit_server_tpu.runtime.trace import EV_QUARANTINE
+
+        bb = getattr(rt, "blackbox", None)
         for row in flagged:
             if row not in self.quarantined:
                 self.quarantined.add(row)
                 self.rows_quarantined += 1
+                if bb is not None:
+                    bb.emit(row, EV_QUARANTINE, float(tick_index))
+                    bb.dump_to(row, "quarantine")
         rt._ctrl_dirty = True
         if self._restore_pending:
             # A full restore is already in flight; what we just audited
@@ -287,6 +293,12 @@ class IntegrityMonitor:
         self._escalated_epoch = rt.run_epoch
         self.escalations += 1
         self._pending_repair.clear()
+        bb = getattr(rt, "blackbox", None)
+        if bb is not None:
+            from livekit_server_tpu.runtime.trace import EV_ESCALATE
+
+            bb.emit(bb.NODE, EV_ESCALATE, float(self.escalations))
+            bb.dump_to(bb.NODE, "integrity_escalation")
         self.log.error("integrity escalation: full restart requested", reason=reason)
         if self.escalate_cb is not None:
             self.escalate_cb(reason)
@@ -321,6 +333,12 @@ class IntegrityMonitor:
             except (ChecksumError, ValueError, KeyError, IndexError) as e:
                 self.repair_failures += 1
                 self.log.warn("row repair rejected", room=row, error=str(e))
+                bb = getattr(rt, "blackbox", None)
+                if bb is not None:
+                    from livekit_server_tpu.runtime.trace import EV_REPAIR_FAIL
+
+                    bb.emit(row, EV_REPAIR_FAIL)
+                    bb.dump_to(row, "repair_failed")
                 self._escalate(f"row repair failed for room {row}: {e}")
                 return
             self.quarantined.discard(row)
@@ -331,6 +349,12 @@ class IntegrityMonitor:
             rt._ctrl_dirty = True
             self.rows_repaired += 1
             self.log.info("room row repaired from checkpoint", room=row)
+            bb = getattr(rt, "blackbox", None)
+            if bb is not None:
+                from livekit_server_tpu.runtime.trace import EV_REPAIR_OK
+
+                bb.emit(row, EV_REPAIR_OK)
+                bb.dump_to(row, "repair_ok")
 
     # -- restore hooks ---------------------------------------------------
 

@@ -77,6 +77,12 @@ class EgressBatch:
     tl0: np.ndarray       # int32
     keyidx: np.ndarray    # int32
     payloads: Any         # PayloadSlab
+    # Attribution stamps (runtime/trace.py LatencyAttribution): when the
+    # owning tick was dispatched to the device and when its step
+    # committed — the stage boundaries the sampled wire-latency
+    # decomposition splits on. 0.0 = unstamped (tracing off / tests).
+    t_dispatch: float = 0.0
+    t_device_end: float = 0.0
 
     def __len__(self) -> int:
         return len(self.rooms)
@@ -286,6 +292,14 @@ class StagedTick:
     express_words: Any = None
     express_log: Any = None
     edge_over_us: float = 0.0  # wake overshoot past the dispatch edge
+    # Span start stamps + extra durations for the trace ring
+    # (runtime/trace.py): staging start, the express retier's slice of
+    # it, the ctrl-upload window, and the device dispatch time.
+    stage_t0: float = 0.0
+    retier_s: float = 0.0
+    upload_t0: float = 0.0
+    upload_s: float = 0.0
+    device_t0: float = 0.0
 
 
 class PlaneRuntime:
@@ -304,6 +318,10 @@ class PlaneRuntime:
         egress_multicast: bool = True,
         express_max_subs: int = 0,
         express_max_rooms: int = 16,
+        trace_enabled: bool = True,
+        trace_ring_ticks: int = 512,
+        trace_sample_every: int = 64,
+        blackbox_events: int = 64,
     ):
         from livekit_server_tpu.ops import audio as audio_ops, bwe as bwe_ops
 
@@ -450,6 +468,20 @@ class PlaneRuntime:
         from concurrent.futures import ThreadPoolExecutor
 
         self._executor = ThreadPoolExecutor(max_workers=1, thread_name_prefix="plane")
+
+        # Flight-recorder tracing plane (runtime/trace.py): fixed ring of
+        # per-tick span records, the sampled wire-latency attribution
+        # stage decomposer, and the per-room black-box event recorder.
+        # trace/wire_stages are None when disabled; the black box is
+        # always on (cold-path emits only, bounded per-room rings).
+        from livekit_server_tpu.runtime import trace as trace_mod
+
+        self.trace = None
+        self.wire_stages = None
+        if trace_enabled:
+            self.trace = trace_mod.TickTraceRing(trace_ring_ticks)
+            self.wire_stages = trace_mod.LatencyAttribution(trace_sample_every)
+        self.blackbox = trace_mod.BlackBox(R, blackbox_events)
 
     # -- control-plane mutation API (host mirrors; applied at tick edge) --
     def set_track(self, room: int, track: int, *, published: bool, is_video: bool,
@@ -628,6 +660,7 @@ class PlaneRuntime:
         donates — state the restart already restored."""
         epoch = self.run_epoch
         t0 = time.perf_counter()
+        st.device_t0 = t0
         if self.fault is not None:
             self.fault.maybe_stall()
         if epoch != self.run_epoch:
@@ -679,13 +712,16 @@ class PlaneRuntime:
         q_ticks = max(1, 1000 // self.tick_ms)
         roll = (idx + 1) % q_ticks == 0
         ex_rows = ex_words = ex_log = None
+        retier_s = 0.0
         if self.express is not None:
             # Tier boundary, in the same synchronous event-loop slice as
             # the drain (atomic w.r.t. arrivals and migration freezes):
             # close the ending window, re-tier, and take over the closing
             # window for freshly promoted rooms. Returns the rooms whose
             # fast-path subscriber bits this tick's fan-out must skip.
+            r0 = time.perf_counter()
             ex_rows, ex_words, ex_log = self.express.tick_boundary(self.ingest)
+            retier_s = time.perf_counter() - r0
         inp, payloads = self.ingest.drain(
             roll_quality=roll, tick_index=idx,
             reuse_fields=(self._mesh is None),
@@ -702,6 +738,8 @@ class PlaneRuntime:
         st = StagedTick(inp=inp, payloads=payloads, idx=idx, roll=roll,
                         packed=packed, express_rows=ex_rows,
                         express_words=ex_words, express_log=ex_log)
+        st.stage_t0 = t0
+        st.retier_s = retier_s
         st.stage_s = time.perf_counter() - t0
         return st
 
@@ -759,6 +797,12 @@ class PlaneRuntime:
             express=(st.express_rows, st.express_words, st.express_log),
         )
         fanout_s = time.perf_counter() - c0
+        # Attribution stamps for the wire-latency stage decomposer: the
+        # egress consumer (udp.send_egress_batch's do_send — possibly on
+        # a pacer thread) reads these off the batch, so they must land
+        # before the callbacks run.
+        result.egress_batch.t_dispatch = st.device_t0
+        result.egress_batch.t_device_end = st.device_t0 + st.device_s
         result.tick_s = st.stage_s + st.device_s + fanout_s
         result.quality_window_closed = st.roll
         self.recent_tick_s.append(round(result.tick_s, 5))
@@ -768,10 +812,12 @@ class PlaneRuntime:
         self.stats["stage_s"] += st.stage_s
         self.stats["device_s"] += st.device_s
         self.stats["fanout_s"] += fanout_s
+        s0 = time.perf_counter()
         for cb in self._on_tick:
             r = cb(result)
             if asyncio.iscoroutine(r):
                 await r
+        send_s = time.perf_counter() - s0
         # Egress leaves inside the callbacks (wire tx), so the deadline
         # check runs after them: a tick is late when its sends left after
         # the end of the window its pipeline depth entitles it to.
@@ -797,6 +843,26 @@ class PlaneRuntime:
                 s["ms"] for s in ep.last_send.get("shards", [])
             ]
         self.recent_ticks.append(tick_rec)
+        if self.trace is not None:
+            # Trace ring: scalar stores into preallocated columns only
+            # (GC07 — no allocation on the hot path).
+            slot = self.trace.record_tick(
+                st.idx, st.edge, st.stage_t0, st.stage_s, st.retier_s,
+                st.upload_t0, st.upload_s, st.device_t0, st.device_s,
+                c0, fanout_s, send_s, st.edge_over_us, st.depth, late,
+            )
+            if ep.last_send:
+                shards = ep.last_send.get("shards", ())
+                munge_ms = ep.last_munge.get("ms", ()) if ep.last_munge else ()
+                for i in range(len(shards)):
+                    self.trace.set_shard(
+                        slot, i,
+                        munge_ms[i] if i < len(munge_ms) else 0.0,
+                        shards[i]["ms"],
+                    )
+        # Tick-edge calibration gauges (telemetry scrapes these).
+        self.stats["sleep_bias_us"] = round(max(self._sleep_bias, 0.0) * 1e6, 1)
+        self.stats["edge_overshoot_us"] = round(self._edge_overshoot_us, 1)
         if self.governor is not None:
             # Close the overload loop on the finished tick's verdict.
             self.governor.on_tick(self.recent_ticks[-1])
@@ -831,7 +897,9 @@ class PlaneRuntime:
         st = self._stage_host()
         self._schedule_probe(st)
         async with self.state_lock:
+            st.upload_t0 = time.perf_counter()
             self._upload_ctrl()
+            st.upload_s = time.perf_counter() - st.upload_t0
             out = await loop.run_in_executor(self._executor, self._device_step, st)
         if out is None:
             raise asyncio.CancelledError("device step abandoned by restart")
@@ -858,6 +926,7 @@ class PlaneRuntime:
             hs._budget_refill_ms[room, sub] = now_ms
         rtt = max(1, int(self.ingest.rtt_ms[room, sub]))
         K = self.dims.pkts
+        budget_before = int(hs.budget[room, sub])
         replays: list[EgressPacket] = []
         for sn in sns:
             if len(replays) >= hs.BURST_CAP or hs.budget[room, sub] <= 0:
@@ -897,6 +966,14 @@ class PlaneRuntime:
             )
         if replays:
             self.stats["rtx_packets"] = self.stats.get("rtx_packets", 0) + len(replays)
+        if budget_before > 0 and int(hs.budget[room, sub]) <= 0:
+            # Replay budget newly exhausted: a NACK storm on this
+            # (room, sub) pair. Cold path (loss events only) — black-box
+            # the event and dump the room's recorder for the post-mortem.
+            from livekit_server_tpu.runtime.trace import EV_NACK_STORM
+
+            self.blackbox.emit(room, EV_NACK_STORM, float(sub), float(len(sns)))
+            self.blackbox.dump_to(room, "nack_storm")
         return replays
 
     def _assemble_padding(self, inp) -> list[EgressPacket]:
@@ -1172,7 +1249,9 @@ class PlaneRuntime:
                     np.asarray(cur.inp.pad_num)[list(self.ingest.frozen_rows)] = 0
                 await self.state_lock.acquire()
                 try:
+                    cur.upload_t0 = time.perf_counter()
                     self._upload_ctrl()
+                    cur.upload_s = time.perf_counter() - cur.upload_t0
                     fut = loop.run_in_executor(self._executor, self._device_step, cur)
                     if pending is not None:
                         pending_task = self._complete_task = asyncio.ensure_future(

@@ -268,6 +268,12 @@ class PlaneSupervisor:
         rt.start()
         self.restarts += 1
         self.restart_causes[cause] = self.restart_causes.get(cause, 0) + 1
+        bb = getattr(rt, "blackbox", None)
+        if bb is not None:
+            from livekit_server_tpu.runtime.trace import EV_RESTART
+
+            bb.emit(bb.NODE, EV_RESTART, float(self._attempts))
+            bb.dump_to(bb.NODE, f"plane_restart:{cause}")
         if self.telemetry is not None:
             self.telemetry.add("livekit_plane_restarts_total")
             self.telemetry.add(

@@ -525,6 +525,10 @@ class UDPMediaTransport(asyncio.DatagramProtocol):
         # munge+seal cost) — kept separate or the batched tail would bury
         # the express p99 (and vice versa).
         self.fwd_latency_express = ForwardLatencyProbe()
+        # Sampled wire-latency stage decomposer (runtime/trace.py
+        # LatencyAttribution); attached by the server/bench alongside the
+        # egress plane. None = no per-stage attribution.
+        self.wire_stages = None
         # Express lane (runtime/express.py): attached by the room manager
         # when plane.express_max_subs > 0; rx_batch hands each receive
         # batch to it right after staging.
@@ -2000,7 +2004,17 @@ class UDPMediaTransport(asyncio.DatagramProtocol):
                     marker=bool(cols.marker[j]),
                     t_arr=float(cols.t_arr[j]),
                 ))
+            _t_send0 = time.perf_counter()
             self.send_egress(pkts)
+            send_now = time.perf_counter()
+            if self._egress_plane is not None:
+                self._egress_plane.record_express(
+                    len(pkts), int((send_now - _t_send0) * 1e9)
+                )
+            if self.wire_stages is not None:
+                self.wire_stages.observe_express(
+                    cols.sn[idx], cols.t_arr[idx], send_now
+                )
             return len(pkts)
         # Destination-major stable order (GSO runs in the native sender);
         # entries arrive in k-order per stream, the stable sort keeps it.
@@ -2070,6 +2084,7 @@ class UDPMediaTransport(asyncio.DatagramProtocol):
                 ext_off = np.zeros(len(idx), np.int64)
                 ext_len = np.where(is_vid, len(sec), 0).astype(np.int32)
         fd = self.transport.get_extra_info("socket").fileno()
+        _t_send0 = time.perf_counter()
         _, _, _, sent, _ = native_egress.send_express(
             fd=fd, slab=cols.slab,
             pay_off=cols.pay_off[idx], pay_len=cols.pay_len[idx],
@@ -2092,10 +2107,19 @@ class UDPMediaTransport(asyncio.DatagramProtocol):
             self.stats["tx_drop"] = (
                 self.stats.get("tx_drop", 0) + len(idx) - sent
             )
+        send_now = time.perf_counter()
+        if self._egress_plane is not None:
+            # Express sends count toward the host-egress pps/wall stats
+            # (ISSUE-12 satellite: today only the batched path reports).
+            self._egress_plane.record_express(
+                int(sent), int((send_now - _t_send0) * 1e9)
+            )
         t_arr = cols.t_arr[idx]
         stamped = t_arr[t_arr > 0.0]
         if stamped.size:
-            self.fwd_latency_express.observe(time.perf_counter() - stamped)
+            self.fwd_latency_express.observe(send_now - stamped)
+        if self.wire_stages is not None:
+            self.wire_stages.observe_express(cols.sn[idx], t_arr, send_now)
         # SR/tx bookkeeping (add.at — express batches are tiny relative
         # to the plane, bincount temporaries never pay off here).
         S = self.ingest.dims.subs
@@ -2305,7 +2329,10 @@ class UDPMediaTransport(asyncio.DatagramProtocol):
                 if batch.payloads.t_arr is not None else None
             )
 
-            def do_send(args=send_args, n_entries=n_entries, t_arr=t_arr):
+            def do_send(args=send_args, n_entries=n_entries, t_arr=t_arr,
+                        sn_s=batch.sn[idx], ws=self.wire_stages,
+                        t_disp=getattr(batch, "t_dispatch", 0.0),
+                        t_dev=getattr(batch, "t_device_end", 0.0)):
                 if use_plane:
                     (_, _, _, sent, sh_sent, sh_built,
                      sh_ns) = native_egress.send_sharded(**args)
@@ -2323,9 +2350,15 @@ class UDPMediaTransport(asyncio.DatagramProtocol):
                     )
                 if t_arr is not None:
                     # Wire-out stamp: the kernel has every datagram now.
+                    send_now = time.perf_counter()
                     stamped = t_arr[t_arr > 0.0]
                     if stamped.size:
-                        self.fwd_latency.observe(time.perf_counter() - stamped)
+                        self.fwd_latency.observe(send_now - stamped)
+                    if ws is not None:
+                        # Sampled per-stage decomposition: arrival →
+                        # dispatch (staging+queue wait), dispatch →
+                        # device end, device end → wire.
+                        ws.observe_batch(sn_s, t_arr, t_disp, t_dev, send_now)
 
             if pace_us > 0:
                 self._pace_pending = self._pace_pool.submit(do_send)

@@ -348,6 +348,11 @@ class MigrationOrchestrator:
         rt.ingest.freeze_sinks[row] = bridge.capture
         for pkt in rt.ingest.extract_row(row):
             bridge.capture(pkt)
+        bb = getattr(rt, "blackbox", None)
+        if bb is not None:
+            from livekit_server_tpu.runtime.trace import EV_MIG_FREEZE
+
+            bb.emit(row, EV_MIG_FREEZE)
         epoch = 0
         try:
             async with rt.state_lock:      # vs. the donated device step
@@ -472,6 +477,11 @@ class MigrationOrchestrator:
         room.close(pm.DisconnectReason.MIGRATION)
         mgr._update_node_stats()
         self.stats["commits"] += 1
+        bb = getattr(mgr.runtime, "blackbox", None)
+        if bb is not None:
+            from livekit_server_tpu.runtime.trace import EV_MIG_COMMIT
+
+            bb.emit(row, EV_MIG_COMMIT, float(epoch))
         self.log.info(
             "room migrated", room=name, target=target[:12], epoch=epoch,
             bridged=bridge.captured,
@@ -529,6 +539,12 @@ class MigrationOrchestrator:
             "migration rolled back; room keeps serving",
             room=name, target=target[:12], reason=reason, replayed=replayed,
         )
+        bb = getattr(mgr.runtime, "blackbox", None)
+        if bb is not None:
+            from livekit_server_tpu.runtime.trace import EV_MIG_ABORT
+
+            bb.emit(row, EV_MIG_ABORT, float(epoch))
+            bb.dump_to(row, f"migration_abort:{reason[:40]}")
 
     async def _replay_unfreeze(
         self, row: int, head: list, bridge: FreezeBridge | None

@@ -80,6 +80,10 @@ class RoomManager:
             egress_multicast=config.egress.multicast_seal,
             express_max_subs=p.express_max_subs,
             express_max_rooms=p.express_max_rooms,
+            trace_enabled=config.trace.enabled,
+            trace_ring_ticks=config.trace.ring_ticks,
+            trace_sample_every=config.trace.sample_every,
+            blackbox_events=config.trace.blackbox_events,
         )
         self.rooms: dict[str, Room] = {}
         self._row_to_room: dict[int, Room] = {}
@@ -96,6 +100,9 @@ class RoomManager:
         from livekit_server_tpu.utils.logger import Logger
 
         self.log = Logger()  # server start replaces with a node-scoped one
+        # Black-box dumps go to the manager's log (re-pointed alongside
+        # self.log when the server installs the node-scoped logger).
+        self.runtime.blackbox.log = self.log
         self.agents = None  # AgentService; room/publisher job dispatch
         self.runtime.on_tick(self._dispatch_tick)
         self._reaper_task: asyncio.Task | None = None
@@ -221,6 +228,9 @@ class RoomManager:
             await self.router.set_node_for_room(name, self.router.local_node.node_id)
         self._create_locks.pop(name, None)
         self._update_node_stats()
+        from livekit_server_tpu.runtime.trace import EV_ROOM_OPEN
+
+        self.runtime.blackbox.emit(room.slots.row, EV_ROOM_OPEN)
         self.log.info("room started", room=name, row=room.slots.row)
         self._notify("room_started", room=room.info.to_dict())
         if self.agents is not None:
@@ -241,6 +251,9 @@ class RoomManager:
         room = self.rooms.pop(name, None)
         if room is not None:
             self._row_to_room.pop(room.slots.row, None)
+            from livekit_server_tpu.runtime.trace import EV_ROOM_CLOSE
+
+            self.runtime.blackbox.emit(room.slots.row, EV_ROOM_CLOSE)
             room.close(pm.DisconnectReason.ROOM_DELETED)
             self.log.info("room finished", room=name)
             self._notify("room_finished", room=room.info.to_dict())
@@ -342,6 +355,11 @@ class RoomManager:
         if participant.client_config is not None:
             join["client_configuration"] = participant.client_config.to_dict()
         participant.send("join", join)
+        from livekit_server_tpu.runtime.trace import EV_JOIN
+
+        self.runtime.blackbox.emit(
+            room.slots.row, EV_JOIN, float(participant.sub_col)
+        )
         self.log.info("participant joined", room=room_name, participant=identity)
         await self.store.store_participant(room_name, participant.to_info())
         self._update_node_stats()
@@ -382,6 +400,11 @@ class RoomManager:
             if not stale:
                 if not participant.disconnected.is_set():
                     room.remove_participant(participant, pm.DisconnectReason.SIGNAL_CLOSE)
+                from livekit_server_tpu.runtime.trace import EV_LEAVE
+
+                self.runtime.blackbox.emit(
+                    room.slots.row, EV_LEAVE, float(participant.sub_col)
+                )
                 await self.store.delete_participant(room.name, participant.identity)
                 self.log.info(
                     "participant left", room=room.name,
@@ -777,6 +800,12 @@ class RoomManager:
             if self.integrity is not None:
                 self.telemetry.observe_integrity(self.integrity_stats())
             self.telemetry.observe_egress(self.runtime.egress_plane.observe())
+            if self.runtime.wire_stages is not None:
+                # Per-stage wire-latency samples since the last tick →
+                # stage histograms + livekit_forward_latency_ms.
+                self.telemetry.observe_wire_stages(
+                    self.runtime.wire_stages.drain()
+                )
 
     def integrity_stats(self) -> dict:
         """IntegrityMonitor stats + the checkpoint-generation fallback

@@ -80,6 +80,8 @@ class LivekitServer:
         self.app.router.add_get("/debug/integrity", self.debug_integrity)
         self.app.router.add_get("/debug/egress", self.debug_egress)
         self.app.router.add_get("/debug/migration", self.debug_migration)
+        self.app.router.add_get("/debug/trace", self.debug_trace)
+        self.app.router.add_get("/debug/blackbox/{room}", self.debug_blackbox)
         self._runner: web.AppRunner | None = None
         self._sites: list[web.TCPSite] = []
         self._stats_task: asyncio.Task | None = None
@@ -187,6 +189,12 @@ class LivekitServer:
         body["sleep_bias_us"] = round(
             max(getattr(rt, "_sleep_bias", 0.0), 0.0) * 1e6, 1
         )
+        body["edge_overshoot_us"] = round(
+            getattr(rt, "_edge_overshoot_us", 0.0), 1
+        )
+        if rt.wire_stages is not None:
+            # Per-stage wire-latency decomposition (sampled attribution).
+            body["wire_stages"] = rt.wire_stages.summary()
         udp = getattr(self.room_manager, "udp", None)
         if udp is not None and getattr(udp, "fwd_latency", None) is not None:
             # Measured wall-clock packet-in→wire-out latency (includes
@@ -200,6 +208,69 @@ class LivekitServer:
                     udp.fwd_latency_express.summary()
                 )
         return web.json_response(body)
+
+    async def debug_trace(self, request: web.Request) -> web.Response:
+        """Chrome/Perfetto trace export of the tick-span ring
+        (?ticks=N, newest N ticks) plus the sampled wire-latency stage
+        decomposition as a sidecar. Save the body to a file and load it
+        in ui.perfetto.dev or chrome://tracing."""
+        rt = self.room_manager.runtime
+        if rt.trace is None:
+            return web.json_response(
+                {"error": "tracing disabled (trace.enabled: false)"},
+                status=404,
+            )
+        try:
+            n = int(request.query.get("ticks", "120"))
+        except ValueError:
+            return web.json_response(
+                {"error": "ticks must be an integer"}, status=400
+            )
+        from livekit_server_tpu.telemetry import trace_export
+
+        body: dict = {
+            "traceEvents": trace_export.to_chrome(
+                rt.trace.snapshot(n), rt.tick_ms
+            ),
+            "displayTimeUnit": "ms",
+        }
+        if rt.wire_stages is not None:
+            # Perfetto ignores unknown top-level keys; curl consumers get
+            # the stage decomposition without a second request.
+            body["otherData"] = {"wire_stages": rt.wire_stages.summary()}
+        return web.json_response(body)
+
+    async def debug_blackbox(self, request: web.Request) -> web.Response:
+        """One room's black-box flight-recorder lane ({room} is a room
+        name, a row index, or `node` for the node lane), plus the
+        retained automatic dumps."""
+        rt = self.room_manager.runtime
+        bb = rt.blackbox
+        key = request.match_info["room"]
+        if key == "node":
+            row = bb.NODE
+        else:
+            room = self.room_manager.rooms.get(key)
+            if room is not None:
+                row = room.slots.row
+            else:
+                try:
+                    row = int(key)
+                except ValueError:
+                    return web.json_response(
+                        {"error": f"unknown room {key!r}"}, status=404
+                    )
+                if not 0 <= row < rt.dims.rooms:
+                    return web.json_response(
+                        {"error": f"row {row} out of range"}, status=404
+                    )
+        return web.json_response({
+            "room": key,
+            "row": row,
+            "events": bb.dump(row),
+            "dumps_total": bb.dumps,
+            "last_dumps": list(bb.last_dumps),
+        })
 
     async def metrics(self, request: web.Request) -> web.Response:
         # Recovery-machinery gauges sampled at scrape time: bus transport
@@ -365,6 +436,11 @@ class LivekitServer:
                 # transport routes tick egress through it from here on.
                 self.room_manager.udp.attach_egress_plane(
                     self.room_manager.runtime.egress_plane
+                )
+                # Sampled wire-latency attribution: the transport observes
+                # per-stage stamps on each send (runtime/trace.py).
+                self.room_manager.udp.wire_stages = (
+                    self.room_manager.runtime.wire_stages
                 )
                 # Express lane (plane.express_max_subs > 0): interactive
                 # rooms forward on packet arrival through this transport

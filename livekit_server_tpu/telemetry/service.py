@@ -55,14 +55,22 @@ class Histogram:
         idx = np.searchsorted(self.buckets, v, side="left")
         self.counts += np.bincount(idx, minlength=len(self.buckets) + 1)
 
-    def render(self, name: str, lines: list[str]) -> None:
+    def render(self, name: str, lines: list[str],
+               labels: dict[str, str] | None = None) -> None:
+        # Extra labels (e.g. stage="device") precede the cumulative `le`
+        # label on every series of the family.
+        lbl = (
+            "".join(f'{k}="{v}",' for k, v in sorted(labels.items()))
+            if labels else ""
+        )
+        sfx = f"{{{lbl[:-1]}}}" if lbl else ""
         cum = 0
         for b, c in zip(self.buckets, self.counts[:-1]):
             cum += int(c)
-            lines.append(f'{name}_bucket{{le="{b:g}"}} {cum}')
-        lines.append(f'{name}_bucket{{le="+Inf"}} {self.count}')
-        lines.append(f"{name}_sum {self.sum:g}")
-        lines.append(f"{name}_count {self.count}")
+            lines.append(f'{name}_bucket{{{lbl}le="{b:g}"}} {cum}')
+        lines.append(f'{name}_bucket{{{lbl}le="+Inf"}} {self.count}')
+        lines.append(f"{name}_sum{sfx} {self.sum:g}")
+        lines.append(f"{name}_count{sfx} {self.count}")
 
 
 # Bucket ladders (prometheus/packets.go + connectionquality histograms).
@@ -71,6 +79,23 @@ _HIST_SPECS = {
     "livekit_track_jitter_ms": (0.5, 1, 2, 5, 10, 20, 50, 100, 200),
     "livekit_track_bitrate_kbps": (16, 64, 150, 500, 1000, 2000, 4000, 8000),
     "livekit_forward_latency_ms": (1, 2, 5, 10, 20, 50, 100, 250, 1000),
+    "livekit_tick_duration_ms": (0.5, 1, 2, 5, 10, 20, 50, 100, 250),
+}
+
+# Per-stage wire-latency decomposition (runtime/trace.py
+# LatencyAttribution): one histogram per stage label.
+_STAGE_BUCKETS = (0.5, 1, 2, 5, 10, 20, 50, 100, 250)
+
+# One-line HELP strings per metric family (exposition-format HELP/TYPE
+# headers; families not listed fall back to the family name itself).
+_HELP = {
+    "livekit_forward_latency_ms": "Sampled packet arrival-to-wire latency (both egress tiers)",
+    "livekit_wire_latency_stage_ms": "Sampled wire latency decomposed by pipeline stage",
+    "livekit_tick_duration_ms": "Media-plane tick work time (stage+device+fanout)",
+    "livekit_host_egress_pps": "Host egress datagrams/s EMA over both tiers",
+    "livekit_plane_sleep_bias_us": "Calibrated tick-edge coarse-sleep overshoot margin",
+    "livekit_plane_edge_overshoot_us": "Last tick-edge wake overshoot",
+    "livekit_events_total": "Lifecycle events by type",
 }
 
 
@@ -80,6 +105,9 @@ class TelemetryService:
         self.counters: dict[str, float] = defaultdict(float)
         self.gauges: dict[str, float] = {}
         self.histograms = {k: Histogram(v) for k, v in _HIST_SPECS.items()}
+        # Stage-labelled wire-latency histograms (one per stage key fed
+        # by observe_wire_stages); rendered as one labelled family.
+        self.stage_hists: dict[str, Histogram] = {}
         self.events: list[dict[str, Any]] = []  # ring of recent events
         # Per-track analytics records (~1/s per published track — the
         # statsworker.go → analytics stream seat; ring-buffered, served at
@@ -118,6 +146,15 @@ class TelemetryService:
         for k in ("pipeline_stalls", "ctrl_full_uploads", "ctrl_delta_uploads",
                   "ctrl_delta_rows", "ctrl_upload_bytes"):
             self.set_gauge(f"livekit_plane_{k}_total", stats.get(k, 0))
+        # Tick-edge calibration: measured coarse-sleep bias + last wake
+        # overshoot (plane_runtime._sleep_until / _calibrate_sleep).
+        self.set_gauge(
+            "livekit_plane_sleep_bias_us", stats.get("sleep_bias_us", 0.0)
+        )
+        self.set_gauge(
+            "livekit_plane_edge_overshoot_us",
+            stats.get("edge_overshoot_us", 0.0),
+        )
 
     def observe_overload(self, snap: dict[str, Any]) -> None:
         """Overload-governor state (runtime/governor.py stats_dict):
@@ -171,7 +208,8 @@ class TelemetryService:
         volumes, and per-shard sent/busy breakdowns."""
         self.set_gauge("livekit_host_egress_pps", snap.get("host_egress_pps", 0.0))
         self.set_gauge("livekit_egress_shards", snap.get("shards", 0))
-        for k in ("entries", "grouped_entries", "datagrams"):
+        for k in ("entries", "grouped_entries", "datagrams",
+                  "express_datagrams"):
             self.set_gauge(f"livekit_egress_{k}_total", snap.get(k, 0))
         self.set_gauge(
             "livekit_egress_send_ms_total", snap.get("send_ms_total", 0.0)
@@ -207,7 +245,26 @@ class TelemetryService:
                 self.set_gauge(f"livekit_media_{k}_total", stats[k])
 
     def observe_tick_latency(self, tick_s: float) -> None:
-        self.histograms["livekit_forward_latency_ms"].observe(tick_s * 1000.0)
+        # Tick work time gets its own family now;
+        # livekit_forward_latency_ms is fed by the attribution sampler
+        # (observe_wire_stages) with true arrival→wire packet latencies.
+        self.histograms["livekit_tick_duration_ms"].observe(tick_s * 1000.0)
+
+    def observe_wire_stages(self, drained: dict[str, Any]) -> None:
+        """Sampled per-stage wire-latency arrays (runtime/trace.py
+        LatencyAttribution.drain()) → stage histograms, with the end-to-
+        end samples also feeding livekit_forward_latency_ms ('total'
+        already covers BOTH tiers — the express observer pushes each
+        sample into 'express' and 'total')."""
+        for stage, vals in drained.items():
+            if not len(vals):
+                continue
+            h = self.stage_hists.get(stage)
+            if h is None:
+                h = self.stage_hists[stage] = Histogram(_STAGE_BUCKETS)
+            h.observe(vals)
+            if stage == "total":
+                self.histograms["livekit_forward_latency_ms"].observe(vals)
 
     def observe_tracks(self, loss_pct, jitter_ms, bps) -> None:
         """Windowed per-track receive stats (device reductions) → quality
@@ -226,13 +283,32 @@ class TelemetryService:
             del self.track_stats[: len(self.track_stats) - 2000]
 
     def prometheus_text(self) -> str:
-        lines = []
+        lines: list[str] = []
+        seen: set[str] = set()
+
+        def header(key: str, mtype: str) -> None:
+            fam = key.split("{", 1)[0]
+            if fam in seen:
+                return
+            seen.add(fam)
+            lines.append(f"# HELP {fam} {_HELP.get(fam, fam)}")
+            lines.append(f"# TYPE {fam} {mtype}")
+
         for key, v in sorted(self.counters.items()):
+            header(key, "counter")
             lines.append(f"{key} {v:g}")
         for key, v in sorted(self.gauges.items()):
+            header(key, "gauge")
             lines.append(f"{key} {v:g}")
         for name, h in sorted(self.histograms.items()):
+            header(name, "histogram")
             h.render(name, lines)
+        if self.stage_hists:
+            header("livekit_wire_latency_stage_ms", "histogram")
+            for stage, h in sorted(self.stage_hists.items()):
+                h.render(
+                    "livekit_wire_latency_stage_ms", lines, {"stage": stage}
+                )
         return "\n".join(lines) + "\n"
 
     async def close(self) -> None:

@@ -397,6 +397,33 @@ async def test_metrics_and_debug():
             await alice.close()
 
 
+async def test_trace_and_blackbox_endpoints():
+    from livekit_server_tpu.telemetry import trace_export
+
+    async with running_server() as server:
+        async with aiohttp.ClientSession() as s:
+            alice = SignalClient(s, server.port)
+            await alice.connect("fr", "alice")
+            await asyncio.sleep(0.15)  # let a few ticks record
+            url = f"http://127.0.0.1:{server.port}"
+            async with s.get(f"{url}/debug/trace?ticks=32") as r:
+                doc = await r.json()
+                events = doc["traceEvents"]
+                assert events and trace_export.validate(events) == []
+                assert {e["name"] for e in events} >= {
+                    "stage_host", "device_step", "fan_out"
+                }
+            # room lane: the join emitted a lifecycle event
+            async with s.get(f"{url}/debug/blackbox/fr") as r:
+                bb = await r.json()
+                assert any(e["event"] == "join" for e in bb["events"])
+            async with s.get(f"{url}/debug/blackbox/node") as r:
+                assert (await r.json())["room"] == "node"
+            async with s.get(f"{url}/debug/blackbox/no-such-room") as r:
+                assert r.status == 404
+            await alice.close()
+
+
 async def test_udp_media_through_full_server():
     """Publisher announces a UDP track via signal, streams plain RTP to the
     node's UDP port; subscriber proves address ownership via the punch

@@ -22,6 +22,7 @@ from livekit_server_tpu.analysis import (
     gc04,
     gc05,
     gc06,
+    gc07,
     diff_baseline,
     load_project,
     run_all,
@@ -500,6 +501,98 @@ def test_gc06_method_dumps_not_flagged(tmp_path):
     """
     project = make_project(tmp_path, {"pkg/pub.py": src})
     assert gc06.run(project, cfg_for("gc06")) == []
+
+
+# -- GC07 emit hygiene ------------------------------------------------------
+
+GC07_FIXTURE = """\
+    class Recorder:
+        def tick(self, bb, trace, idx, sn):
+            bb.emit(3, 7, float(idx), 0.0)
+            bb.emit(3, 7, f"room-{idx}")
+            trace.record_tick(idx, {"late": 1})
+            trace.set_shard(0, 0, [m for m in (1,)])
+            bb.emit(3, 7, "r{}".format(idx))
+            self.log.warn(f"room {idx} slow")
+"""
+
+
+def test_gc07_fixture(tmp_path):
+    project = make_project(tmp_path, {"pkg/rec.py": GC07_FIXTURE})
+    findings = gc07.run(project, cfg_for("gc07"))
+    assert all(f.rule == "GC07" for f in findings)
+    # the log.warn f-string is untouched: warn is not an emit call
+    assert lines_of(findings, "GC07") == [4, 5, 6, 7]
+
+
+def test_gc07_names_the_construct(tmp_path):
+    project = make_project(tmp_path, {"pkg/rec.py": GC07_FIXTURE})
+    by_line = {f.line: f.message for f in gc07.run(project, cfg_for("gc07"))}
+    assert "f-string" in by_line[4]
+    assert "dict display" in by_line[5]
+    assert "comprehension" in by_line[6]
+    assert "str.format" in by_line[7]
+
+
+GC07_SAMPLED = """\
+    class Recorder:
+        def tick(self, bb, ws, idx, sn):
+            if sn % 64 == 0:
+                bb.emit(3, 7, f"room-{idx}")
+            if self.sampled(sn):
+                ws.observe_batch(sn, {"t": 0.0})
+            mask = sn > 0
+            if mask:
+                bb.emit(3, 7, f"mask-{idx}")
+            if idx > 3:
+                bb.emit(3, 7, f"hot-{idx}")
+"""
+
+
+def test_gc07_sampling_branch_exempts(tmp_path):
+    # modulo decimation, a *sample* name, and a *mask* name all exempt;
+    # an arbitrary non-sampling condition does not.
+    project = make_project(tmp_path, {"pkg/rec.py": GC07_SAMPLED})
+    assert lines_of(gc07.run(project, cfg_for("gc07")), "GC07") == [11]
+
+
+def test_gc07_str_mod_format_is_not_a_guard(tmp_path):
+    # "x-%d" % idx allocates in the args; the Mod there must not read as
+    # a decimation test on some enclosing if.
+    src = """\
+        def f(bb, idx):
+            if idx > 3:
+                bb.emit(3, 7, "x-%d" % idx)
+    """
+    project = make_project(tmp_path, {"pkg/rec.py": src})
+    findings = gc07.run(project, cfg_for("gc07"))
+    assert lines_of(findings, "GC07") == [3]
+    assert "%-format" in findings[0].message
+
+
+def test_gc07_inline_disable(tmp_path):
+    suppressed = GC07_FIXTURE.replace(
+        'bb.emit(3, 7, f"room-{idx}")',
+        'bb.emit(3, 7, f"room-{idx}")  # graftcheck: disable=GC07',
+    ).replace(
+        'trace.record_tick(idx, {"late": 1})',
+        'trace.record_tick(idx, {"late": 1})  # graftcheck: disable=GC07',
+    ).replace(
+        "trace.set_shard(0, 0, [m for m in (1,)])",
+        "trace.set_shard(0, 0, [m for m in (1,)])"
+        "  # graftcheck: disable=GC07",
+    ).replace(
+        'bb.emit(3, 7, "r{}".format(idx))',
+        'bb.emit(3, 7, "r{}".format(idx))  # graftcheck: disable=GC07',
+    )
+    project = make_project(tmp_path, {"pkg/rec.py": suppressed})
+    assert lines_of(run_all_pkg(project), "GC07") == []
+
+
+def test_gc07_emit_calls_configurable(tmp_path):
+    project = make_project(tmp_path, {"pkg/rec.py": GC07_FIXTURE})
+    cfg = cfg_for("gc07", emit_calls=["record_tick"])
+    assert lines_of(gc07.run(project, cfg), "GC07") == [5]
 
 
 # -- suppressions -----------------------------------------------------------

@@ -1,0 +1,152 @@
+"""Telemetry Histogram semantics + the prometheus exposition format:
+bucket-edge placement, the overflow slot, numpy/scalar equivalence,
+labelled rendering, and the HELP/TYPE headers."""
+
+from __future__ import annotations
+
+import numpy as np
+import pytest
+
+from livekit_server_tpu.config.config import Config
+from livekit_server_tpu.telemetry.service import (
+    _STAGE_BUCKETS,
+    Histogram,
+    TelemetryService,
+)
+
+
+def _rendered(h: Histogram, name: str = "m", labels=None) -> list[str]:
+    lines: list[str] = []
+    h.render(name, lines, labels)
+    return lines
+
+
+# -- bucket math ------------------------------------------------------------
+
+def test_bucket_edges_are_le_inclusive():
+    h = Histogram((1.0, 2.0, 5.0))
+    # a value exactly on an edge belongs to that bucket (le semantics)
+    h.observe([1.0, 2.0, 5.0])
+    assert h.counts.tolist() == [1, 1, 1, 0]
+    h.observe([0.5, 1.5, 4.99])
+    assert h.counts.tolist() == [2, 2, 2, 0]
+
+
+def test_overflow_slot_feeds_inf_only():
+    h = Histogram((1.0, 2.0))
+    h.observe([3.0, 100.0])
+    assert h.counts.tolist() == [0, 0, 2]
+    lines = _rendered(h)
+    assert 'm_bucket{le="1"} 0' in lines
+    assert 'm_bucket{le="2"} 0' in lines
+    assert 'm_bucket{le="+Inf"} 2' in lines
+
+
+def test_numpy_batch_equals_scalar_loop():
+    vals = [0.3, 1.0, 1.7, 2.0, 9.0, 0.0]
+    ha = Histogram((0.5, 1.0, 2.0, 5.0))
+    hb = Histogram((0.5, 1.0, 2.0, 5.0))
+    ha.observe(np.asarray(vals, np.float64))
+    for v in vals:
+        hb.observe(v)
+    assert ha.counts.tolist() == hb.counts.tolist()
+    assert ha.count == hb.count == len(vals)
+    assert ha.sum == pytest.approx(hb.sum) == pytest.approx(sum(vals))
+
+
+def test_empty_observe_is_a_noop():
+    h = Histogram((1.0,))
+    h.observe(np.array([]))
+    assert h.count == 0 and h.sum == 0.0 and h.counts.tolist() == [0, 0]
+
+
+# -- render format ----------------------------------------------------------
+
+def test_render_is_cumulative_and_complete():
+    h = Histogram((1.0, 2.0, 5.0))
+    h.observe([0.5, 1.5, 1.6, 3.0, 99.0])
+    lines = _rendered(h, "lat")
+    assert lines == [
+        'lat_bucket{le="1"} 1',
+        'lat_bucket{le="2"} 3',
+        'lat_bucket{le="5"} 4',
+        'lat_bucket{le="+Inf"} 5',
+        "lat_sum 105.6",
+        "lat_count 5",
+    ]
+
+
+def test_render_with_labels_precedes_le():
+    h = Histogram((1.0,))
+    h.observe([0.5, 7.0])
+    lines = _rendered(h, "lat", {"stage": "device"})
+    assert lines == [
+        'lat_bucket{stage="device",le="1"} 1',
+        'lat_bucket{stage="device",le="+Inf"} 2',
+        'lat_sum{stage="device"} 7.5',
+        'lat_count{stage="device"} 2',
+    ]
+
+
+# -- service wiring ---------------------------------------------------------
+
+def test_wire_stages_feed_forward_latency_from_total_only():
+    telem = TelemetryService(Config())
+    telem.observe_wire_stages({
+        "staging": np.array([1.0, 2.0], np.float32),
+        "total": np.array([5.0, 6.0, 7.0], np.float32),
+        "express": np.array([0.4], np.float32),
+    })
+    fwd = telem.histograms["livekit_forward_latency_ms"]
+    # express already rides 'total' (the sampler pushes both): counting it
+    # again would double-weight the express tier
+    assert fwd.count == 3
+    assert telem.stage_hists["staging"].count == 2
+    assert telem.stage_hists["express"].count == 1
+    assert telem.stage_hists["total"].buckets.tolist() == list(
+        _STAGE_BUCKETS
+    )
+    # empty drains create nothing
+    telem.observe_wire_stages({"device": np.array([], np.float32)})
+    assert "device" not in telem.stage_hists
+
+
+def test_prometheus_text_headers_once_per_family():
+    telem = TelemetryService(Config())
+    telem.add("livekit_events_total", 1, event="room_started")
+    telem.add("livekit_events_total", 1, event="room_finished")
+    telem.observe_wire_stages({
+        "total": np.array([3.0], np.float32),
+        "device": np.array([1.0], np.float32),
+    })
+    text = telem.prometheus_text()
+    lines = text.splitlines()
+    assert lines.index("# TYPE livekit_events_total counter") == (
+        lines.index("# HELP livekit_events_total Lifecycle events by type")
+        + 1
+    )
+    # the stage family renders once, with one series per stage label
+    assert text.count("# TYPE livekit_wire_latency_stage_ms histogram") == 1
+    assert 'livekit_wire_latency_stage_ms_count{stage="device"} 1' in lines
+    assert 'livekit_wire_latency_stage_ms_count{stage="total"} 1' in lines
+    assert text.count("# TYPE livekit_forward_latency_ms histogram") == 1
+    assert "livekit_forward_latency_ms_count 1" in lines
+    # every HELP/TYPE pair appears at most once per family
+    helps = [ln.split()[2] for ln in lines if ln.startswith("# HELP")]
+    assert len(helps) == len(set(helps))
+
+
+def test_plane_edge_gauges_exported():
+    telem = TelemetryService(Config())
+    telem.observe_plane({"sleep_bias_us": 57.3, "edge_overshoot_us": 12.5})
+    text = telem.prometheus_text()
+    assert "livekit_plane_sleep_bias_us 57.3" in text
+    assert "livekit_plane_edge_overshoot_us 12.5" in text
+    assert "# TYPE livekit_plane_sleep_bias_us gauge" in text
+
+
+def test_tick_duration_histogram_fed_in_ms():
+    telem = TelemetryService(Config())
+    telem.observe_tick_latency(0.0042)  # 4.2 ms
+    h = telem.histograms["livekit_tick_duration_ms"]
+    assert h.count == 1 and h.sum == pytest.approx(4.2)

@@ -1,0 +1,282 @@
+"""Flight-recorder plane: trace-ring bounds, attribution sampling math,
+black-box rings, and the Chrome trace-event export schema."""
+
+from __future__ import annotations
+
+import json
+
+import numpy as np
+import pytest
+
+from livekit_server_tpu.runtime.trace import (
+    EV_GOV_LEVEL,
+    EV_NACK_STORM,
+    EV_QUARANTINE,
+    MAX_SHARDS,
+    STAGES,
+    BlackBox,
+    LatencyAttribution,
+    TickTraceRing,
+)
+from livekit_server_tpu.telemetry import trace_export
+
+
+def _record(ring: TickTraceRing, idx: int, base: float = 100.0) -> int:
+    """One well-formed tick record at a synthetic perf_counter base."""
+    t = base + idx * 0.005
+    return ring.record_tick(
+        idx=idx, edge=t, stage_t0=t + 0.0001, stage_s=0.001,
+        retier_s=0.0002, upload_t0=t + 0.0012, upload_s=0.0003,
+        device_t0=t + 0.0016, device_s=0.002, fanout_t0=t + 0.0037,
+        fanout_s=0.0008, send_s=0.0004, wake_over_us=42.0, depth=1,
+        late=(idx % 7 == 0),
+    )
+
+
+# -- TickTraceRing ----------------------------------------------------------
+
+def test_ring_bounds_and_wraparound():
+    ring = TickTraceRing(cap=16)
+    for i in range(40):
+        _record(ring, i)
+    assert ring.recorded == 40
+    snap = ring.snapshot()
+    # only the newest cap records survive, oldest first
+    assert len(snap) == 16
+    assert [r["tick"] for r in snap] == list(range(24, 40))
+
+
+def test_ring_snapshot_newest_n():
+    ring = TickTraceRing(cap=32)
+    for i in range(10):
+        _record(ring, i)
+    snap = ring.snapshot(4)
+    assert [r["tick"] for r in snap] == [6, 7, 8, 9]
+    assert ring.snapshot(0) == []
+    # n beyond what's recorded clamps
+    assert len(ring.snapshot(99)) == 10
+
+
+def test_ring_minimum_capacity():
+    assert TickTraceRing(cap=1).cap >= 8
+
+
+def test_ring_record_fields_round_trip():
+    ring = TickTraceRing(cap=8)
+    _record(ring, 3)
+    r = ring.snapshot()[-1]
+    assert r["tick"] == 3 and r["depth"] == 1
+    assert r["stage_s"] == pytest.approx(0.001)
+    assert r["retier_s"] == pytest.approx(0.0002)
+    assert r["device_s"] == pytest.approx(0.002)
+    assert r["wake_over_us"] == pytest.approx(42.0)
+
+
+def test_ring_shard_lanes_bounded():
+    ring = TickTraceRing(cap=8)
+    slot = _record(ring, 0)
+    ring.set_shard(slot, 0, 0.5, 0.25)
+    ring.set_shard(slot, 2, 0.125, 0.0625)
+    ring.set_shard(slot, MAX_SHARDS + 3, 9.0, 9.0)  # out of range: dropped
+    r = ring.snapshot()[-1]
+    assert len(r["shard_munge_ms"]) == 3  # lanes 0..2, lane 1 zero-filled
+    assert r["shard_munge_ms"][0] == pytest.approx(0.5)
+    assert r["shard_send_ms"][2] == pytest.approx(0.0625)
+
+
+def test_ring_shard_reset_on_slot_reuse():
+    ring = TickTraceRing(cap=8)
+    slot = _record(ring, 0)
+    ring.set_shard(slot, 5, 1.0, 1.0)
+    for i in range(1, 9):  # wrap back onto slot 0
+        _record(ring, i)
+    r = ring.snapshot()[-1]
+    assert r["tick"] == 8 and r["shard_munge_ms"] == []
+
+
+# -- LatencyAttribution -----------------------------------------------------
+
+def test_attribution_deterministic_sampling():
+    la = LatencyAttribution(sample_every=8)
+    sn = np.arange(32)
+    ta = np.full(32, 99.0)
+    la.observe_batch(sn, ta, t_dispatch=99.004, t_device_end=99.006,
+                     now=99.010)
+    # exactly sn % 8 == 0 sampled: 4 of 32
+    assert int(la.total[STAGES.index("staging")]) == 4
+    assert int(la.total[STAGES.index("total")]) == 4
+
+
+def test_attribution_unstamped_and_predecomposition_batches_skipped():
+    la = LatencyAttribution(sample_every=1)
+    sn = np.arange(4)
+    la.observe_batch(sn, np.zeros(4), 1.0, 2.0, 3.0)   # t_arr == 0
+    la.observe_batch(sn, np.full(4, 99.0), 0.0, 0.0, 99.1)  # no stamps
+    assert not la.summary()
+
+
+def test_attribution_stage_split_sums_to_total():
+    la = LatencyAttribution(sample_every=1)
+    now = 200.0
+    sn = np.array([0, 1, 2])
+    ta = np.array([now - 0.010, now - 0.012, now - 0.008])
+    la.observe_batch(sn, ta, t_dispatch=now - 0.006,
+                     t_device_end=now - 0.004, now=now)
+    d = la.drain()
+    summed = d["staging"] + d["device"] + d["egress"]
+    assert np.allclose(summed, d["total"], atol=1e-3)
+    # late straggler (arrival after dispatch) clips staging at 0
+    la.observe_batch(np.array([3]), np.array([now - 0.001]),
+                     t_dispatch=now - 0.006, t_device_end=now - 0.004,
+                     now=now)
+    assert float(la.drain()["staging"][0]) == 0.0
+
+
+def test_attribution_express_feeds_total_too():
+    la = LatencyAttribution(sample_every=1)
+    la.observe_express(np.array([0, 1]), np.array([9.998, 9.997]), 10.0)
+    d = la.drain()
+    assert len(d["express"]) == 2 and len(d["total"]) == 2
+    assert "staging" not in d
+
+
+def test_attribution_drain_is_incremental():
+    la = LatencyAttribution(sample_every=1)
+    la.observe_express(np.array([0]), np.array([0.9]), 1.0)
+    assert len(la.drain()["express"]) == 1
+    assert la.drain() == {}  # nothing new
+    la.observe_express(np.array([1]), np.array([1.9]), 2.0)
+    assert len(la.drain()["express"]) == 1
+
+
+def test_attribution_ring_wrap_keeps_newest():
+    la = LatencyAttribution(sample_every=1)
+    n = la.CAP + 100
+    la.observe_express(np.arange(n), np.full(n, 4.0), 5.0)
+    d = la.drain()
+    assert len(d["express"]) == la.CAP
+    s = la.summary()
+    # an over-CAP burst is truncated to the newest CAP before the push,
+    # so the lifetime count reflects what was retained
+    assert s["express"]["n"] == la.CAP
+    assert s["express"]["p50_ms"] == pytest.approx(1000.0, rel=0.01)
+
+
+def test_attribution_summary_percentiles():
+    la = LatencyAttribution(sample_every=1)
+    lat_s = np.arange(1, 101) / 1e3  # 1..100 ms
+    la.observe_express(np.arange(100), 50.0 - lat_s, 50.0)
+    s = la.summary()["express"]
+    assert s["n"] == 100
+    assert 49.0 <= s["p50_ms"] <= 52.0
+    assert 98.0 <= s["p99_ms"] <= 100.0
+
+
+# -- BlackBox ---------------------------------------------------------------
+
+def test_blackbox_round_trip_and_bounds():
+    bb = BlackBox(rooms=2, events=4)
+    for k in range(7):
+        bb.emit(1, EV_QUARANTINE, float(k))
+    ev = bb.dump(1)
+    assert len(ev) == 4  # ring keeps the last M
+    assert [e["a"] for e in ev] == [3.0, 4.0, 5.0, 6.0]
+    assert all(e["event"] == "quarantine" for e in ev)
+    assert bb.dump(0) == []  # other lanes untouched
+
+
+def test_blackbox_node_lane_and_out_of_range():
+    bb = BlackBox(rooms=2, events=4)
+    bb.emit(bb.NODE, EV_GOV_LEVEL, 0.0, 2.0)
+    bb.emit(99, EV_GOV_LEVEL, 2.0, 3.0)  # out of range → node lane
+    ev = bb.dump(bb.NODE)
+    assert len(ev) == 2 and ev[0]["b"] == 2.0
+
+
+def test_blackbox_dump_to_retains_and_logs():
+    class Log:
+        def __init__(self):
+            self.calls = []
+
+        def warn(self, msg, **kw):
+            self.calls.append((msg, kw))
+
+    log = Log()
+    bb = BlackBox(rooms=1, events=4, log=log)
+    bb.emit(0, EV_NACK_STORM, 1.0, 25.0)
+    dumped = bb.dump_to(0, "nack_storm")
+    assert dumped[-1]["event"] == "nack_storm"
+    assert bb.dumps == 1
+    assert bb.last_dumps[-1]["reason"] == "nack_storm"
+    assert log.calls and log.calls[0][1]["room"] == 0
+    # no log attached is fine (detached runtimes)
+    bb.log = None
+    bb.dump_to(0, "again")
+    assert bb.dumps == 2
+
+
+# -- export schema ----------------------------------------------------------
+
+def _synthetic_events(n_ticks: int = 5):
+    ring = TickTraceRing(cap=64)
+    for i in range(n_ticks):
+        slot = _record(ring, i)
+        ring.set_shard(slot, 0, 0.2, 0.1)
+        ring.set_shard(slot, 1, 0.15, 0.05)
+    return trace_export.to_chrome(ring.snapshot(), tick_ms=5)
+
+
+def test_export_schema_valid_and_json_clean():
+    events = _synthetic_events()
+    assert trace_export.validate(events) == []
+    doc = json.loads(trace_export.export_json([], 5))
+    assert doc["traceEvents"] == []
+
+
+def test_export_span_inventory():
+    events = _synthetic_events()
+    names = {e["name"] for e in events}
+    for want in ("tick_edge", "stage_host", "express_retier", "ctrl_upload",
+                 "device_step", "fan_out", "egress_send", "munge", "send",
+                 "thread_name"):
+        assert want in names, want
+    # every X event carries µs ts/dur and the shared pid
+    for e in events:
+        if e["ph"] == "X":
+            assert e["pid"] == 1 and e["ts"] >= 0 and e["dur"] >= 0
+
+
+def test_export_lane_assignment():
+    events = _synthetic_events()
+    by_name = {}
+    for e in events:
+        by_name.setdefault(e["name"], set()).add(e["tid"])
+    assert by_name["stage_host"] == {trace_export.TID_LOOP}
+    assert by_name["device_step"] == {trace_export.TID_DEVICE}
+    assert by_name["fan_out"] == {trace_export.TID_FANOUT}
+    assert by_name["munge"] == {trace_export.TID_SHARD0,
+                                trace_export.TID_SHARD0 + 1}
+
+
+def test_validate_rejects_broken_traces():
+    assert trace_export.validate([{"ph": "X", "pid": 1, "tid": 1}])
+    assert trace_export.validate(
+        [{"name": "a", "ph": "X", "pid": 1, "tid": 1, "ts": 0.0,
+          "dur": -1.0}]
+    )
+    # partial overlap on one lane is a nesting violation
+    bad = [
+        {"name": "a", "ph": "X", "pid": 1, "tid": 1, "ts": 0.0, "dur": 10.0},
+        {"name": "b", "ph": "X", "pid": 1, "tid": 1, "ts": 5.0, "dur": 10.0},
+    ]
+    assert any("overlaps" in p for p in trace_export.validate(bad))
+    # containment is fine
+    ok = [
+        {"name": "a", "ph": "X", "pid": 1, "tid": 1, "ts": 0.0, "dur": 10.0},
+        {"name": "b", "ph": "X", "pid": 1, "tid": 1, "ts": 2.0, "dur": 3.0},
+    ]
+    assert trace_export.validate(ok) == []
+
+
+def test_selftest_end_to_end():
+    assert trace_export.selftest(ticks=4) == []

@@ -35,6 +35,9 @@ def main(argv: list[str] | None = None) -> int:
     ap.add_argument("--latency", action="store_true",
                     help="also run the slow express-lane latency smoke "
                          "(tests/test_latency_smoke.py; real sockets, ~30s)")
+    ap.add_argument("--trace-schema", action="store_true",
+                    help="also validate the trace-export schema on a tiny "
+                         "traced run (telemetry/trace_export --selftest)")
     ap.add_argument("--rules", default=None,
                     help="comma-separated subset, e.g. GC01,GC04")
     args = ap.parse_args(argv)
@@ -113,6 +116,28 @@ def main(argv: list[str] | None = None) -> int:
             latency_failures = [f"latency smoke failed "
                                 f"(exit {proc.returncode}):\n{tail}"]
     native_failures.extend(latency_failures)
+
+    # Opt-in trace-schema gate: run a tiny CPU plane with tracing on,
+    # export the span ring as Chrome trace JSON, and validate required
+    # fields + strict span nesting. Subprocess for the same hang-proofing
+    # as the latency smoke.
+    if args.trace_schema:
+        import os
+        import subprocess
+
+        proc = subprocess.run(
+            [sys.executable, "-m",
+             "livekit_server_tpu.telemetry.trace_export", "--selftest"],
+            cwd=REPO_ROOT, capture_output=True, text=True, timeout=300,
+            env={**os.environ, "JAX_PLATFORMS": os.environ.get(
+                "JAX_PLATFORMS", "cpu")},
+        )
+        if proc.returncode != 0:
+            tail = "\n".join((proc.stdout or "").splitlines()[-15:])
+            native_failures.append(
+                f"trace schema selftest failed "
+                f"(exit {proc.returncode}):\n{tail}"
+            )
 
     if args.as_json:
         print(json.dumps({

@@ -1,0 +1,90 @@
+"""Trace fetcher/validator CLI for the flight-recorder plane.
+
+    python -m tools.trace --url http://127.0.0.1:7880 -o trace.json
+    python -m tools.trace --selftest
+    python -m tools.trace --validate trace.json
+
+Fetches /debug/trace from a running node (the tick-span ring rendered as
+Chrome/Perfetto trace-event JSON plus the sampled wire-latency stage
+decomposition sidecar), writes it to a file loadable in ui.perfetto.dev
+or chrome://tracing, and prints the stage summary. --validate re-checks
+a saved export against the schema (required fields, non-negative
+durations, strict span nesting per lane); --selftest runs a tiny traced
+plane locally with no server at all.
+
+Exit codes: 0 ok, 1 validation problems / fetch errors, 2 bad usage.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+import urllib.error
+import urllib.request
+
+
+def main(argv: list[str] | None = None) -> int:
+    ap = argparse.ArgumentParser(prog="tools.trace", description=__doc__)
+    ap.add_argument("--url", default="http://127.0.0.1:7880",
+                    help="server base URL (default http://127.0.0.1:7880)")
+    ap.add_argument("--ticks", type=int, default=120,
+                    help="newest N ticks to export (default 120)")
+    ap.add_argument("-o", "--out", default="trace.json",
+                    help="output file for the fetched trace (default "
+                         "trace.json)")
+    ap.add_argument("--validate", metavar="FILE",
+                    help="validate a saved trace JSON file instead of "
+                         "fetching")
+    ap.add_argument("--selftest", action="store_true",
+                    help="run a tiny local traced plane and validate its "
+                         "export (no server needed)")
+    args = ap.parse_args(argv)
+
+    from livekit_server_tpu.telemetry import trace_export
+
+    if args.selftest:
+        problems = trace_export.selftest()
+        for p in problems:
+            print(p)
+        print("trace selftest:", "FAILED" if problems else "ok")
+        return 1 if problems else 0
+
+    if args.validate:
+        with open(args.validate, encoding="utf-8") as fh:
+            doc = json.load(fh)
+        events = doc.get("traceEvents", doc if isinstance(doc, list) else [])
+        problems = trace_export.validate(events)
+        for p in problems:
+            print(p)
+        print(f"trace: {len(events)} events, {len(problems)} problem(s)")
+        return 1 if problems else 0
+
+    url = f"{args.url.rstrip('/')}/debug/trace?ticks={args.ticks}"
+    try:
+        with urllib.request.urlopen(url, timeout=10) as resp:
+            doc = json.load(resp)
+    except (urllib.error.URLError, OSError, ValueError) as e:
+        print(f"fetch failed: {url}: {e}", file=sys.stderr)
+        return 1
+    events = doc.get("traceEvents", [])
+    problems = trace_export.validate(events)
+    with open(args.out, "w", encoding="utf-8") as fh:
+        json.dump(doc, fh)
+    print(f"wrote {args.out}: {len(events)} events "
+          f"({args.ticks} ticks requested)")
+    stages = (doc.get("otherData") or {}).get("wire_stages") or {}
+    for stage, s in stages.items():
+        print(f"  {stage:8s} p50={s.get('p50_ms')}ms "
+              f"p99={s.get('p99_ms')}ms n={s.get('n')}")
+    for p in problems:
+        print(p)
+    if problems:
+        print(f"validation: {len(problems)} problem(s)")
+        return 1
+    print("load it in ui.perfetto.dev or chrome://tracing")
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())
