@@ -29,6 +29,11 @@ analyzers wired into the tier-1 gate:
        (record_tick / set_shard / BlackBox.emit / observe_*) must pass
        scalars only: no f-string, container display, comprehension, or
        .format in the emit's arguments outside a sampled branch.
+  GC08 page-handle-discipline — device page indices minted from the
+       pager (`pages_of_room`) are epoch-scoped; using one across an
+       await or a state_lock release without `check_epoch` (or a
+       re-mint) is a finding — alloc/grow/compaction may have remapped
+       the pages behind the handle.
 
 Suppressions: `# graftcheck: disable=GC01` on the finding's exact line
 (with a justification comment), `# graftcheck: disable-file=GC02` for a

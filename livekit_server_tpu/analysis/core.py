@@ -18,7 +18,7 @@ from typing import Callable
 
 # -- finding model ----------------------------------------------------------
 
-RULES = ("GC01", "GC02", "GC03", "GC04", "GC05", "GC06", "GC07")
+RULES = ("GC01", "GC02", "GC03", "GC04", "GC05", "GC06", "GC07", "GC08")
 
 # Parse/config failures surface as findings too (rule GC00) so the runner
 # has one reporting path; compileall in tools/check.py catches the rest.
@@ -274,6 +274,22 @@ DEFAULT_CONFIG: dict = {
         # a branch the allocation is paid 1-in-K times, which is fine.
         "sample_guards": ["sample", "sampled", "mask", "stamped"],
     },
+    "gc08": {
+        # Page-handle staleness: anywhere that can mint device page
+        # indices from the pager. runtime/ holds the paged runtime and
+        # integrity/migration consumers; service/ holds roommanager.
+        "paths": [
+            "livekit_server_tpu/runtime",
+            "livekit_server_tpu/service",
+        ],
+        # call tails whose result is an epoch-scoped page handle
+        "mint_calls": ["pages_of_room"],
+        # call tails that re-validate a held handle's epoch
+        "revalidate_calls": ["check_epoch"],
+        # lock names whose `with` exit is a staleness boundary (another
+        # thread may compact once the state lock drops)
+        "lock_names": ["state_lock"],
+    },
 }
 
 
@@ -332,6 +348,7 @@ def run_all(
         gc05,
         gc06,
         gc07,
+        gc08,
     )
 
     impls: dict[str, Callable[[Project, dict], list[Finding]]] = {
@@ -342,6 +359,7 @@ def run_all(
         "GC05": gc05.run,
         "GC06": gc06.run,
         "GC07": gc07.run,
+        "GC08": gc08.run,
     }
     findings: list[Finding] = []
     for f in project.files:

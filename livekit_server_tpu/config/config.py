@@ -176,6 +176,18 @@ class PlaneConfig:
     # work is per-room; bound it so a flood of small rooms cannot starve
     # the tick loop). Only meaningful when express_max_subs > 0.
     express_max_rooms: int = 16
+    # Paged room state (runtime/pager.py): carve device state out of one
+    # pooled HBM buffer in (pager_tpage × pager_spage) track×sub pages
+    # per room instead of a dense [rooms, tracks, subs] box, so small
+    # rooms stop paying the worst-case footprint. Both page dims must be
+    # pow2 divisors of tracks_per_room / subs_per_room (spage also ≤ 32
+    # and dividing 32 — the selector's sub bitmask lane). pager_pool_pages
+    # sizes the pool (pow2; 0 = rooms × max pages per room, i.e. dense-
+    # equivalent capacity — useful for parity runs, pointless in prod).
+    pager_enabled: bool = False
+    pager_tpage: int = 4
+    pager_spage: int = 8
+    pager_pool_pages: int = 0
 
 
 @dataclass
@@ -545,6 +557,29 @@ def _validate(cfg: Config) -> None:
         raise ConfigError(
             f"plane.express_max_rooms must be positive, got {p.express_max_rooms}"
         )
+    if p.pager_enabled:
+        def _pow2(n: int) -> bool:
+            return n > 0 and (n & (n - 1)) == 0
+
+        for name, axis in (("pager_tpage", "tracks_per_room"),
+                           ("pager_spage", "subs_per_room")):
+            v, cap = getattr(p, name), getattr(p, axis)
+            if not _pow2(v):
+                raise ConfigError(f"plane.{name} must be a power of two, got {v}")
+            if cap % v != 0:
+                raise ConfigError(
+                    f"plane.{name} must divide plane.{axis} ({cap}), got {v}"
+                )
+        if p.pager_spage > 32 or 32 % p.pager_spage != 0:
+            raise ConfigError(
+                "plane.pager_spage must divide 32 (selector sub-bitmask "
+                f"lane), got {p.pager_spage}"
+            )
+        if p.pager_pool_pages and not _pow2(p.pager_pool_pages):
+            raise ConfigError(
+                "plane.pager_pool_pages must be a power of two (or 0 for "
+                f"dense-equivalent), got {p.pager_pool_pages}"
+            )
     eg = cfg.egress
     if not 0 <= eg.shards <= 64:
         raise ConfigError(f"egress.shards must be in [0, 64], got {eg.shards}")

@@ -74,6 +74,31 @@ def shard_tree(tree: Any, mesh: Mesh) -> Any:
     return jax.tree.map(put, tree)
 
 
+def page_sharding(mesh: Mesh) -> NamedSharding:
+    """Sharding for the PAGED plane's pooled buffers: the leading axis is
+    the page-pool axis [P] instead of [R], split over the same 1-D mesh.
+    Unlike rooms, pages are NOT embarrassingly parallel — the paged tick
+    gathers a room's sub column across its track pages (tmembers), so the
+    paged mesh path uses plain GSPMD jit (the partitioner inserts the
+    cross-shard gathers) rather than the dense tick's shard_map. The
+    pager's allocator keeps a room's grid contiguous (one pow2 run), so
+    most tmembers gathers stay shard-local anyway."""
+    return NamedSharding(mesh, P(ROOM_AXIS))
+
+
+def shard_pool(tree: Any, mesh: Mesh) -> Any:
+    """device_put the pooled plane state / page table with every leaf's
+    leading (page or room) axis split over the mesh; scalars replicate."""
+    ps = page_sharding(mesh)
+    rep = replicated(mesh)
+
+    def put(x):
+        x = jnp.asarray(x)
+        return jax.device_put(x, rep if x.ndim == 0 else ps)
+
+    return jax.tree.map(put, tree)
+
+
 def make_sharded_tick(
     mesh: Mesh,
     audio_params: Any | None = None,

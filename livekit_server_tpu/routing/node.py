@@ -46,6 +46,11 @@ class NodeStats:
     # TPU additions: plane occupancy drives placement before CPU ever does.
     plane_rooms_used: int = 0
     plane_rooms_capacity: int = 0
+    # Paged plane: HBM page-pool headroom (0/0 on a dense plane). The
+    # selector's room-count signal saturates long before a paged pool
+    # does, so placement reads pages when they're reported.
+    plane_pages_used: int = 0
+    plane_pages_capacity: int = 0
 
 
 def sample_system_stats(stats: NodeStats) -> NodeStats:

@@ -210,9 +210,20 @@ class OverloadGovernor:
     def should_admit(self, kind: str) -> bool:
         """Node admission gate for new work ('room' / 'join' / 'publish').
         Existing sessions — including resumes — are never evicted by the
-        governor; only NEW load is refused, and only at L4."""
-        del kind  # one gate for all kinds today; the signature is the API
-        return not self.drain_hold and self.level < L_REJECT
+        governor; only NEW load is refused, and only at L4.
+
+        Room admission is additionally keyed on REAL plane headroom, not
+        row count: `occupancy()["admittable_rooms"]` folds in the page
+        pool on a paged runtime (free pages / min room footprint), so a
+        fragmented or page-exhausted pool refuses rooms even while room
+        rows remain — and a dense runtime degrades to the row check."""
+        if self.drain_hold or self.level >= L_REJECT:
+            return False
+        if kind == "room":
+            occ = self.runtime.occupancy()
+            if occ.get("admittable_rooms", 1) <= 0:
+                return False
+        return True
 
     def note_rejection(self, kind: str) -> None:
         self.rejected[kind] = self.rejected.get(kind, 0) + 1

@@ -57,6 +57,13 @@ BIT_RANGE = 1 << 1
 BIT_CURSOR = 1 << 2
 BIT_CTRL = 1 << 3
 BIT_BOUNDS = 1 << 4
+# Set by the PAGED runtime's map_audit_mask, not by audit_plane: the
+# device page table diverged from the pager's canonical host mirrors
+# (an SDC hit on the indirection layer itself). The table rows are
+# repaired from the host copy immediately; the rooms that computed
+# through the corrupt mapping still quarantine + row-repair like any
+# other violation.
+BIT_TABLE = 1 << 5
 
 # Finite values past this are treated as corruption: no real rate, byte
 # count, jitter, or audio level in the plane approaches 1e30, but a
@@ -226,6 +233,13 @@ class IntegrityMonitor:
         mask_dev, counts_dev, self._mirror = self._audit(rt.state, self._mirror)
         mask = np.asarray(mask_dev)
         counts = np.asarray(counts_dev)
+        # Paged layout: the audit ran over POOLED page rows; the runtime
+        # maps the per-page mask to per-room (OR of the room's pages) and
+        # folds in its page-table SDC check (BIT_TABLE). Dense runtimes
+        # have no mapper — the mask is already per-room.
+        mapper = getattr(rt, "map_audit_mask", None)
+        if mapper is not None:
+            mask = mapper(mask)
         self.audit_s += time.perf_counter() - t0
         self.audits += 1
         self.last_audit_tick = tick_index
@@ -364,6 +378,13 @@ class IntegrityMonitor:
         self.quarantined.discard(row)
         self._pending_repair.discard(row)
         self._row_attempts.pop(row, None)
+        self._mirror = None
+
+    def on_layout_change(self) -> None:
+        """The paged runtime applied a page-table delta (alloc / free /
+        grow / compaction): page rows changed identity under the audit
+        mirror's cursors, so re-baseline instead of flagging relocated
+        streams as rewinds."""
         self._mirror = None
 
     def on_full_restore(self) -> None:

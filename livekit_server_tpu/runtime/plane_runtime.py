@@ -373,7 +373,7 @@ class PlaneRuntime:
         # one. _complete feeds it each finished tick's verdict.
         self.governor = None
 
-        self.state = plane.init_state(dims)
+        self.state = self._init_device_state()
         # Host-owned SN/TS/VP8 rewrite state (the round-5 decide-on-
         # device / rewrite-on-host split; see runtime/munge.py).
         self.munger = HostMunger(dims)
@@ -395,21 +395,7 @@ class PlaneRuntime:
 
             self.express = ExpressLane(self, express_max_subs, express_max_rooms)
         self._mesh = mesh
-        if mesh is not None:
-            from livekit_server_tpu.parallel import make_sharded_tick, shard_tree
-            from livekit_server_tpu.parallel.mesh import room_sharding
-
-            self.state = shard_tree(self.state, mesh)
-            self._step = make_sharded_tick(
-                mesh, self._ap, self._bp, donate=True, red_enabled=red_enabled,
-            )
-            self._apply_delta = _build_ctrl_delta(room_sharding(mesh))
-        else:
-            # Shared across PlaneRuntime instances with identical params so
-            # repeated construction (tests, restarts) reuses the XLA
-            # compilation cache instead of re-tracing a fresh closure.
-            self._step = _build_step(self._ap, self._bp, red_enabled)
-            self._apply_delta = _build_ctrl_delta()
+        self._init_step()
 
         # Rolling payload history for NACK replay (slab keys reference slot
         # tick % SLAB_WINDOW; resolve_nacks age-gates so a recycled slot is
@@ -482,6 +468,65 @@ class PlaneRuntime:
             self.trace = trace_mod.TickTraceRing(trace_ring_ticks)
             self.wire_stages = trace_mod.LatencyAttribution(trace_sample_every)
         self.blackbox = trace_mod.BlackBox(R, blackbox_events)
+
+    # -- device-layout seams (overridden by PagedPlaneRuntime) ------------
+    # The host side of the runtime — mirrors, munger, sequencer, express,
+    # fan-out, governor — speaks LOGICAL dense [R, T, S] shapes. These
+    # four hooks are the only places the device layout leaks in, so a
+    # subclass can swap the dense plane for the pooled paged plane
+    # (runtime/paged_runtime.py) without touching the tick loop.
+
+    def _init_device_state(self):
+        """Allocate the device-resident plane state (dense layout)."""
+        return plane.init_state(self.dims)
+
+    def _init_step(self) -> None:
+        """Build the jitted device step + ctrl-delta appliers."""
+        if self._mesh is not None:
+            from livekit_server_tpu.parallel import make_sharded_tick, shard_tree
+            from livekit_server_tpu.parallel.mesh import room_sharding
+
+            self.state = shard_tree(self.state, self._mesh)
+            self._step = make_sharded_tick(
+                self._mesh, self._ap, self._bp, donate=True,
+                red_enabled=self.red_enabled,
+            )
+            self._apply_delta = _build_ctrl_delta(room_sharding(self._mesh))
+        else:
+            # Shared across PlaneRuntime instances with identical params so
+            # repeated construction (tests, restarts) reuses the XLA
+            # compilation cache instead of re-tracing a fresh closure.
+            self._step = _build_step(self._ap, self._bp, self.red_enabled)
+            self._apply_delta = _build_ctrl_delta()
+
+    def _pack_inputs(self, inp: plane.TickInputs) -> tuple:
+        """Logical TickInputs → the device-step upload arrays."""
+        return plane.pack_tick_inputs(inp)
+
+    def _unpack_outputs(self, buf) -> plane.TickOutputs:
+        """Device-step output buffer → LOGICAL-shape TickOutputs."""
+        return plane.unpack_tick_outputs(
+            np.asarray(buf), self.dims, self.red_enabled
+        )
+
+    def _sel_mirror(self, state) -> tuple:
+        """The express lane's post-step selector mirror, in LOGICAL
+        [R, T, S] shape: (current_spatial, current_temporal,
+        target_spatial, target_temporal) numpy arrays."""
+        sel = state.sel
+        return (
+            np.asarray(sel.current_spatial),
+            np.asarray(sel.current_temporal),
+            np.asarray(sel.target_spatial),
+            np.asarray(sel.target_temporal),
+        )
+
+    def occupancy(self) -> dict:
+        """Per-resource occupancy (rooms/tracks/subs used vs pool) for
+        admission gating and /debug — the capacity accounting the slot
+        allocator keeps. `admittable_rooms` is how many more MINIMAL
+        rooms this plane could accept (the governor's L4 headroom key)."""
+        return self.slots.occupancy()
 
     # -- control-plane mutation API (host mirrors; applied at tick edge) --
     def set_track(self, room: int, track: int, *, published: bool, is_video: bool,
@@ -672,9 +717,7 @@ class PlaneRuntime:
             out = jax.tree.map(np.asarray, out)
         else:
             state, buf = self._step(self.state, *st.packed)
-            out = plane.unpack_tick_outputs(
-                np.asarray(buf), self.dims, self.red_enabled
-            )
+            out = self._unpack_outputs(buf)
         if epoch != self.run_epoch:
             return None  # restarted mid-step: result belongs to a dead run
         self.state = state
@@ -683,13 +726,7 @@ class PlaneRuntime:
             # here (same device sync as `out`), consumed at the next
             # retier on the event loop — decisions made from it are
             # bounded ≤1 tick stale.
-            sel = state.sel
-            self.express.post_mirror(
-                np.asarray(sel.current_spatial),
-                np.asarray(sel.current_temporal),
-                np.asarray(sel.target_spatial),
-                np.asarray(sel.target_temporal),
-            )
+            self.express.post_mirror(*self._sel_mirror(state))
         if self.integrity is not None:
             # Audit the committed state on the cadence; the fetched mask
             # is a few dozen bytes riding the same device sync as `out`.
@@ -734,7 +771,7 @@ class PlaneRuntime:
             # Pack here — NOT in the worker — so the drained staging set's
             # zero-copy field views are consumed before the set recycles,
             # and the packing memcpys overlap the previous device step.
-            packed = plane.pack_tick_inputs(inp)
+            packed = self._pack_inputs(inp)
         st = StagedTick(inp=inp, payloads=payloads, idx=idx, roll=roll,
                         packed=packed, express_rows=ex_rows,
                         express_words=ex_words, express_log=ex_log)

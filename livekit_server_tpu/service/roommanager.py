@@ -58,8 +58,27 @@ class RoomManager:
         self.store = store
         self.telemetry = telemetry
         p = config.plane
-        self.runtime = PlaneRuntime(
-            plane.PlaneDims(p.rooms, p.tracks_per_room, p.pkts_per_track, p.subs_per_room),
+        if p.pager_enabled:
+            from livekit_server_tpu.models import paged
+            from livekit_server_tpu.runtime.paged_runtime import PagedPlaneRuntime
+
+            pool = p.pager_pool_pages or (
+                p.rooms
+                * (p.tracks_per_room // p.pager_tpage)
+                * (p.subs_per_room // p.pager_spage)
+            )
+            runtime_cls = PagedPlaneRuntime
+            dims = paged.PagedDims(
+                p.rooms, p.tracks_per_room, p.pkts_per_track, p.subs_per_room,
+                tpage=p.pager_tpage, spage=p.pager_spage, pool_pages=pool,
+            )
+        else:
+            runtime_cls = PlaneRuntime
+            dims = plane.PlaneDims(
+                p.rooms, p.tracks_per_room, p.pkts_per_track, p.subs_per_room
+            )
+        self.runtime = runtime_cls(
+            dims,
             tick_ms=p.tick_ms,
             mesh=mesh,
             low_latency=p.low_latency,
@@ -431,6 +450,13 @@ class RoomManager:
             # Drain works with the governor disabled too: the orchestrator
             # itself refuses every admission kind while rooms move off.
             reason = "node draining"
+        elif kind == "room" and (
+            self.runtime.occupancy().get("admittable_rooms", 1) <= 0
+        ):
+            # Real plane headroom (paged: free pages / min room footprint;
+            # dense: free rows) — checked before the governor so page-pool
+            # exhaustion reports its own reason rather than "overloaded".
+            reason = "no plane capacity for a new room"
         elif self.governor is not None and not self.governor.should_admit(kind):
             reason = "node overloaded"
         elif kind == "room" and lim.max_rooms and len(self.rooms) >= lim.max_rooms:
@@ -800,6 +826,9 @@ class RoomManager:
             if self.integrity is not None:
                 self.telemetry.observe_integrity(self.integrity_stats())
             self.telemetry.observe_egress(self.runtime.egress_plane.observe())
+            pager_stats = getattr(self.runtime, "pager_stats", None)
+            if pager_stats is not None:
+                self.telemetry.observe_pager(pager_stats())
             if self.runtime.wire_stages is not None:
                 # Per-stage wire-latency samples since the last tick →
                 # stage histograms + livekit_forward_latency_ms.
@@ -872,6 +901,9 @@ class RoomManager:
         )
         st.plane_rooms_used = self.runtime.slots.rooms_used
         st.plane_rooms_capacity = self.runtime.slots.capacity
+        occ = self.runtime.occupancy()
+        st.plane_pages_used = occ.get("pages_used", 0)
+        st.plane_pages_capacity = occ.get("pages_total", 0)
 
     def sample_traffic(self) -> None:
         """Window deltas of the cumulative rx/tx counters → node packet/

@@ -77,6 +77,7 @@ class LivekitServer:
         self.app.router.add_get("/debug/tasks", self.debug_tasks)
         self.app.router.add_get("/debug/ticks", self.debug_ticks)
         self.app.router.add_get("/debug/overload", self.debug_overload)
+        self.app.router.add_get("/debug/pager", self.debug_pager)
         self.app.router.add_get("/debug/integrity", self.debug_integrity)
         self.app.router.add_get("/debug/egress", self.debug_egress)
         self.app.router.add_get("/debug/migration", self.debug_migration)
@@ -341,6 +342,31 @@ class LivekitServer:
             snap["tx_total"] = rm.udp.stats.get("tx", 0)
             snap["tx_drop_total"] = rm.udp.stats.get("tx_drop", 0)
         return web.json_response(snap)
+
+    async def debug_pager(self, request: web.Request) -> web.Response:
+        """Paged room-state plane: page-pool occupancy/fragmentation,
+        allocator churn counters, per-room page extents, and per-resource
+        slot occupancy. `paged: false` (with the dense slot occupancy)
+        when the plane runs the dense layout."""
+        rm = self.room_manager
+        rt = rm.runtime
+        pager_stats = getattr(rt, "pager_stats", None)
+        body: dict = {
+            "paged": pager_stats is not None,
+            "occupancy": rt.occupancy(),
+        }
+        if pager_stats is not None:
+            body["pool"] = pager_stats()
+            pager = rt.pager
+            body["rooms"] = {
+                room.name: {
+                    "row": room.slots.row,
+                    "pages": [int(p) for p in pager.pages_of_room(room.slots.row)],
+                    "extent": tuple(pager.extent(room.slots.row)),
+                }
+                for room in rm.rooms.values()
+            }
+        return web.json_response(body)
 
     async def debug_integrity(self, request: web.Request) -> web.Response:
         """State-integrity plane: audits run, violations by rule, the

@@ -222,6 +222,23 @@ class TelemetryService:
         for i, ms in enumerate(snap.get("shard_send_ms", [])):
             self.set_gauge("livekit_egress_shard_busy_ms_total", ms, shard=str(i))
 
+    def observe_pager(self, snap: dict[str, Any]) -> None:
+        """Paged room-state plane (runtime/pager.py stats()): HBM page
+        pool occupancy, fragmentation, and churn counters. Only emitted
+        when the plane runs paged — a dense plane has no pager."""
+        self.set_gauge("livekit_page_pool_used", snap.get("pages_used", 0))
+        self.set_gauge("livekit_page_pool_total", snap.get("pages_total", 0))
+        self.set_gauge(
+            "livekit_page_fragmentation_ratio",
+            snap.get("fragmentation_ratio", 0.0),
+        )
+        self.set_gauge(
+            "livekit_page_internal_slack", snap.get("internal_slack", 0)
+        )
+        for k in ("allocs", "frees", "grows", "compactions",
+                  "alloc_failures", "table_repairs"):
+            self.set_gauge(f"livekit_pager_{k}_total", snap.get(k, 0))
+
     def observe_queue_drops(self) -> None:
         """Bus/signal back-pressure drops (the QueueFull paths that used
         to lose messages with at most a local count): process-wide

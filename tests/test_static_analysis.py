@@ -23,6 +23,7 @@ from livekit_server_tpu.analysis import (
     gc05,
     gc06,
     gc07,
+    gc08,
     diff_baseline,
     load_project,
     run_all,
@@ -593,6 +594,83 @@ def test_gc07_emit_calls_configurable(tmp_path):
     project = make_project(tmp_path, {"pkg/rec.py": GC07_FIXTURE})
     cfg = cfg_for("gc07", emit_calls=["record_tick"])
     assert lines_of(gc07.run(project, cfg), "GC07") == [5]
+
+
+# -- GC08 page-handle discipline --------------------------------------------
+
+GC08_BAD = """\
+    class Mover:
+        async def relocate(self, rt, row):
+            pages = rt.pager.pages_of_room(row)       # line 3: mint
+            await rt.bus.publish("moving", row)       # line 4: boundary
+            return rt.state_rows(pages)               # line 5: stale use
+"""
+
+GC08_LOCK_BAD = """\
+    class Mover:
+        async def relocate(self, rt, row):
+            async with rt.state_lock:
+                pages = rt.pager.pages_of_room(row)
+            return rt.state_rows(pages)               # line 5: after release
+"""
+
+GC08_GOOD = """\
+    class Mover:
+        async def relocate(self, rt, row):
+            pages = rt.pager.pages_of_room(row)
+            self.touch(pages)                         # same epoch: fine
+            await rt.bus.publish("moving", row)
+            rt.pager.check_epoch(self.epoch)          # revalidated
+            return rt.state_rows(pages)
+
+        async def refetch(self, rt, row):
+            pages = rt.pager.pages_of_room(row)
+            await rt.bus.publish("moving", row)
+            pages = rt.pager.pages_of_room(row)       # re-mint: fine
+            return rt.state_rows(pages)
+"""
+
+
+def test_gc08_await_boundary(tmp_path):
+    project = make_project(tmp_path, {"pkg/mover.py": GC08_BAD})
+    findings = gc08.run(project, cfg_for("gc08"))
+    assert lines_of(findings, "GC08") == [5]
+    assert "an await" in findings[0].message
+    assert "check_epoch" in findings[0].hint
+
+
+def test_gc08_lock_release_boundary(tmp_path):
+    project = make_project(tmp_path, {"pkg/mover.py": GC08_LOCK_BAD})
+    findings = gc08.run(project, cfg_for("gc08"))
+    assert lines_of(findings, "GC08") == [5]
+    assert "state_lock" in findings[0].message
+
+
+def test_gc08_revalidate_and_remint_exempt(tmp_path):
+    project = make_project(tmp_path, {"pkg/mover.py": GC08_GOOD})
+    assert gc08.run(project, cfg_for("gc08")) == []
+
+
+def test_gc08_inline_disable(tmp_path):
+    suppressed = GC08_BAD.replace(
+        'return rt.state_rows(pages)               # line 5: stale use',
+        'return rt.state_rows(pages)  # graftcheck: disable=GC08',
+    )
+    project = make_project(tmp_path, {"pkg/mover.py": suppressed})
+    assert lines_of(run_all_pkg(project), "GC08") == []
+
+
+def test_gc08_use_before_boundary_is_fine(tmp_path):
+    src = """\
+        class Mover:
+            async def relocate(self, rt, row):
+                pages = rt.pager.pages_of_room(row)
+                out = rt.state_rows(pages)
+                await rt.bus.publish("done", row)
+                return out
+    """
+    project = make_project(tmp_path, {"pkg/mover.py": src})
+    assert gc08.run(project, cfg_for("gc08")) == []
 
 
 # -- suppressions -----------------------------------------------------------

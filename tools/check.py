@@ -94,6 +94,12 @@ def main(argv: list[str] | None = None) -> int:
             native_failures = native_mod.native_smoke()
         except Exception as exc:  # toolchain totally absent ⇒ report, fail
             native_failures = [f"native smoke crashed: {exc!r}"]
+        # Egress shard planner × paged extents: the munge/seal walk cuts
+        # on room boundaries; with the paged plane, a room's entry count
+        # tracks its RAGGED page extent, not the dense axis. Verify the
+        # planner still tiles exactly and never splits a room when fed an
+        # extent-skewed entry distribution from a real pager.
+        native_failures.extend(_pager_shard_smoke())
 
     # Opt-in latency smoke: the slow-marked express-lane wire-p99 test
     # (excluded from tier-1 by the `slow` marker). Runs in a subprocess
@@ -169,6 +175,52 @@ def main(argv: list[str] | None = None) -> int:
     if new or not compiled_ok or native_failures:
         return 1
     return 0
+
+
+def _pager_shard_smoke() -> list[str]:
+    """Cross-check the egress plane's entry planner against paged room
+    extents: allocate a mixed-size room population through a RoomPager,
+    synthesize a room-ascending egress entry column where each room's
+    entry count equals its paged sub extent, and assert for several
+    shard widths that the plan (a) tiles [0, n) with no gap or overlap
+    and (b) keeps every room on exactly one shard. Pure host math —
+    runs even when the C++ toolchain is absent."""
+    import numpy as np
+
+    from livekit_server_tpu.runtime.egress_plane import EgressPlane
+    from livekit_server_tpu.runtime.pager import RoomPager
+
+    failures: list[str] = []
+    pager = RoomPager(rooms=32, tracks=16, subs=32, tpage=4, spage=8,
+                      pool_pages=64)
+    # 80/15/5-ish population: mostly tiny rooms, a few big ones.
+    sizes = [(1, 2)] * 12 + [(2, 10)] * 4 + [(8, 30)] * 2
+    for row, (tr, sb) in enumerate(sizes):
+        pager.alloc_room(row, tracks=tr, subs=sb)
+    rooms_col = np.concatenate([
+        np.full(pager.extent(row).subs, row, np.int32)
+        for row, _ in enumerate(sizes)
+    ])
+    for shards in (1, 2, 3, 5, 8):
+        plane = EgressPlane(shards=shards, multicast_seal=False)
+        lo, hi = plane.entry_plan(rooms_col)
+        if lo[0] != 0 or hi[-1] != len(rooms_col) or not (lo[1:] == hi[:-1]).all():
+            failures.append(
+                f"pager shard smoke: entry_plan({shards}) does not tile "
+                f"[0, {len(rooms_col)}): lo={lo.tolist()} hi={hi.tolist()}"
+            )
+            continue
+        for a, b in zip(lo, hi):
+            seg = rooms_col[a:b]
+            if len(seg) == 0:
+                continue
+            prev_seg = rooms_col[:a]
+            if len(prev_seg) and prev_seg[-1] == seg[0]:
+                failures.append(
+                    f"pager shard smoke: shards={shards} splits room "
+                    f"{int(seg[0])} across a cut at entry {int(a)}"
+                )
+    return failures
 
 
 if __name__ == "__main__":
