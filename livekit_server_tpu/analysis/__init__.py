@@ -34,6 +34,12 @@ analyzers wired into the tier-1 gate:
        await or a state_lock release without `check_epoch` (or a
        re-mint) is a finding — alloc/grow/compaction may have remapped
        the pages behind the handle.
+  GC09 fencing-discipline — room-ownership KV state (room_checkpoint:/
+       room_snapshot:/room_epoch: keys, the room_node_map pin hash)
+       may only be mutated through the epoch-fenced writer API
+       (RoomFence guarded writes, the KVRouter pin movers); a raw bus
+       mutation on a literal fenced key bypasses the epoch CAS that
+       keeps a stale owner from clobbering the takeover winner.
 
 Suppressions: `# graftcheck: disable=GC01` on the finding's exact line
 (with a justification comment), `# graftcheck: disable-file=GC02` for a

@@ -18,7 +18,9 @@ from typing import Callable
 
 # -- finding model ----------------------------------------------------------
 
-RULES = ("GC01", "GC02", "GC03", "GC04", "GC05", "GC06", "GC07", "GC08")
+RULES = (
+    "GC01", "GC02", "GC03", "GC04", "GC05", "GC06", "GC07", "GC08", "GC09",
+)
 
 # Parse/config failures surface as findings too (rule GC00) so the runner
 # has one reporting path; compileall in tools/check.py catches the rest.
@@ -290,6 +292,33 @@ DEFAULT_CONFIG: dict = {
         # thread may compact once the state lock drops)
         "lock_names": ["state_lock"],
     },
+    "gc09": {
+        # Fencing discipline: room-ownership KV state may only be
+        # mutated through the epoch-fenced writer API. routing/ holds
+        # the fence and the pin movers; service/ holds checkpoint and
+        # failover writers.
+        "paths": [
+            "livekit_server_tpu/routing",
+            "livekit_server_tpu/service",
+        ],
+        # literal key prefixes that are epoch-fenced
+        "fenced_prefixes": [
+            "room_checkpoint:",
+            "room_snapshot:",
+            "room_epoch:",
+        ],
+        # hash literals / module constants that hold room→node pins
+        "pin_hashes": ["room_node_map"],
+        "pin_hash_names": ["NODE_ROOM_KEY"],
+        # the sanctioned writers: the fence itself plus the pin movers
+        # that claim/transfer an epoch before touching the hash
+        "allowed_in": [
+            "RoomFence.*",
+            "KVRouter.set_node_for_room",
+            "KVRouter.clear_room_state",
+            "FailoverOrchestrator.run_once",
+        ],
+    },
 }
 
 
@@ -349,6 +378,7 @@ def run_all(
         gc06,
         gc07,
         gc08,
+        gc09,
     )
 
     impls: dict[str, Callable[[Project, dict], list[Finding]]] = {
@@ -360,6 +390,7 @@ def run_all(
         "GC06": gc06.run,
         "GC07": gc07.run,
         "GC08": gc08.run,
+        "GC09": gc09.run,
     }
     findings: list[Finding] = []
     for f in project.files:

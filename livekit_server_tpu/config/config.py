@@ -221,6 +221,11 @@ class KeyValueConfig:
     lease_ttl_s: float = 6.0
     # Cadence of the surviving nodes' dead-pin scan (room failover).
     failover_interval_s: float = 2.0
+    # Heartbeat/lease refresh cadence (the stats worker's sleep). Must
+    # divide comfortably into lease_ttl_s: the lease survives a couple
+    # of missed refreshes, and the fleet plane's fence_grace timeline is
+    # quantized by it.
+    stats_interval_s: float = 2.0
 
 
 @dataclass
@@ -328,6 +333,43 @@ class FaultInjectConfig:
     # Source-side: the first N commit phases raise ConnectionError on
     # their bus ops (the "bus severed mid-handoff" drill).
     mig_sever_handoffs: int = 0
+    # Bus-partition drills (BusServer.set_partition via the injector's
+    # bus_partition_tick seam). Groups are lists of node ids; group 0
+    # keeps the bus, later groups are severed (every KV op errors, every
+    # pub/sub push is skipped) — the minority side of a split-brain.
+    bus_partition_groups: list = field(default_factory=list)
+    # Tick to install the partition at / heal it at (-1 = never).
+    bus_partition_tick: int = -1
+    bus_heal_at_tick: int = -1
+    # (src, dst) node-id pairs whose pushes are held during the
+    # partition and delivered IN ORDER on heal — the stale-message-
+    # after-heal drill (e.g. a migration COMMIT outliving its epoch).
+    bus_asym_pairs: list = field(default_factory=list)
+
+
+@dataclass
+class FleetConfig:
+    """Partition-tolerant fleet plane (routing/fleet.py +
+    service/fleetplane.py): epoch-fenced room ownership, self-fencing on
+    lease loss, elected failover and the load rebalancer."""
+
+    enabled: bool = True
+    # A node whose liveness lease goes unrefreshed this long self-fences
+    # (mutes egress, freezes checkpoints, denies admissions, quiesces
+    # supervisor restarts). Validated against the takeover timeline:
+    # must stay BELOW kv.lease_ttl_s + kv.failover_interval_s (fence
+    # before any survivor can finish a takeover) and at most
+    # 2 x kv.lease_ttl_s (a transient blip must not mute a node long).
+    fence_grace_s: float = 6.0
+    # TTL of the `fleet_restore:{room}` create-lock electing a failover
+    # restorer; a crashed winner's lock lapses after this.
+    restore_lock_ttl_s: float = 10.0
+    # Load rebalancer (default-off): drain the hottest node via live
+    # migration when its plane load exceeds the fleet mean by headroom.
+    rebalance_enabled: bool = False
+    rebalance_interval_s: float = 10.0
+    rebalance_headroom: float = 0.25
+    rebalance_max_moves: int = 1
 
 
 @dataclass
@@ -412,6 +454,7 @@ class Config:
     integrity: IntegrityConfig = field(default_factory=IntegrityConfig)
     migration: MigrationConfig = field(default_factory=MigrationConfig)
     trace: TraceConfig = field(default_factory=TraceConfig)
+    fleet: FleetConfig = field(default_factory=FleetConfig)
 
 
 _SCALARS = (int, float, str, bool)
@@ -629,6 +672,33 @@ def _validate(cfg: Config) -> None:
             raise ConfigError(f"limits.{name} must be positive")
     if cfg.kv.lease_ttl_s <= 0:
         raise ConfigError("kv.lease_ttl_s must be positive")
+    if cfg.kv.stats_interval_s <= 0:
+        raise ConfigError("kv.stats_interval_s must be positive")
+    if f.bus_heal_at_tick < -1 or f.bus_partition_tick < -1:
+        raise ConfigError(
+            "faults.bus_partition_tick/bus_heal_at_tick must be >= -1"
+        )
+    fleet = cfg.fleet
+    if fleet.enabled:
+        if fleet.fence_grace_s <= 0:
+            raise ConfigError("fleet.fence_grace_s must be positive")
+        if fleet.fence_grace_s > 2 * cfg.kv.lease_ttl_s:
+            raise ConfigError(
+                "fleet.fence_grace_s must be <= 2 x kv.lease_ttl_s "
+                "(a blip must not mute a healthy node for long)"
+            )
+        if fleet.fence_grace_s >= cfg.kv.lease_ttl_s + cfg.kv.failover_interval_s:
+            raise ConfigError(
+                "fleet.fence_grace_s must be < kv.lease_ttl_s + "
+                "kv.failover_interval_s (the minority must fence before "
+                "any survivor can complete a takeover)"
+            )
+    for name in ("restore_lock_ttl_s", "rebalance_interval_s",
+                 "rebalance_max_moves"):
+        if getattr(fleet, name) <= 0:
+            raise ConfigError(f"fleet.{name} must be positive")
+    if fleet.rebalance_headroom < 0:
+        raise ConfigError("fleet.rebalance_headroom must be >= 0")
     mig = cfg.migration
     for name in ("snapshot_ttl_s", "ack_timeout_s", "retry_attempts",
                  "retry_backoff_base_s", "retry_backoff_max_s",

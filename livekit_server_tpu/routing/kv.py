@@ -31,6 +31,9 @@ class MessageBus(Protocol):
     async def get(self, key: str) -> str | None: ...
     async def delete(self, key: str) -> None: ...
     async def setnx(self, key: str, value: str, ttl: float | None = None) -> bool: ...
+    async def cas(
+        self, key: str, expect: str | None, value: str, ttl: float | None = None
+    ) -> bool: ...
     async def publish(self, channel: str, msg: Any) -> int: ...
     def subscribe(self, channel: str, size: int = 200) -> "Subscription": ...
 
@@ -132,6 +135,18 @@ class MemoryBus:
     async def setnx(self, key: str, value: str, ttl: float | None = None) -> bool:
         """Distributed-lock primitive (redisstore.go:242-280 room lock)."""
         if self._live(key) is not None:
+            return False
+        await self.set(key, value, ttl)
+        return True
+
+    async def cas(
+        self, key: str, expect: str | None, value: str, ttl: float | None = None
+    ) -> bool:
+        """Compare-and-swap: write only if the key's current value is
+        EXACTLY `expect` (None = key absent). The epoch-fencing primitive
+        (routing/fleet.py): a stale owner's expect string names a dead
+        epoch, so its write loses here instead of clobbering the winner's."""
+        if self._live(key) != expect:
             return False
         await self.set(key, value, ttl)
         return True

@@ -13,8 +13,14 @@ import enum
 import os
 import time
 from dataclasses import dataclass, field
+from typing import ClassVar
 
 from livekit_server_tpu.utils import ids
+
+# Wall-clock tolerance for peers that predate the monotonic heartbeat
+# stamp (mono_at == 0): their updated_at may be skewed by NTP steps, so
+# freshness checks widen by this much instead of trusting it exactly.
+SKEW_ALLOWANCE_S = 2.0
 
 
 class NodeState(enum.IntEnum):
@@ -28,6 +34,11 @@ class NodeStats:
     """livekit.NodeStats equivalent (node registry + selector input)."""
 
     updated_at: float = 0.0
+    # Sender-side monotonic stamp (time.monotonic() on the PUBLISHING
+    # node), refreshed with every heartbeat. Meaningless to compare
+    # across machines directly — receivers only watch whether it
+    # ADVANCES (LocalNode.is_available), which no clock step can fake.
+    mono_at: float = 0.0
     started_at: float = field(default_factory=time.time)
     num_rooms: int = 0
     num_clients: int = 0
@@ -86,6 +97,15 @@ class LocalNode:
     state: NodeState = NodeState.SERVING
     stats: NodeStats = field(default_factory=NodeStats)
 
+    # Receiver-side freshness observations, process-wide: node_id →
+    # (newest sender mono_at seen, OUR monotonic clock when it first
+    # appeared). Freshness is judged entirely on the RECEIVER's clock —
+    # a peer whose wall clock stepped hours is neither falsely killed
+    # (its advancing mono_at keeps refreshing the entry) nor falsely
+    # alive (a dead node's stamp stops advancing and the entry ages on
+    # our clock). Bounded by cluster size: one entry per node ever seen.
+    _freshness: ClassVar[dict[str, tuple[float, float]]] = {}
+
     def to_dict(self) -> dict:
         d = {
             "node_id": self.node_id,
@@ -108,8 +128,21 @@ class LocalNode:
         )
 
     def is_available(self, max_age: float = 30.0) -> bool:
-        """selector/interfaces.go IsAvailable — serving + fresh stats."""
-        return (
-            self.state == NodeState.SERVING
-            and time.time() - self.stats.updated_at < max_age
-        )
+        """selector/interfaces.go IsAvailable — serving + fresh stats.
+
+        Skew-tolerant: peers publishing a monotonic heartbeat stamp are
+        judged by whether that stamp still ADVANCES, timed on the
+        receiver's own clock; the wall-clock comparison survives only as
+        a widened fallback for stamp-less peers."""
+        if self.state != NodeState.SERVING:
+            return False
+        mono = self.stats.mono_at
+        if mono:
+            seen = LocalNode._freshness.get(self.node_id)
+            now = time.monotonic()
+            if seen is None or mono > seen[0]:
+                LocalNode._freshness[self.node_id] = (mono, now)
+                return True
+            return now - seen[1] < max_age
+        delta = time.time() - self.stats.updated_at
+        return delta < max_age + SKEW_ALLOWANCE_S

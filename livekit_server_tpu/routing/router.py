@@ -174,6 +174,14 @@ class KVRouter(LocalRouter):
         self.bus = bus
         self.stats_interval = stats_interval
         self.lease_ttl = lease_ttl
+        # Ownership fence (routing/fleet.py RoomFence), attached by the
+        # fleet plane. When present, every pin move rides an epoch CAS.
+        self.fence = None
+        # Monotonic stamp of the last lease refresh that reached the bus,
+        # plus an async observer fed after EVERY attempt (ok or not) —
+        # the self-fencing signal (service/fleetplane.py LeaseGuard).
+        self.last_lease_ok = time.monotonic()
+        self.on_lease: Callable[[bool], Awaitable[None]] | None = None
         self._stats_task: asyncio.Task | None = None
         self._session_task: asyncio.Task | None = None
         self._session_sub = None
@@ -210,10 +218,27 @@ class KVRouter(LocalRouter):
         while True:
             await asyncio.sleep(self.stats_interval)
             self.local_node.stats.updated_at = time.time()
-            await self.bus.hset(
-                NODES_KEY, self.local_node.node_id, json.dumps(self.local_node.to_dict())
-            )
-            await self.bus.set(self._lease_key(self.local_node.node_id), "1", self.lease_ttl)
+            self.local_node.stats.mono_at = time.monotonic()
+            ok = True
+            try:
+                await self.bus.hset(
+                    NODES_KEY, self.local_node.node_id, json.dumps(self.local_node.to_dict())
+                )
+                await self.bus.set(self._lease_key(self.local_node.node_id), "1", self.lease_ttl)
+            except asyncio.CancelledError:
+                raise
+            except Exception:  # noqa: BLE001 — a severed/partitioned bus
+                # must not kill the heartbeat task: the failed attempt IS
+                # the signal the lease observer fences on, and the worker
+                # must keep probing so recovery is observed too.
+                ok = False
+            if ok:
+                self.last_lease_ok = time.monotonic()
+            if self.on_lease is not None:
+                try:
+                    await self.on_lease(ok)
+                except Exception:  # noqa: BLE001 — observer bugs must not
+                    pass           # stop the lease heartbeat itself
 
     async def list_nodes(self) -> list[LocalNode]:
         raw = await self.bus.hgetall(NODES_KEY)
@@ -224,9 +249,25 @@ class KVRouter(LocalRouter):
         return await self.bus.hget(NODE_ROOM_KEY, room_name) or ""
 
     async def set_node_for_room(self, room_name: str, node_id: str) -> None:
+        """Move a room pin. With a fence attached the pin only moves
+        behind an epoch CAS: pinning to ourselves claims the next epoch,
+        pinning elsewhere (migration COMMIT) transfers it — so the pin
+        and the ownership epoch advance together and a concurrent
+        claimant makes this raise instead of silently split-braining."""
+        if self.fence is not None:
+            from livekit_server_tpu.routing.fleet import FencedWriteRejected
+
+            if node_id == self.local_node.node_id:
+                moved = await self.fence.claim(room_name)
+            else:
+                moved = await self.fence.transfer(room_name, node_id)
+            if not moved:
+                raise FencedWriteRejected(room_name)
         await self.bus.hset(NODE_ROOM_KEY, room_name, node_id)
 
     async def clear_room_state(self, room_name: str) -> None:
+        if self.fence is not None and self.fence.owns(room_name):
+            await self.fence.release(room_name)
         await self.bus.hdel(NODE_ROOM_KEY, room_name)
 
     async def try_takeover(self, room_name: str, dead_node_id: str = "") -> str:
@@ -239,7 +280,20 @@ class KVRouter(LocalRouter):
         lock_key = f"takeover:{room_name}"
         for _ in range(10):
             if await self.bus.setnx(lock_key, self.local_node.node_id, 5.0):
-                await self.set_node_for_room(room_name, self.local_node.node_id)
+                from livekit_server_tpu.routing.fleet import FencedWriteRejected
+
+                try:
+                    await self.set_node_for_room(room_name, self.local_node.node_id)
+                except FencedWriteRejected:
+                    # The epoch CAS lost to a restorer on the other
+                    # election path (the orchestrator's create-lock):
+                    # back off cleanly to whoever holds the epoch now.
+                    await self.bus.delete(lock_key)
+                    if self.fence is not None:
+                        _epoch, holder = await self.fence.read(room_name)
+                        if holder:
+                            return holder
+                    return await self.get_node_for_room(room_name) or self.local_node.node_id
                 await self.bus.delete(lock_key)
                 from livekit_server_tpu.utils.logger import log
 
@@ -417,9 +471,12 @@ class KVRouter(LocalRouter):
 
 
 def create_router(
-    local_node: LocalNode, bus: MessageBus | None, lease_ttl: float = 6.0
+    local_node: LocalNode,
+    bus: MessageBus | None,
+    lease_ttl: float = 6.0,
+    stats_interval: float = 2.0,
 ) -> Router:
     """interfaces.go:116 CreateRouter — bus present ⇒ distributed."""
     if bus is None:
         return LocalRouter(local_node)
-    return KVRouter(local_node, bus, lease_ttl=lease_ttl)
+    return KVRouter(local_node, bus, stats_interval=stats_interval, lease_ttl=lease_ttl)

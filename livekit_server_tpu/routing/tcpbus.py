@@ -28,6 +28,7 @@ import asyncio
 import fnmatch
 import hmac
 import json
+from collections import deque
 from typing import Any
 
 from livekit_server_tpu.routing.kv import MemoryBus, Subscription
@@ -69,7 +70,44 @@ class BusServer:
         self.server: asyncio.AbstractServer | None = None
         # writer → {pattern, ...}
         self._subs: dict[asyncio.StreamWriter, set[str]] = {}
+        # writer → node ident (the "ident" op; fault-injection partitions
+        # sever by node, and a client survives reconnects by re-identing).
+        self._idents: dict[asyncio.StreamWriter, str] = {}
+        # Partition injection (FaultSpec.bus_partition_groups): idents NOT
+        # in group 0 lose the bus — every op errors (their clients see a
+        # non-retried RuntimeError, so leases lapse fast) and no pushes
+        # flow to or from them. Asym pairs (src, dst) additionally hold
+        # src→dst pushes in a bounded buffer flushed on heal — the
+        # deterministic "COMMIT arrives after the heal" drill primitive.
+        self._severed: set[str] = set()
+        self._asym: set[tuple[str, str]] = set()
+        self._held: deque = deque(maxlen=256)  # (writer, pattern, channel, msg)
         self.stats = {"conns": 0, "ops": 0, "published": 0}
+
+    # -- partition injection (deterministic, driven by the fault harness) --
+    def set_partition(self, groups, asym_pairs=()) -> None:
+        """Sever node subsets: `groups` is an iterable of ident groups;
+        group 0 keeps the bus (the bus process lives on the majority
+        side), every other group loses it entirely. Idents that appear in
+        no group (test-harness utility clients) stay connected."""
+        self._severed = set()
+        for i, g in enumerate(groups):
+            if i > 0:
+                self._severed |= {str(n) for n in g}
+        self._asym = {(str(a), str(b)) for a, b in asym_pairs}
+        self.stats["partitions"] = self.stats.get("partitions", 0) + 1
+
+    def heal_partition(self) -> None:
+        """Reconnect everyone and flush pushes held on asym pairs, in
+        capture order — held messages arrive AFTER everything published
+        during the partition, exactly like a delayed link coming back."""
+        self._severed = set()
+        self._asym = set()
+        held, self._held = list(self._held), deque(maxlen=256)
+        for w, pat, channel, msg in held:
+            if not w.is_closing():
+                w.write(_frame({"p": pat, "c": channel, "m": msg}))
+        self.stats["heals"] = self.stats.get("heals", 0) + 1
 
     async def start(self, host: str = "127.0.0.1", port: int = 7850) -> None:
         self.server = await asyncio.start_server(self._handle, host, port)
@@ -114,10 +152,19 @@ class BusServer:
             pass
         finally:
             self._subs.pop(writer, None)
+            self._idents.pop(writer, None)
             writer.close()
 
     async def _dispatch(self, writer, op: str, a: list):
         s = self.state
+        if op == "ident":
+            self._idents[writer] = str(a[0])
+            return None
+        if self._severed and self._idents.get(writer, "") in self._severed:
+            # The severed side sees every op fail, not time out: the error
+            # frame surfaces as a non-retried RuntimeError client-side, so
+            # a partitioned node's lease refresh fails within one beat.
+            raise RuntimeError("bus partitioned")
         if op == "hset":
             await s.hset(a[0], a[1], a[2])
         elif op == "hget":
@@ -134,8 +181,10 @@ class BusServer:
             await s.delete(a[0])
         elif op == "setnx":
             return await s.setnx(a[0], a[1], a[2])
+        elif op == "cas":
+            return await s.cas(a[0], a[1], a[2], a[3])
         elif op == "pub":
-            return self._publish(a[0], a[1])
+            return self._publish(a[0], a[1], sender=self._idents.get(writer, ""))
         elif op == "sub":
             self._subs[writer].add(a[0])
         elif op == "unsub":
@@ -146,14 +195,22 @@ class BusServer:
             raise ValueError(f"unknown op {op}")
         return None
 
-    def _publish(self, channel: str, msg: Any) -> int:
+    def _publish(self, channel: str, msg: Any, sender: str = "") -> int:
         n = 0
         for w, patterns in list(self._subs.items()):
+            dst = self._idents.get(w, "")
+            if self._severed and dst in self._severed:
+                continue  # receiver is on the dark side of the partition
             for pat in patterns:
                 if pat == channel or (
                     ("*" in pat or "?" in pat) and fnmatch.fnmatch(channel, pat)
                 ):
                     if w.is_closing():
+                        continue
+                    if sender and (sender, dst) in self._asym:
+                        # One-way link failure: hold (not drop) until heal.
+                        self._held.append((w, pat, channel, msg))
+                        self.stats["held"] = self.stats.get("held", 0) + 1
                         continue
                     # Bounded like Subscription's drop-on-overflow queue: a
                     # stalled subscriber drops pushes instead of growing
@@ -190,6 +247,7 @@ class TCPBusClient:
         self._writer = writer
         self._host, self._port, self._token = host, port, token
         self._next_id = 0
+        self._ident = ""  # node identity announced via set_ident
         self._pending: dict[int, asyncio.Future] = {}
         self._subs: dict[str, list[Subscription]] = {}
         self._task = asyncio.ensure_future(self._read_loop())
@@ -282,6 +340,10 @@ class TCPBusClient:
                 self._send("auth", self._token).add_done_callback(
                     lambda f: f.exception()
                 )
+            if self._ident:
+                self._send("ident", self._ident).add_done_callback(
+                    lambda f: f.exception()
+                )
             for channel in self._subs:
                 self._send("sub", channel).add_done_callback(
                     lambda f: f.exception()
@@ -353,8 +415,23 @@ class TCPBusClient:
     async def setnx(self, key, value, ttl=None):
         return await self._call("setnx", key, value, ttl)
 
+    async def cas(self, key, expect, value, ttl=None):
+        return await self._call("cas", key, expect, value, ttl)
+
     async def publish(self, channel, msg):
         return await self._call("pub", channel, msg)
+
+    def set_ident(self, node_id: str) -> None:
+        """Name this connection to the bus (fire-and-forget, like
+        subscribe): partitions sever by node ident, and _reconnect
+        re-idents so the identity survives transport churn."""
+        self._ident = node_id
+        try:
+            self._send("ident", node_id).add_done_callback(
+                lambda f: f.exception()
+            )
+        except ConnectionError:
+            pass  # re-sent by _reconnect once the transport is back
 
     def subscribe(self, channel: str, size: int = 200) -> Subscription:
         """Synchronous like MemoryBus.subscribe: the SUB frame goes on the

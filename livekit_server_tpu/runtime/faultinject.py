@@ -73,6 +73,17 @@ class FaultSpec:
     mig_corrupt_handoff: bool = False
     # source's first N commit phases fail their bus ops (sever drill).
     mig_sever_handoffs: int = 0
+    # Bus-partition drills (BusServer.set_partition seam): node-id groups
+    # to sever from each other at bus_partition_tick — group 0 keeps the
+    # bus, later groups lose every KV op and pub/sub push (the minority
+    # side of a split-brain). Healed at bus_heal_at_tick (-1 = never).
+    # bus_asym_pairs lists (src, dst) node-id pairs whose pushes are
+    # HELD and delivered in order on heal — the stale-message-after-heal
+    # drill (e.g. a migration COMMIT landing after its epoch died).
+    bus_partition_groups: tuple = ()
+    bus_partition_tick: int = -1
+    bus_heal_at_tick: int = -1
+    bus_asym_pairs: tuple = ()
 
 
 @dataclass
@@ -90,6 +101,8 @@ class FaultStats:
     mig_acks_delayed: int = 0        # ACKs slept past the source timeout
     mig_handoffs_corrupted: int = 0  # PREPARE snapshots damaged in flight
     mig_commits_severed: int = 0     # commit phases failed at the bus seam
+    partitions: int = 0              # bus partitions installed by the tick seam
+    heals: int = 0                   # partitions healed by the tick seam
 
 
 class FaultInjector:
@@ -128,6 +141,12 @@ class FaultInjector:
             mig_ack_delay_s=cfg.mig_ack_delay_s,
             mig_corrupt_handoff=cfg.mig_corrupt_handoff,
             mig_sever_handoffs=cfg.mig_sever_handoffs,
+            bus_partition_groups=tuple(
+                tuple(g) for g in cfg.bus_partition_groups
+            ),
+            bus_partition_tick=cfg.bus_partition_tick,
+            bus_heal_at_tick=cfg.bus_heal_at_tick,
+            bus_asym_pairs=tuple(tuple(p) for p in cfg.bus_asym_pairs),
         ))
 
     # -- ingest-boundary packet faults -----------------------------------
@@ -285,6 +304,30 @@ class FaultInjector:
         self.stats.mig_commits_severed += 1
         return True
 
+    # -- bus-partition drills (routing/tcpbus.py BusServer seam) ----------
+    def bus_partition_tick(self, bus_server, tick_index: int) -> None:
+        """Deterministic sever/heal on the tick clock: install the
+        configured partition at bus_partition_tick, heal it at
+        bus_heal_at_tick. Driven by whichever test/bench owns both the
+        BusServer and a tick counter; idempotent across repeat calls for
+        the same tick."""
+        s = self.spec
+        if not s.bus_partition_groups:
+            return
+        if tick_index == s.bus_partition_tick and not bus_server._severed:
+            bus_server.set_partition(
+                [list(g) for g in s.bus_partition_groups],
+                asym_pairs=s.bus_asym_pairs,
+            )
+            self.stats.partitions += 1
+        if (
+            s.bus_heal_at_tick >= 0
+            and tick_index == s.bus_heal_at_tick
+            and (bus_server._severed or bus_server._asym)
+        ):
+            bus_server.heal_partition()
+            self.stats.heals += 1
+
     # -- infrastructure faults (chaos-test helpers) ----------------------
     def sever_bus(self, client) -> None:
         """Hard-drop a TCPBusClient's socket (no FIN handshake): in-flight
@@ -317,6 +360,9 @@ class FaultInjector:
         failover = getattr(server.room_manager, "_failover_task", None)
         if failover is not None:
             failover.cancel()
+        fleet = getattr(server.room_manager, "fleet", None)
+        if fleet is not None:
+            await fleet.stop()
         bus = getattr(router, "bus", None)
         if bus is not None and hasattr(bus, "_writer"):
             bus.closed = True  # suppress the reconnect loop: the node is dead

@@ -96,6 +96,10 @@ class PlaneSupervisor:
         # stop. The watchdog must not read that as a stall and "restore"
         # rooms the drain just handed off.
         self.draining = False
+        # Self-fenced (service/fleetplane.py quorum loss): restarts are
+        # quiesced the same way — a restart would restore rooms from KV
+        # checkpoints that may already belong to the takeover winner.
+        self.fenced = False
         self._attempts = 0           # consecutive restarts without health
         self._requested_restart = "" # set by request_restart(), watchdog-consumed
         self._watch_task: asyncio.Task | None = None
@@ -221,8 +225,10 @@ class PlaneSupervisor:
     async def _watchdog(self) -> None:
         while True:
             await asyncio.sleep(self.check_interval_s)
-            if self.draining:
-                continue  # quiescing on purpose: never restart a drain
+            if self.draining or self.fenced:
+                # Quiescing on purpose: never restart a drain or a
+                # fenced minority that must stay silent.
+                continue
             cause = "stall"
             reason = self._requested_restart
             if reason:

@@ -54,6 +54,7 @@ from collections import deque
 from dataclasses import dataclass, field
 
 from livekit_server_tpu.protocol import models as pm
+from livekit_server_tpu.routing.fleet import FencedWriteRejected
 from livekit_server_tpu.routing.node import NodeState
 from livekit_server_tpu.routing.selector import NoNodesAvailable
 from livekit_server_tpu.rtc.room import Room
@@ -193,6 +194,10 @@ class MigrationOrchestrator:
             "stale_commits": 0, "adoptions": 0, "commits_in": 0,
             "adoptions_released": 0, "bridged_out": 0, "bridged_in": 0,
             "bridge_dropped": 0, "drains": 0,
+            # Handoffs whose ownership epoch was claimed away mid-flight
+            # by a failover restorer (routing/fleet.py): the local
+            # replica is closed by the fence, not rolled back.
+            "fenced_handoffs": 0,
         }
 
     # -- lifecycle --------------------------------------------------------
@@ -370,8 +375,27 @@ class MigrationOrchestrator:
             ) as e:
                 verdict, reason = "timeout", f"{type(e).__name__}: {e}"
             if verdict == "ack":
-                if await self._commit(name, target, room, bridge, epoch):
-                    return True
+                try:
+                    if await self._commit(name, target, room, bridge, epoch):
+                        return True
+                except FencedWriteRejected:
+                    # A failover restorer claimed a higher epoch mid-
+                    # commit: the fence's on_lost already closed the
+                    # local replica, so there is nothing to roll back
+                    # INTO. Abort the target's adoption and stand down.
+                    self.stats["fenced_handoffs"] += 1
+                    try:
+                        await self._send(
+                            target,
+                            {"kind": "abort", "room": name, "epoch": epoch},
+                        )
+                    except (ConnectionError, OSError):
+                        pass   # target's adopt TTL reaps it
+                    self.log.warn(
+                        "handoff fenced out by a higher ownership epoch",
+                        room=name, target=target[:12],
+                    )
+                    return False
                 reason = "commit failed: bus error"
             elif verdict == "nack":
                 self.stats["nacks_received"] += 1
@@ -523,6 +547,16 @@ class MigrationOrchestrator:
             await self.router.set_node_for_room(name, me)
         except (ConnectionError, OSError):
             pass   # bus down: lease failover will converge the pin
+        except FencedWriteRejected:
+            # A higher epoch owns the room now (takeover raced the
+            # rollback): the fence's on_lost just closed — and popped —
+            # the replica re-registered above. Stand down entirely; the
+            # epoch holder serves the room.
+            self.stats["fenced_handoffs"] += 1
+            self.log.warn(
+                "rollback fenced out by a higher ownership epoch", room=name
+            )
+            return
         try:
             await self._send(
                 target, {"kind": "abort", "room": name, "epoch": epoch}
@@ -716,6 +750,16 @@ class MigrationOrchestrator:
             return
         del self._adoptions[name]
         self.stats["commits_in"] += 1
+        # The source's COMMIT repin transferred the ownership epoch to
+        # us; adopt the record now so our own checkpoint writes are
+        # fenced under it (guarded writes would auto-assume lazily, but
+        # an explicit adopt keeps /debug/fleet truthful immediately).
+        fence = getattr(self.router, "fence", None)
+        if fence is not None:
+            try:
+                await fence.assume(name)
+            except (ConnectionError, OSError):
+                pass   # lazy auto-assume covers it on the next write
         room = self.mgr.rooms.get(name)
         # Replay the source's freeze window first, then whatever arrived
         # here directly while the row was frozen — monotonic SN order, so

@@ -25,6 +25,7 @@ from livekit_server_tpu.protocol.signal import (
     decode_signal_request,
     encode_signal_response,
 )
+from livekit_server_tpu.routing.fleet import FencedWriteRejected
 from livekit_server_tpu.routing.messagechannel import (
     ChannelClosed,
     ChannelFull,
@@ -201,6 +202,11 @@ class RoomManager:
         self._ckpt_gens = max(1, integ.checkpoint_generations)
         self._ckpt_history: dict[str, list[str]] = {}
         self.ckpt_fallbacks = 0  # room-restore generations rejected
+        # Fired for every checkpoint/snapshot adoption (failover restore
+        # and migration alike); subscription masks never travel in a
+        # snapshot (restore_room docstring), so this is where re-attach
+        # logic — and the drills standing in for it — re-subscribes.
+        self.on_adopt: list = []
         # Live migration plane (service/migration.py): two-phase room
         # handoff + node drain. Needs a shared bus to talk to peers —
         # a bus-less single-node router runs without it.
@@ -209,6 +215,15 @@ class RoomManager:
             from livekit_server_tpu.service.migration import MigrationOrchestrator
 
             self.migration = MigrationOrchestrator(self)
+        # Fleet coordination plane (service/fleetplane.py): epoch-fenced
+        # room ownership, self-fencing on lease loss, elected failover
+        # and the load rebalancer. Needs a shared bus AND a router that
+        # runs the lease loop (KVRouter) — single-node runs without it.
+        self.fleet = None
+        if config.fleet.enabled and hasattr(router, "on_lease"):
+            from livekit_server_tpu.service.fleetplane import FleetPlane
+
+            self.fleet = FleetPlane(self)
         router.on_new_session(self.start_session)
         self._update_node_stats()
 
@@ -244,7 +259,19 @@ class RoomManager:
             self.rooms[name] = room
             self._row_to_room[room.slots.row] = room
             await self.store.store_room(room.info)
-            await self.router.set_node_for_room(name, self.router.local_node.node_id)
+            try:
+                await self.router.set_node_for_room(
+                    name, self.router.local_node.node_id
+                )
+            except FencedWriteRejected:
+                # Lost the ownership election: another node claimed a
+                # higher epoch between our admission check and the pin.
+                # Tear the half-created replica down and refuse — the
+                # epoch holder serves this room.
+                self.rooms.pop(name, None)
+                self._row_to_room.pop(room.slots.row, None)
+                room.close(pm.DisconnectReason.MIGRATION)
+                raise CapacityError("room owned by another node")
         self._create_locks.pop(name, None)
         self._update_node_stats()
         from livekit_server_tpu.runtime.trace import EV_ROOM_OPEN
@@ -277,18 +304,21 @@ class RoomManager:
             self.log.info("room finished", room=name)
             self._notify("room_finished", room=room.info.to_dict())
         await self.store.delete_room(name)
-        await self.router.clear_room_state(name)
         bus = getattr(self.router, "bus", None)
         if bus is not None:
             # A deliberate delete must also retire the failover checkpoint
             # — every generation of it — or a same-name room created
             # within CHECKPOINT_TTL_S would adopt the dead room's SN/TS
-            # lanes.
+            # lanes. Runs BEFORE clear_room_state releases the ownership
+            # epoch, so the deletes go out under our own fence.
             try:
                 for key in self._checkpoint_keys(name):
-                    await bus.delete(key)
+                    await self._fenced_delete(name, key)
+            except FencedWriteRejected:
+                pass   # new owner's checkpoints are theirs to retire
             except (ConnectionError, OSError):
                 pass
+        await self.router.clear_room_state(name)
         self._ckpt_history.pop(name, None)
         self._update_node_stats()
 
@@ -297,6 +327,26 @@ class RoomManager:
         return [f"room_checkpoint:{name}"] + [
             f"room_checkpoint:{name}:g{i}" for i in range(1, self._ckpt_gens)
         ]
+
+    # The ONLY writers for room-checkpoint/snapshot KV keys (graftcheck
+    # GC09 fencing discipline): with the fleet plane up every write
+    # CAS-asserts this node's ownership epoch first, so a stale owner's
+    # checkpoint loses (FencedWriteRejected) instead of clobbering the
+    # takeover winner's state. Without a fleet (single node, fleet
+    # disabled) they fall through to the raw bus.
+    async def _fenced_set(
+        self, room_name: str, key: str, value: str, ttl: float | None = None
+    ) -> None:
+        if self.fleet is not None:
+            await self.fleet.fence.guarded_set(room_name, key, value, ttl)
+        else:
+            await self.router.bus.set(key, value, ttl)
+
+    async def _fenced_delete(self, room_name: str, key: str) -> None:
+        if self.fleet is not None and self.fleet.fence.owns(room_name):
+            await self.fleet.fence.guarded_delete(room_name, key)
+        else:
+            await self.router.bus.delete(key)
 
     # -- session handling (roommanager.go StartSession) -------------------
     async def start_session(
@@ -446,7 +496,12 @@ class RoomManager:
         lim = self.config.limits
         st = self.router.local_node.stats
         reason = ""
-        if self.migration is not None and self.migration.draining:
+        if self.fleet is not None and self.fleet.fenced:
+            # Quorum lost: this node may already have been failed over
+            # by the majority side — admitting anything here would build
+            # state a survivor is about to own.
+            reason = "node fenced (quorum lost)"
+        elif self.migration is not None and self.migration.draining:
             # Drain works with the governor disabled too: the orchestrator
             # itself refuses every admission kind while rooms move off.
             reason = "node draining"
@@ -555,7 +610,8 @@ class RoomManager:
             # leaves the room fully serving on this node — never pop a
             # room whose state only exists in a packet that didn't land.
             try:
-                await bus.set(
+                await self._fenced_set(
+                    name,
                     f"room_snapshot:{name}",
                     self.runtime.encode_room_snapshot(snap),
                     self.config.migration.snapshot_ttl_s,
@@ -564,6 +620,11 @@ class RoomManager:
                     await self.router.set_node_for_room(name, target_node_id)
                 else:
                     await self.router.clear_room_state(name)
+            except FencedWriteRejected:
+                # Ownership already moved to a higher epoch — the
+                # fence's on_lost callback closed the local replica;
+                # there is nothing left here to hand off.
+                return False
             except (ConnectionError, OSError) as e:
                 self.log.warn(
                     "handoff aborted; room keeps serving here",
@@ -622,6 +683,8 @@ class RoomManager:
 
         if pending:
             room.on_track_published.append(_resync)
+        for cb in list(self.on_adopt):
+            cb(room)
 
     async def _maybe_restore_room(self, room: Room) -> None:
         """Adopt a migrated room's device state if a snapshot is waiting on
@@ -664,12 +727,21 @@ class RoomManager:
             return
 
     # -- supervision & failover (tentpole of the supervised media plane) --
-    async def checkpoint_rooms(self) -> None:
+    async def checkpoint_rooms(self, force_fenced: bool = False) -> None:
         """Publish every live room's row snapshot to the KV bus — the seed
         a surviving node restores from if this node dies. Runs on the
-        PlaneSupervisor's checkpoint cadence."""
+        PlaneSupervisor's checkpoint cadence.
+
+        A self-fenced node freezes this entirely (a survivor may hold
+        newer state; our write would clobber it) — except the recovery
+        reconcile, which calls with ``force_fenced=True`` exactly BECAUSE
+        each write CAS-asserts ownership: every room a survivor took
+        raises FencedWriteRejected, closing the local replica, and every
+        still-owned room gets a fresh checkpoint."""
         bus = getattr(self.router, "bus", None)
         if bus is None:
+            return
+        if self.fleet is not None and self.fleet.fenced and not force_fenced:
             return
         async with self._ckpt_lock:
             for name, room in list(self.rooms.items()):
@@ -689,8 +761,11 @@ class RoomManager:
                 hist = self._ckpt_history.setdefault(name, [])
                 hist.insert(0, payload)
                 del hist[self._ckpt_gens:]
-                for key, gen_payload in zip(self._checkpoint_keys(name), hist):
-                    await bus.set(key, gen_payload, CHECKPOINT_TTL_S)
+                try:
+                    for key, gen_payload in zip(self._checkpoint_keys(name), hist):
+                        await self._fenced_set(name, key, gen_payload, CHECKPOINT_TTL_S)
+                except FencedWriteRejected:
+                    continue  # room lost: on_lost closed the replica
 
     async def _failover_worker(self) -> None:
         """Scan for rooms pinned to dead nodes (lapsed liveness lease,
@@ -702,6 +777,19 @@ class RoomManager:
         interval = self.config.kv.failover_interval_s
         while True:
             await asyncio.sleep(interval)
+            if self.fleet is not None:
+                # Elected restore path (exactly one winner per room via
+                # create-lock + epoch CAS); a fenced node sits scans out
+                # — it must not restore rooms it may be about to lose.
+                if not self.fleet.fenced:
+                    try:
+                        await self.fleet.orchestrator.run_once()
+                    except asyncio.CancelledError:
+                        raise
+                    except Exception as e:  # noqa: BLE001 — scan must
+                        # not kill the loop; next interval retries.
+                        self.log.warn("failover scan failed", error=str(e))
+                continue
             try:
                 dead = await self.router.dead_room_pins()
             except (ConnectionError, OSError):
@@ -741,6 +829,14 @@ class RoomManager:
 
     # -- tick fan-out -----------------------------------------------------
     def _dispatch_tick(self, res: TickResult) -> None:
+        if self.fleet is not None and self.fleet.fenced:
+            # Self-fenced: drop this tick's egress wholesale (UDP batch,
+            # WS packets, padding, speaker/keyframe fan-out). The
+            # majority side may already be serving these rooms —
+            # double-forwarding is exactly the split-brain failure the
+            # fleet plane exists to prevent.
+            self.fleet.stats["muted_ticks"] += 1
+            return
         if self.udp is not None:
             # Batch wire path: one native call assembles/seals/sends every
             # UDP-destined entry; only WS-destined entries materialize as
@@ -861,6 +957,8 @@ class RoomManager:
             self._failover_task = asyncio.ensure_future(self._failover_worker())
         if self.migration is not None:
             self.migration.start()
+        if self.fleet is not None:
+            self.fleet.start()
 
     async def _reaper(self) -> None:
         while True:
@@ -875,6 +973,8 @@ class RoomManager:
                     p.reap_stale_publications()
 
     async def stop(self) -> None:
+        if self.fleet is not None:
+            await self.fleet.stop()
         if self.migration is not None:
             await self.migration.stop()
         if self.supervisor is not None:

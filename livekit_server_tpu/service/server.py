@@ -81,6 +81,7 @@ class LivekitServer:
         self.app.router.add_get("/debug/integrity", self.debug_integrity)
         self.app.router.add_get("/debug/egress", self.debug_egress)
         self.app.router.add_get("/debug/migration", self.debug_migration)
+        self.app.router.add_get("/debug/fleet", self.debug_fleet)
         self.app.router.add_get("/debug/trace", self.debug_trace)
         self.app.router.add_get("/debug/blackbox/{room}", self.debug_blackbox)
         self._runner: web.AppRunner | None = None
@@ -319,6 +320,17 @@ class LivekitServer:
             }
         )
 
+    async def debug_fleet(self, request: web.Request) -> web.Response:
+        """Fleet-plane state: fence flag + lease age, owned room epochs,
+        and the fencing / failover-election / rebalance counters."""
+        fleet = self.room_manager.fleet
+        return web.json_response(
+            {
+                "enabled": fleet is not None,
+                "fleet": fleet.snapshot() if fleet is not None else None,
+            }
+        )
+
     async def debug_migration(self, request: web.Request) -> web.Response:
         """Migration-plane state: drain flag, in-flight handoffs with
         their epochs, pending adoptions, and the lifetime counters
@@ -435,6 +447,12 @@ class LivekitServer:
 
     # -- lifecycle --------------------------------------------------------
     async def start(self) -> None:
+        # Identify this node's bus connection to the BusServer before any
+        # other op: the partition-injection harness severs/heals by node
+        # id, and pub/sub sender attribution needs it.
+        bus = getattr(self.router, "bus", None)
+        if bus is not None and hasattr(bus, "set_ident"):
+            bus.set_ident(self.router.local_node.node_id)
         await self.router.register_node()
         if hasattr(self.router, "remove_dead_nodes"):
             await self.router.remove_dead_nodes()
@@ -644,7 +662,11 @@ def create_server(config: Config, bus=None, mesh=None) -> LivekitServer:
         store = LocalStore()
     else:
         bus = bus if bus is not None else MemoryBus()
-        router = create_router(node, bus, lease_ttl=config.kv.lease_ttl_s)
+        router = create_router(
+            node, bus,
+            lease_ttl=config.kv.lease_ttl_s,
+            stats_interval=config.kv.stats_interval_s,
+        )
         store = KVStore(bus)
     telemetry = TelemetryService(config)
     rm = RoomManager(config, router, store, mesh=mesh, telemetry=telemetry)

@@ -1,0 +1,292 @@
+"""Fleet-plane primitives: epoch CAS, RoomFence, LeaseGuard, skew-
+tolerant liveness, and the fleet/fault config surface.
+
+These are the single-process units behind the split-brain drills in
+tests/test_multinode.py: exactly-one-winner claims, fenced stale
+writes, fence/recover transitions, and the monotonic-heartbeat
+freshness rule — all on a MemoryBus, no sockets.
+"""
+
+from __future__ import annotations
+
+import time
+
+import pytest
+
+from livekit_server_tpu.config import ConfigError, load_config
+from livekit_server_tpu.routing.fleet import (
+    ROOM_EPOCH_PREFIX,
+    FencedWriteRejected,
+    LeaseGuard,
+    RoomFence,
+)
+from livekit_server_tpu.routing.kv import MemoryBus
+from livekit_server_tpu.routing.node import (
+    SKEW_ALLOWANCE_S,
+    LocalNode,
+    NodeState,
+    NodeStats,
+)
+from livekit_server_tpu.runtime.faultinject import FaultInjector
+
+
+# -- bus.cas ----------------------------------------------------------------
+
+async def test_cas_absent_expect_and_mismatch():
+    bus = MemoryBus()
+    # expect=None means "key absent": only one creator wins.
+    assert await bus.cas("k", None, "a")
+    assert not await bus.cas("k", None, "b")
+    assert await bus.get("k") == "a"
+    # exact-string compare; a stale expect loses without writing
+    assert not await bus.cas("k", "stale", "c")
+    assert await bus.cas("k", "a", "c")
+    assert await bus.get("k") == "c"
+
+
+async def test_cas_expired_key_counts_as_absent():
+    bus = MemoryBus()
+    await bus.set("k", "a", ttl=0.01)
+    time.sleep(0.03)
+    assert not await bus.cas("k", "a", "b")   # value expired away
+    assert await bus.cas("k", None, "b")
+
+
+# -- RoomFence --------------------------------------------------------------
+
+async def test_claim_is_exactly_one_winner():
+    bus = MemoryBus()
+    a = RoomFence(bus, "node-a")
+    b = RoomFence(bus, "node-b")
+    assert await a.claim("r")
+    assert a.epoch_of("r") == 1
+    # b claims over a's live record: epoch moves to 2 and a's guarded
+    # writes are dead
+    assert await b.claim("r")
+    assert b.epoch_of("r") == 2
+    assert (await a.read("r")) == (2, "node-b")
+    # idempotent re-claim while the record still names us
+    assert await b.claim("r")
+    assert b.epoch_of("r") == 2
+    assert b.stats["claims"] == 1
+
+
+async def test_claim_race_from_same_record():
+    bus = MemoryBus()
+    a = RoomFence(bus, "node-a")
+    b = RoomFence(bus, "node-b")
+    dead = RoomFence(bus, "node-dead")
+    assert await dead.claim("r")
+    # both survivors race a takeover from the dead node's record: the
+    # epoch CAS admits exactly one
+    key = ROOM_EPOCH_PREFIX + "r"
+    cur = await bus.get(key)
+    won_a = await bus.cas(key, cur, '{"e":2,"n":"node-a"}')
+    won_b = await bus.cas(key, cur, '{"e":2,"n":"node-b"}')
+    assert [won_a, won_b].count(True) == 1
+
+
+async def test_assume_adopts_but_never_steals():
+    bus = MemoryBus()
+    a = RoomFence(bus, "node-a")
+    b = RoomFence(bus, "node-b")
+    # unclaimed → assume claims
+    assert await a.assume("r")
+    assert a.owns("r")
+    # record names someone else → a recovered fenced node must NOT
+    # steal it back
+    assert await b.claim("r")
+    a.forget("r")
+    assert not await a.assume("r")
+    assert not a.owns("r")
+    # record names me (the target side of a transfer) → adopt
+    assert await b.transfer("r", "node-a")
+    assert await a.assume("r")
+    assert a.epoch_of("r") == 3
+
+
+async def test_guarded_write_fences_stale_owner():
+    bus = MemoryBus()
+    a = RoomFence(bus, "node-a")
+    b = RoomFence(bus, "node-b")
+    lost: list[str] = []
+    a.on_lost.append(lost.append)
+    assert await a.claim("r")
+    await a.guarded_set("r", "room_checkpoint:r:gen", "v1", 30.0)
+    assert await b.claim("r")       # takeover while a is dark
+    with pytest.raises(FencedWriteRejected):
+        await a.guarded_set("r", "room_checkpoint:r:gen", "v2-stale", 30.0)
+    # the stale write never landed, the loss was surfaced exactly once
+    assert await bus.get("room_checkpoint:r:gen") == "v1"
+    assert lost == ["r"]
+    assert not a.owns("r")
+    assert a.stats["writes_fenced"] == 1
+    # and a's guarded deletes are equally dead
+    with pytest.raises(FencedWriteRejected):
+        await a.guarded_delete("r", "room_checkpoint:r:gen")
+
+
+async def test_transfer_moves_epoch_and_kills_source_writes():
+    bus = MemoryBus()
+    src = RoomFence(bus, "node-src")
+    dst = RoomFence(bus, "node-dst")
+    assert await src.claim("r")
+    assert await src.transfer("r", "node-dst")
+    assert (await src.read("r")) == (2, "node-dst")
+    assert not src.owns("r")
+    assert await dst.assume("r")
+    with pytest.raises(FencedWriteRejected):
+        await src.guarded_set("r", "room_checkpoint:r:gen", "stale")
+
+
+async def test_transfer_losing_cas_fires_on_lost():
+    bus = MemoryBus()
+    src = RoomFence(bus, "node-src")
+    thief = RoomFence(bus, "node-thief")
+    lost: list[str] = []
+    src.on_lost.append(lost.append)
+    assert await src.claim("r")
+    assert await thief.claim("r")
+    assert not await src.transfer("r", "node-dst")
+    assert lost == ["r"]
+    assert (await src.read("r")) == (2, "node-thief")
+
+
+async def test_release_spares_racing_claimant():
+    bus = MemoryBus()
+    a = RoomFence(bus, "node-a")
+    b = RoomFence(bus, "node-b")
+    assert await a.claim("r")
+    await a.release("r")
+    assert (await a.read("r")) == (0, "")     # record gone
+    # release after a racing claim must not delete the winner's record
+    assert await a.claim("r")
+    assert await b.claim("r")
+    await a.release("r")
+    assert (await b.read("r")) == (2, "node-b")
+
+
+# -- LeaseGuard -------------------------------------------------------------
+
+def test_lease_guard_fence_and_recover():
+    clock = [0.0]
+    g = LeaseGuard(fence_grace_s=5.0, clock=lambda: clock[0])
+    assert g.observe(True) == ""
+    clock[0] = 3.0
+    assert g.observe(False) == ""          # within grace
+    clock[0] = 5.5
+    assert g.observe(False) == "fence"     # past grace: go silent
+    assert g.fenced and g.fences == 1
+    clock[0] = 9.0
+    assert g.observe(False) == ""          # already fenced, no re-fire
+    assert g.observe(True) == "recover"    # bus is back
+    # the caller unfences only AFTER reconciling lost rooms
+    assert g.fenced
+    g.unfence()
+    assert not g.fenced
+    assert g.observe(True) == ""
+    assert g.age() == 0.0
+
+
+def test_lease_guard_blip_within_grace_never_fences():
+    clock = [0.0]
+    g = LeaseGuard(fence_grace_s=5.0, clock=lambda: clock[0])
+    for t in (1.0, 2.0, 4.9):
+        clock[0] = t
+        assert g.observe(False) == ""
+    clock[0] = 5.0
+    assert g.observe(True) == ""           # refresh landed in time
+    clock[0] = 9.0
+    assert g.observe(False) == ""          # grace restarts from last ok
+
+
+# -- skew-tolerant liveness -------------------------------------------------
+
+def _peer(node_id: str, **stats) -> LocalNode:
+    return LocalNode(
+        node_id=node_id, state=NodeState.SERVING, stats=NodeStats(**stats)
+    )
+
+
+def test_is_available_monotonic_stamp_advances():
+    LocalNode._freshness.clear()
+    # wall clock is hours off — irrelevant while mono_at advances
+    peer = _peer("n1", updated_at=time.time() - 7200.0, mono_at=100.0)
+    assert peer.is_available(max_age=0.5)
+    peer.stats.mono_at = 101.0
+    assert peer.is_available(max_age=0.5)
+
+
+def test_is_available_frozen_stamp_ages_on_receiver_clock():
+    LocalNode._freshness.clear()
+    peer = _peer("n2", updated_at=time.time(), mono_at=100.0)
+    assert peer.is_available(max_age=0.05)   # first observation
+    time.sleep(0.08)
+    # stamp stopped advancing: dead by OUR clock, fresh wall time or not
+    peer.stats.updated_at = time.time()
+    assert not peer.is_available(max_age=0.05)
+
+
+def test_is_available_stampless_fallback_widened_by_skew():
+    LocalNode._freshness.clear()
+    skewed = time.time() - 1.0 - SKEW_ALLOWANCE_S / 2
+    peer = _peer("n3", updated_at=skewed, mono_at=0.0)
+    assert peer.is_available(max_age=1.0)    # inside widened window
+    peer.stats.updated_at = time.time() - 1.0 - SKEW_ALLOWANCE_S * 2
+    assert not peer.is_available(max_age=1.0)
+
+
+def test_is_available_not_serving_is_never_available():
+    LocalNode._freshness.clear()
+    peer = _peer("n4", updated_at=time.time(), mono_at=100.0)
+    peer.state = NodeState.SHUTTING_DOWN
+    assert not peer.is_available(max_age=30.0)
+
+
+# -- config surface ---------------------------------------------------------
+
+def _cfg(extra: str = ""):
+    return load_config(yaml_text="development: true\n" + extra)
+
+
+def test_fleet_config_defaults_and_validation():
+    cfg = _cfg()
+    assert cfg.fleet.enabled
+    assert cfg.fleet.fence_grace_s <= 2 * cfg.kv.lease_ttl_s
+    assert (
+        cfg.fleet.fence_grace_s
+        < cfg.kv.lease_ttl_s + cfg.kv.failover_interval_s
+    )
+    with pytest.raises(ConfigError, match="fence_grace_s"):
+        _cfg("fleet:\n  fence_grace_s: 0\n")
+    # grace beyond 2× lease_ttl: a blip could mute a healthy node too long
+    with pytest.raises(ConfigError, match="fence_grace_s"):
+        _cfg("fleet:\n  fence_grace_s: 100.0\n")
+    # grace must beat the earliest takeover (lease_ttl + failover_interval)
+    with pytest.raises(ConfigError, match="fence_grace_s"):
+        _cfg(
+            "fleet:\n  fence_grace_s: 8.0\n"
+            "kv:\n  lease_ttl_s: 6.0\n  failover_interval_s: 1.0\n"
+        )
+    # disabled fleet skips the timeline coupling
+    cfg = _cfg("fleet:\n  enabled: false\n  fence_grace_s: 100.0\n")
+    assert not cfg.fleet.enabled
+
+
+def test_fault_partition_config_maps_to_spec():
+    cfg = _cfg(
+        "faults:\n"
+        "  enabled: true\n"
+        "  seed: 7\n"
+        "  bus_partition_groups: [[0, 1], [2]]\n"
+        "  bus_partition_tick: 50\n"
+        "  bus_heal_at_tick: 200\n"
+        "  bus_asym_pairs: [[2, 0]]\n"
+    )
+    spec = FaultInjector.from_config(cfg.faults).spec
+    assert spec.bus_partition_groups == ((0, 1), (2,))
+    assert spec.bus_partition_tick == 50
+    assert spec.bus_heal_at_tick == 200
+    assert spec.bus_asym_pairs == ((2, 0),)
+    with pytest.raises(ConfigError, match="bus_partition_tick"):
+        _cfg("faults:\n  enabled: true\n  bus_partition_tick: -2\n")

@@ -24,6 +24,7 @@ from livekit_server_tpu.analysis import (
     gc06,
     gc07,
     gc08,
+    gc09,
     diff_baseline,
     load_project,
     run_all,
@@ -671,6 +672,73 @@ def test_gc08_use_before_boundary_is_fine(tmp_path):
     """
     project = make_project(tmp_path, {"pkg/mover.py": src})
     assert gc08.run(project, cfg_for("gc08")) == []
+
+
+# -- GC09 fencing discipline ------------------------------------------------
+
+GC09_BAD = """\
+    class Manager:
+        async def checkpoint(self, name, payload):
+            key = f"room_checkpoint:{name}:gen"
+            await self.bus.set(key, payload, 30.0)
+            await self.bus.set(
+                f"room_checkpoint:{name}:gen", payload, 30.0)
+            await self.bus.delete("room_snapshot:a")
+
+        async def pin(self, bus, name, node):
+            await bus.hset(NODE_ROOM_KEY, name, node)
+            await bus.hdel("room_node_map", name)
+"""
+
+GC09_GOOD = """\
+    class Manager:
+        async def checkpoint(self, name, payload):
+            await self.fence.guarded_set(
+                name, f"room_checkpoint:{name}:gen", payload)
+            await self.bus.set(f"node_lease:{name}", "1", 6.0)
+            await self.bus.hset("nodes", name, payload)
+
+    class KVRouter:
+        async def set_node_for_room(self, name, node):
+            await self.bus.hset(NODE_ROOM_KEY, name, node)
+
+    class RoomFence:
+        async def release(self, room):
+            await self.bus.delete(f"room_epoch:{room}")
+"""
+
+
+def test_gc09_unfenced_literal_writes(tmp_path):
+    # line 4 (variable key) is the sanctioned indirection and passes;
+    # lines 5/7 (literal fenced prefixes) and 10/11 (pin hash by module
+    # constant and by literal) are findings.
+    project = make_project(tmp_path, {"pkg/mgr.py": GC09_BAD})
+    findings = gc09.run(project, cfg_for("gc09"))
+    assert lines_of(findings, "GC09") == [5, 7, 10, 11]
+    assert "epoch" in findings[0].hint
+
+
+def test_gc09_writer_api_and_variable_keys_exempt(tmp_path):
+    # guarded_set isn't a bus call, node_lease:/nodes aren't fenced
+    # keys, and the fence/pin-mover bodies are allowlisted.
+    project = make_project(tmp_path, {"pkg/mgr.py": GC09_GOOD})
+    assert gc09.run(project, cfg_for("gc09")) == []
+
+
+def test_gc09_allowlist_is_load_bearing(tmp_path):
+    project = make_project(tmp_path, {"pkg/mgr.py": GC09_GOOD})
+    findings = gc09.run(project, cfg_for("gc09", allowed_in=[]))
+    assert [f.line for f in findings] == [10, 14]
+
+
+def test_gc09_inline_disable(tmp_path):
+    suppressed = GC09_BAD.replace(
+        'await self.bus.delete("room_snapshot:a")',
+        'await self.bus.delete("room_snapshot:a")'
+        "  # graftcheck: disable=GC09",
+    )
+    project = make_project(tmp_path, {"pkg/mgr.py": suppressed})
+    assert lines_of(run_all_pkg(project), "GC09") == [5, 10, 11]
 
 
 # -- suppressions -----------------------------------------------------------
