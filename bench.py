@@ -1359,6 +1359,158 @@ def main() -> None:
             RESULT["paged_capacity_error"] = f"{type(e).__name__}: {e}"
         section_done("paged_capacity", t_sec)
 
+    # -- ragged pooled tick: pay compute only for live pages --------------
+    # The fused live-extent tick (ops/paged_kernel behind models/paged
+    # paged_plane_tick_fused) schedules one grid step per LIVE page; the
+    # stock pooled tick charges the full pool every tick. Fill a pool at
+    # the same 80/15/5 distribution, time the fused tick at full
+    # occupancy, release half the rooms, time again: work should track
+    # live pages, not pool size. On this CPU rig the gathered fallback
+    # stands in for the Pallas kernel (same live-extent schedule; the
+    # TPU path swaps in via use_pallas).
+    if section_ok("paged_kernel", 120):
+        t_sec = time.perf_counter()
+        try:
+            import jax
+            import jax.numpy as jnp
+
+            from livekit_server_tpu.models import paged
+            from livekit_server_tpu.models import plane as plane_model
+            from livekit_server_tpu.runtime.pager import RoomPager
+            from livekit_server_tpu.runtime.slots import CapacityError
+
+            T_MAX, S_MAX, TP, SP, K = 64, 64, 4, 8, 8
+            POOL = 512
+            dims = paged.PagedDims(rooms=POOL, tracks=T_MAX, pkts=K,
+                                   subs=S_MAX, tpage=TP, spage=SP,
+                                   pool_pages=POOL)
+            rng = np.random.default_rng(9)
+
+            def _sample_room() -> int:
+                u = rng.random()
+                if u < 0.80:
+                    return int(rng.integers(2, 5))
+                if u < 0.95:
+                    return int(rng.integers(5, 11))
+                return 50
+
+            pager = RoomPager(rooms=POOL, tracks=T_MAX, subs=S_MAX,
+                              tpage=TP, spage=SP, pool_pages=POOL)
+            admitted: list[int] = []
+            misses = 0
+            while misses < 5:
+                p = _sample_room()
+                try:
+                    pager.alloc_room(len(admitted), tracks=p, subs=p)
+                except CapacityError:
+                    misses += 1
+                    continue
+                admitted.append(len(admitted))
+
+            def _snap():
+                table = paged.PageTable(
+                    rooms_pages=jnp.asarray(pager.rooms_pages),
+                    tmembers=jnp.asarray(pager.tmembers),
+                    pg_room=jnp.asarray(pager.pg_room),
+                    pg_tp=jnp.asarray(pager.pg_tp),
+                    pg_sp=jnp.asarray(pager.pg_sp),
+                )
+                live = np.nonzero(pager.pg_room >= 0)[0].astype(np.int32)
+                nl = 1 << max(len(live) - 1, 1).bit_length()
+                rows = np.concatenate(
+                    [live, np.repeat(live[:1], nl - len(live))]
+                ).astype(np.int32)
+                inv = np.zeros(POOL, np.int32)
+                inv[live] = np.arange(len(live), dtype=np.int32)
+                return table, live, rows, inv
+
+            def _inputs(salt: int):
+                r = np.random.default_rng(100 + salt)
+                P = POOL
+                pk = (P, TP, K)
+                ii = lambda lo, hi, sh: jnp.asarray(  # noqa: E731
+                    r.integers(lo, hi, sh), jnp.int32)
+                bb = lambda pr, sh: jnp.asarray(r.random(sh) < pr)  # noqa: E731
+                ff = lambda lo, hi, sh: jnp.asarray(  # noqa: E731
+                    r.uniform(lo, hi, sh), jnp.float32)
+                return plane_model.TickInputs(
+                    sn=ii(0, 65536, pk), ts=ii(0, 1 << 30, pk),
+                    layer=ii(0, 3, pk), temporal=ii(0, 4, pk),
+                    keyframe=bb(0.2, pk), layer_sync=bb(0.3, pk),
+                    begin_pic=bb(0.4, pk), end_frame=bb(0.4, pk),
+                    pid=ii(0, 100, pk), tl0=ii(0, 100, pk),
+                    keyidx=ii(0, 30, pk), size=ii(40, 1200, pk),
+                    frame_ms=ii(0, 20, pk), audio_level=ii(0, 127, pk),
+                    arrival_rtp=ii(0, 1 << 28, pk),
+                    ts_jump=jnp.zeros(pk, jnp.int32), valid=bb(0.8, pk),
+                    estimate=ff(1e5, 5e6, (P, SP)),
+                    estimate_valid=bb(0.5, (P, SP)),
+                    nacks=ff(0, 3, (P, SP)), pub_rtt_ms=ff(0, 80, (P, TP)),
+                    fb_delay_ms=ff(0, 30, (P, SP)),
+                    fb_recv_bps=ff(1e5, 4e6, (P, SP)),
+                    fb_valid=bb(0.6, (P, SP)), fb_enabled=bb(0.8, (P, SP)),
+                    sub_reset=jnp.zeros((P, SP), bool),
+                    pad_num=jnp.zeros((P, SP), jnp.int32),
+                    pad_track=jnp.full((P, SP), -1, jnp.int32),
+                    tick_ms=jnp.asarray(10, jnp.int32),
+                    roll_quality=jnp.asarray(0, jnp.int32),
+                )
+
+            inputs = [_inputs(s) for s in range(6)]
+
+            def _time_fused(table, rows, inv):
+                tick = jax.jit(lambda s, i: paged.paged_plane_tick_fused(
+                    s, i, table, rows, inv, use_pallas=False))
+                st = plane_model.init_state(dims.pooled())
+                st, out = tick(st, inputs[0])
+                jax.block_until_ready(out)
+                t0 = time.perf_counter()
+                for inp in inputs[1:]:
+                    st, out = tick(st, inp)
+                jax.block_until_ready(out)
+                return round(
+                    (time.perf_counter() - t0) / (len(inputs) - 1) * 1e3, 3)
+
+            table_f, live_f, rows_f, inv_f = _snap()
+            ms_full = _time_fused(table_f, rows_f, inv_f)
+
+            for r in admitted[::2]:
+                pager.release_room(r)
+            table_h, live_h, rows_h, inv_h = _snap()
+            ms_half = _time_fused(table_h, rows_h, inv_h)
+
+            # Flat-cost reference: the stock pooled tick at the same pool.
+            stock = jax.jit(lambda s, i: paged.paged_plane_tick(
+                s, i, table_f))
+            st = plane_model.init_state(dims.pooled())
+            st, out = stock(st, inputs[0])
+            jax.block_until_ready(out)
+            t0 = time.perf_counter()
+            for inp in inputs[1:]:
+                st, out = stock(st, inp)
+            jax.block_until_ready(out)
+            ms_stock = round(
+                (time.perf_counter() - t0) / (len(inputs) - 1) * 1e3, 3)
+
+            RESULT["paged_kernel"] = {
+                "distribution": "80% 2-4p / 15% 5-10p / 5% 50p (seed 9)",
+                "mode": "cpu_fallback",
+                "pool_pages": POOL,
+                "live_pages_full": int(len(live_f)),
+                "grid_steps_full": int(len(rows_f)),
+                "tick_ms_full": ms_full,
+                "live_pages_half": int(len(live_h)),
+                "grid_steps_half": int(len(rows_h)),
+                "tick_ms_half": ms_half,
+                "stock_tick_ms": ms_stock,
+                "half_over_full_work_ratio": round(
+                    ms_half / max(ms_full, 1e-9), 3),
+            }
+            RESULT["paged_kernel_tick_ms"] = ms_full
+        except Exception as e:  # noqa: BLE001
+            RESULT["paged_kernel_error"] = f"{type(e).__name__}: {e}"
+        section_done("paged_kernel", t_sec)
+
     # -- batched audio mix (ops/mix — BASELINE config 2's MCU seat) -------
     # G.711 decode + active-speaker einsum mix + µ-law re-encode at the
     # 1-room × 50-participant shape, all 50 subscribers mixed.
@@ -1405,6 +1557,48 @@ def main() -> None:
             RESULT["audio_mix_error"] = f"{type(e).__name__}"
         section_done("audio_mix", t_sec)
 
+    # -- batched audio mix at the 1000-room MCU shape ---------------------
+    # runtime/mixer.py's device path (_device_mix) batches every enabled
+    # room into one presence/self-exclusion einsum once the per-frame
+    # room count crosses DEVICE_MIX_MIN_ROOMS. Time that exact
+    # contraction at 1000 rooms × 4 tracks × 4 subscribers × 20 ms
+    # (the small-room population where a per-room host loop stops
+    # holding the frame deadline).
+    if section_ok("audio_mix_1kroom", 30):
+        t_sec = time.perf_counter()
+        try:
+            import jax.numpy as jnp
+
+            from livekit_server_tpu.runtime.mixer import _device_mix
+
+            Rk, Tk, Sk, Nk = 1000, 4, 4, 960  # 20 ms @ 48 kHz
+            rngk = np.random.default_rng(3)
+            mixk = _device_mix(Tk, Sk, Nk)
+            # Salted per-call args (identical executions can be cached).
+            kargs = [
+                (
+                    jnp.asarray(rngk.integers(
+                        -32768, 32768, (Rk, Tk, Nk)), jnp.float32),
+                    jnp.asarray(rngk.random((Rk, Tk)) < 0.8),
+                    jnp.asarray(rngk.integers(
+                        0, Tk + 1, (Rk, Sk)), jnp.int32),
+                )
+                for _ in range(9)
+            ]
+            out = mixk(*kargs[0])
+            jax.block_until_ready(out)
+            t0 = time.perf_counter()
+            trials = 8
+            for i in range(trials):
+                out = mixk(*kargs[1 + i])
+            float(np.asarray(out)[0, 0, 0])
+            RESULT["audio_mix_1kroom_tick_ms"] = round(
+                (time.perf_counter() - t0) / trials * 1000.0, 3
+            )
+        except Exception as e:  # noqa: BLE001
+            RESULT["audio_mix_1kroom_error"] = f"{type(e).__name__}"
+        section_done("audio_mix_1kroom", t_sec)
+
     RESULT["bench_total_s"] = round(time.perf_counter() - _T0, 1)
     emit()
     # Compact scoreboard summary, printed LAST: the driver keeps the final
@@ -1418,6 +1612,7 @@ def main() -> None:
                 "p99_wire_ms", "p99_wire_local_ms",
                 "northstar_10240rooms_50subs_tick_ms",
                 "wire_shape_device_tick_ms", "audio_mix_50p_tick_ms",
+                "audio_mix_1kroom_tick_ms", "paged_kernel_tick_ms",
                 "rooms_per_chip_realistic", "paged_vs_dense_rooms_ratio",
                 "bench_total_s"):
         if key in RESULT:

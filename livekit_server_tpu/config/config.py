@@ -188,6 +188,15 @@ class PlaneConfig:
     pager_tpage: int = 4
     pager_spage: int = 8
     pager_pool_pages: int = 0
+    # Ragged-aware pooled-tick kernel (ops/paged_kernel.py): iterate the
+    # LIVE pages only — one Pallas grid step per mapped page, dead pages
+    # never scheduled — fusing the forward decide + stats routing (+ the
+    # audio mix) into one pass. "auto" = on where the kernel exists
+    # (TPU); "on" = live-extent path everywhere (gathered fallback off-
+    # TPU); "interpret" = Pallas interpret mode (CPU CI parity); "off" =
+    # stock full-pool tick. Forced off under a pool mesh (the fused
+    # path is single-chip; sharding keeps the stock tick).
+    paged_kernel: str = "auto"
 
 
 @dataclass
@@ -622,6 +631,11 @@ def _validate(cfg: Config) -> None:
             raise ConfigError(
                 "plane.pager_pool_pages must be a power of two (or 0 for "
                 f"dense-equivalent), got {p.pager_pool_pages}"
+            )
+        if p.paged_kernel not in ("auto", "on", "off", "interpret"):
+            raise ConfigError(
+                "plane.paged_kernel must be one of auto|on|off|interpret, "
+                f"got {p.paged_kernel!r}"
             )
     eg = cfg.egress
     if not 0 <= eg.shards <= 64:

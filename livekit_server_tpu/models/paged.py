@@ -31,7 +31,13 @@ track group and a sub's feedback into every tp-page of its sub group, so
 per-track state (stats/tracker/audio/RED) computes identically in all
 sp-duplicates (read back from sp==0) and per-sub state (BWE/pacer)
 identically in all tp-duplicates (read back from tp==0). Free pages get
-zeroed inputs and init ctrl, hence no sends and no state motion.
+zeroed inputs and init ctrl, hence no sends — and the tick PINS their
+state to its pre-tick values (a zero-input tick would still advance
+pacer tokens / BWE sample age / tracker windows), so a free page always
+holds pristine init state. That enforced invariant is what lets the
+live-extent fused path (`paged_plane_tick_live` + ops/paged_kernel.py)
+skip dead pages entirely: their state needs no writes and their outputs
+are one shared constant computed from the init template.
 
 This module also owns the host-side layout translation (pooled ↔ logical
 numpy) used by checkpoints, integrity repair, the express mirror, and
@@ -246,7 +252,271 @@ def paged_plane_tick(
         deficient=any_deficient,
         sub_quality=sub_q,
     )
+    # Freeze unmapped pages: zero inputs alone do NOT make a free page a
+    # fixed point (pacer tokens, BWE sample age, and tracker windows all
+    # advance per tick — unbounded counter drift), so pin dead rows to
+    # their pre-tick values. This makes the module invariant — a free
+    # page always holds pristine init state — a property of the tick
+    # itself rather than of reinit-on-free alone, and it is the contract
+    # the live-extent path relies on to skip dead pages entirely.
+    live = table.pg_room >= 0                                      # [P]
+
+    def _freeze(n, o):
+        return jnp.where(live.reshape((P,) + (1,) * (n.ndim - 1)), n, o)
+
+    new_state = jax.tree.map(_freeze, new_state, state)
     return new_state, outputs
+
+
+# ---------------------------------------------------------------------------
+# Live-extent fused tick: pay compute only for mapped pages.
+#
+# The stock pooled tick above computes every pool row and masks the dead
+# ones. This variant takes the LIVE page extents as explicit operands —
+# `live_rows [NL]` (pool ids of mapped pages, host-derived from the same
+# device-table mirror the upload pinned, padded to a pow2 bucket by
+# repeating a LIVE row) and `live_inv [P]` (pool id → compact index,
+# 0 for dead rows, only ever read masked) — and runs every phase over
+# the compact [NL] batch:
+#
+#   phase 0  ops/paged_kernel.decide_pages — one Pallas grid step per
+#            live page (the page table is the scalar-prefetch operand;
+#            dead pages are never *scheduled*, not merely masked), fusing
+#            the selector algebra, egress bit packing, send sums, and the
+#            [5,T,K,L] stats/tracker routing selects into one pass.
+#   phase 1  the vmapped dense room core over [NL] rows, with the
+#            kernel's routed stats passed through (`routed_stats`).
+#   phase 2  the cross-track allocation over [NL] rows; a live page's
+#            tmembers only ever reference live pages, so the gather
+#            stays inside the compact batch via `live_inv`.
+#
+# Dead rows: state is untouched (the stock tick's freeze makes pristine
+# init a fixed point) and outputs are one shared constant — a 1-page
+# representative free page ticked in-trace from the init template, so
+# traced scalars (tick_ms, roll_quality) flow into it and the result is
+# bit-identical to what the stock tick computes for every dead row.
+# ---------------------------------------------------------------------------
+
+
+def dead_page_outputs(
+    MT: int, TP: int, K: int, SP: int,
+    inp: TickInputs,
+    audio_params: audio.AudioLevelParams = audio.AudioLevelParams(),
+    bwe_params: bwe.BWEParams = bwe.BWEParams(),
+    red_enabled: bool = True,
+) -> TickOutputs:
+    """TickOutputs of ONE free page under this tick's scalar inputs.
+
+    Free pages hold pristine init state (enforced by the tick's freeze)
+    and zero inputs, so every dead row's outputs equal this constant.
+    Computed in-trace on a 1-page pool with the SAME MT (the phase-2
+    gather width) so the operand set matches a dead row bit-for-bit.
+    """
+    rep_dims = PagedDims(
+        rooms=1, tracks=MT * TP, pkts=K, subs=SP,
+        tpage=TP, spage=SP, pool_pages=1,
+    )
+    rep_state = page_init_template(rep_dims)
+
+    def z(a):
+        return jnp.zeros((1,) + a.shape[1:], a.dtype)
+
+    rep_inp = TickInputs(**{
+        f: (getattr(inp, f) if f in ("tick_ms", "roll_quality")
+            else z(getattr(inp, f)))
+        for f in TickInputs._fields
+    })
+    _, rep_out = paged_plane_tick(
+        rep_state, rep_inp, init_table(rep_dims),
+        audio_params, bwe_params, red_enabled=red_enabled,
+    )
+    return rep_out
+
+
+def broadcast_dead_outputs(rep_out: TickOutputs, P: int) -> TickOutputs:
+    """Tile the representative free page's outputs to the full pool."""
+    return jax.tree.map(
+        lambda r: jnp.broadcast_to(r, (P,) + r.shape[1:]), rep_out
+    )
+
+
+def paged_plane_tick_live(
+    state: PlaneState,
+    inp: TickInputs,
+    table: PageTable,
+    live_rows: jax.Array,   # [NL] int32 pool ids, pow2-padded with live dups
+    live_inv: jax.Array,    # [P] int32 pool id → compact index (dead → 0)
+    decide,                 # ops/paged_kernel.LiveDecide (compact phase 0)
+    audio_params: audio.AudioLevelParams = audio.AudioLevelParams(),
+    bwe_params: bwe.BWEParams = bwe.BWEParams(),
+    red_enabled: bool = True,
+):
+    """Phases 1–2 of the live-extent tick over the compact [NL] batch,
+    plus the scatter back to pool shape. `decide` is phase 0's output
+    (ops/paged_kernel.decide_pages). Requires NL >= 1 — the all-dead
+    pool is the caller's trivial case (state unchanged, dead fill).
+
+    Bit-parity with `paged_plane_tick`: every op here is the stock op
+    over a gathered row subset — int algebra is order-independent and
+    the float chains are per-row identical across batch shapes — and
+    padded duplicate rows scatter identical values.
+    """
+    L = MAX_LAYERS
+    P, MT = table.tmembers.shape
+    TP = state.meta.is_video.shape[1]
+    SP = state.ctrl.subscribed.shape[2]
+    NL = live_rows.shape[0]
+
+    tm_c = table.tmembers[live_rows]                  # [NL, MT]
+    mvalid = tm_c >= 0
+    # A live page's valid tmembers always name live pages, so the
+    # cross-page gathers stay inside the compact batch.
+    mem = live_inv[jnp.clip(tm_c, 0, P - 1)]          # [NL, MT]
+
+    # Cross-page coupling #1 (see paged_plane_tick): per-sub send totals
+    # across the room's track pages, now over compact rows.
+    def gsum(x):  # [NL, SP] int32 → [NL, SP]
+        return jnp.sum(jnp.where(mvalid[:, :, None], x[mem], 0), axis=1)
+
+    pkts_sent_g = gsum(decide.pkts_sent)
+    sent_bytes_g = gsum(decide.sent_bytes)
+
+    state_c = jax.tree.map(lambda a: a[live_rows], state)
+    inp_c = inp._replace(**{
+        f: getattr(inp, f)[live_rows]
+        for f in TickInputs._fields if f not in ("tick_ms", "roll_quality")
+    })
+
+    # ---- phase 1: per-page core over live rows only --------------------
+    inp_axes = TickInputs(**{f: 0 for f in TickInputs._fields})._replace(
+        tick_ms=None, roll_quality=None
+    )
+
+    def tick_one(st, i, sb, db, wb, nk, ps, sby, fp, fby, rs):
+        return plane._room_tick(st, i, sb, db, wb, nk, ps, sby, fp, fby,
+                                audio_params, bwe_params, red_enabled,
+                                routed_stats=rs)
+
+    rs = (decide.st, decide.tr) if decide.st is not None else None
+    rs_axes = (0, 0) if rs is not None else None
+    new_c, outputs_c, bitrates = jax.vmap(
+        tick_one, in_axes=(0, inp_axes, 0, 0, 0, 0, 0, 0, 0, 0, rs_axes)
+    )(state_c, inp_c, decide.send_bits, decide.drop_bits,
+      decide.switch_bits, decide.need_kf, pkts_sent_g, sent_bytes_g,
+      decide.fwd_packets, decide.fwd_bytes, rs)
+
+    # ---- phase 2: allocation with the room's FULL track axis -----------
+    # The stock phase 2 verbatim, with the tmembers gather routed through
+    # live_inv so it reads compact rows.
+    def gtrack(x, fill):  # [NL, TP, ...] → [NL, MT, TP, ...]
+        g = x[mem]
+        m = mvalid.reshape((NL, MT) + (1,) * (g.ndim - 2))
+        return jnp.where(m, g, fill)
+
+    def to_st(x):  # [NL, MT, TP, SP] → [NL, SP, MT*TP]
+        return x.transpose(0, 3, 1, 2).reshape(NL, SP, MT * TP)
+
+    bit_g = gtrack(bitrates, 0.0).reshape(NL, MT * TP, 4, 4)
+    sub_g = to_st(gtrack(state_c.ctrl.subscribed, False))
+    mut_g = to_st(gtrack(state_c.ctrl.sub_muted, False))
+    msp_g = to_st(gtrack(state_c.ctrl.max_spatial, L - 1))
+    mtp_g = to_st(gtrack(state_c.ctrl.max_temporal, 3))
+    video_active = (
+        state_c.meta.is_video & state_c.meta.published
+        & ~state_c.meta.pub_muted
+    )
+    va_g = gtrack(video_active, False).reshape(NL, MT * TP)
+    alloc_muted = ~(sub_g & va_g[:, None, :] & ~mut_g)      # [NL, SP, MT*TP]
+    target_full, _used, deficient = allocation.allocate_budget_rooms(
+        bit_g, msp_g, mtp_g, alloc_muted, outputs_c.committed_bps
+    )
+    tgt4 = target_full.reshape(NL, SP, MT, TP)
+    own_tp = jnp.clip(table.pg_tp[live_rows], 0, MT - 1)
+    tgt_own = jnp.take_along_axis(
+        tgt4, own_tp[:, None, None, None], axis=2
+    )[:, :, 0, :]                                           # [NL, SP, TP]
+    tgt_ts = tgt_own.transpose(0, 2, 1)                     # [NL, TP, SP]
+    sel_state = selector.set_target(
+        decide.sel,
+        jnp.clip(allocation.spatial_of(tgt_ts), -1, L - 1),
+        allocation.temporal_of(tgt_ts),
+    )
+    any_deficient = jnp.any(deficient, axis=-1)             # [NL, SP]
+    sub_q = jnp.where(
+        outputs_c.congested,
+        quality.QUALITY_POOR,
+        jnp.where(any_deficient, quality.QUALITY_GOOD,
+                  quality.QUALITY_EXCELLENT),
+    ).astype(jnp.int32)
+    new_c = new_c._replace(sel=sel_state)
+    outputs_c = outputs_c._replace(
+        target_layers=tgt_own,
+        deficient=any_deficient,
+        sub_quality=sub_q,
+    )
+
+    # ---- scatter back to pool shape ------------------------------------
+    # Dead state rows are untouched (frozen at pristine init by
+    # contract); dead output rows get the shared representative fill.
+    # Padded duplicate live rows scatter identical values.
+    new_state = jax.tree.map(
+        lambda full, c: full.at[live_rows].set(c), state, new_c
+    )
+    rep_out = dead_page_outputs(
+        MT, TP, inp.sn.shape[2], SP, inp,
+        audio_params, bwe_params, red_enabled,
+    )
+    outputs = jax.tree.map(
+        lambda r, c: jnp.broadcast_to(
+            r, (P,) + r.shape[1:]
+        ).at[live_rows].set(c),
+        rep_out, outputs_c,
+    )
+    return new_state, outputs
+
+
+def paged_plane_tick_fused(
+    state: PlaneState,
+    inp: TickInputs,
+    table: PageTable,
+    live_rows,
+    live_inv,
+    audio_params: audio.AudioLevelParams = audio.AudioLevelParams(),
+    bwe_params: bwe.BWEParams = bwe.BWEParams(),
+    red_enabled: bool = True,
+    use_pallas: bool | None = None,
+    interpret: bool = False,
+):
+    """The whole live-extent tick in one trace: phase-0 kernel + live
+    phases 1–2 + scatter. The runtime splits phase 0 into its own
+    dispatch for span timing; tests and bench use this entry."""
+    from livekit_server_tpu.ops import paged_kernel
+
+    live_rows = jnp.asarray(live_rows, jnp.int32)
+    live_inv = jnp.asarray(live_inv, jnp.int32)
+    if live_rows.shape[0] == 0:
+        TP = state.meta.is_video.shape[1]
+        SP = state.ctrl.subscribed.shape[2]
+        P, MT = table.tmembers.shape
+        rep = dead_page_outputs(
+            MT, TP, inp.sn.shape[2], SP, inp,
+            audio_params, bwe_params, red_enabled,
+        )
+        return state, broadcast_dead_outputs(rep, P)
+    base = (
+        state.ctrl.subscribed
+        & ~state.ctrl.sub_muted
+        & (state.meta.published & ~state.meta.pub_muted)[:, :, None]
+    )
+    dec = paged_kernel.decide_pages(
+        state.sel, state.meta.is_svc, state.meta.is_video, base, inp,
+        live_rows, wire_overhead=pacer.WIRE_OVERHEAD_BYTES,
+        use_pallas=use_pallas, interpret=interpret,
+    )
+    return paged_plane_tick_live(
+        state, inp, table, live_rows, live_inv, dec,
+        audio_params, bwe_params, red_enabled,
+    )
 
 
 # ---------------------------------------------------------------------------

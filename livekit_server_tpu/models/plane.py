@@ -290,11 +290,18 @@ def _room_tick(
     audio_params: audio.AudioLevelParams,
     bwe_params: bwe.BWEParams,
     red_enabled: bool = True,
+    *,
+    routed_stats=None,
 ):
     """Phase-1 core tick for ONE room; every field has its leading R axis
     stripped. The forward decision (phase 0) and allocation (phase 2) run
     room-batched in `media_plane_tick`; this returns `bitrates` for phase
-    2 and placeholder zeros for the allocation-derived output fields."""
+    2 and placeholder zeros for the allocation-derived output fields.
+
+    `routed_stats`, when given, is `(st [5, T*L, K], tr_sums [3, T*L])` —
+    the stats/tracker routing selects precomputed by the live-page fused
+    kernel (ops/paged_kernel.py) with the identical int algebra; the
+    in-place computation below is then skipped bit-for-bit."""
     T, K = inp.sn.shape
     S = state.ctrl.subscribed.shape[-1]
     L = MAX_LAYERS
@@ -304,29 +311,51 @@ def _room_tick(
     # one stats row each; an SVC track carries every spatial layer in ONE
     # stream/SN space, so all its packets fold into row 0 — per-layer rows
     # would misread the interleaved SNs as massive loss.
-    eff_layer = jnp.where(
-        state.meta.is_svc[:, None], 0, jnp.clip(inp.layer, 0, L - 1)
-    )
-    # Route packets into [T*L, K] rows by (track, layer) — as an
-    # elementwise one-hot select, NOT a scatter: k is preserved, so
-    # (t, k) → (t, eff_layer, k) can never collide, and data-dependent
-    # scatters serialize per element on TPU while this select/transpose
-    # fuses (the cfg4-scale tick was dominated by exactly this scatter).
     lanes = jnp.arange(L, dtype=jnp.int32)[None, None, :]            # [1,1,L]
-    # One stacked routed select for all five stats fields (sn/ts/size/
-    # arrival/valid) — five separate [T,K,L] selects each materialize
-    # their own routing compare + transpose; stacked they share it and
-    # fuse into one pass (same discipline as the tracker's tr_vals stack
-    # below). Every field's "not this lane" fill is 0 (valid rides as
-    # int32 0/1), so a single zero fill serves the stack.
-    st_vals = jnp.stack(
-        [inp.sn, inp.ts, inp.size, inp.arrival_rtp,
-         inp.valid.astype(jnp.int32)]
-    )                                                                # [5,T,K]
-    st_routed = jnp.where(
-        (eff_layer[:, :, None] == lanes)[None], st_vals[:, :, :, None], 0
-    )                                                                # [5,T,K,L]
-    st = st_routed.transpose(0, 1, 3, 2).reshape(5, T * L, K)
+    if routed_stats is None:
+        eff_layer = jnp.where(
+            state.meta.is_svc[:, None], 0, jnp.clip(inp.layer, 0, L - 1)
+        )
+        # Route packets into [T*L, K] rows by (track, layer) — as an
+        # elementwise one-hot select, NOT a scatter: k is preserved, so
+        # (t, k) → (t, eff_layer, k) can never collide, and
+        # data-dependent scatters serialize per element on TPU while
+        # this select/transpose fuses (the cfg4-scale tick was dominated
+        # by exactly this scatter).
+        # One stacked routed select for all five stats fields (sn/ts/
+        # size/arrival/valid) — five separate [T,K,L] selects each
+        # materialize their own routing compare + transpose; stacked
+        # they share it and fuse into one pass (same discipline as the
+        # tracker's tr_vals stack below). Every field's "not this lane"
+        # fill is 0 (valid rides as int32 0/1), so a single zero fill
+        # serves the stack.
+        st_vals = jnp.stack(
+            [inp.sn, inp.ts, inp.size, inp.arrival_rtp,
+             inp.valid.astype(jnp.int32)]
+        )                                                            # [5,T,K]
+        st_routed = jnp.where(
+            (eff_layer[:, :, None] == lanes)[None], st_vals[:, :, :, None], 0
+        )                                                            # [5,T,K,L]
+        st = st_routed.transpose(0, 1, 3, 2).reshape(5, T * L, K)
+        # Tracker rows route by each packet's TRUE spatial layer (see
+        # the section-2 comment below); computed here so the fused
+        # kernel can hand BOTH routings in via `routed_stats`.
+        true_layer = jnp.clip(inp.layer, 0, L - 1)
+        t_lane = true_layer[:, :, None] == lanes                    # [T,K,L]
+        # One stacked routed-sum for (pkts, bytes, frames) — three
+        # separate reduces cost ~0.9 ms/tick at cfg4; stacked they share
+        # the routing select and fuse into one pass.
+        ones_k = jnp.ones((T, K), jnp.int32)
+        tr_vals = jnp.stack([ones_k, inp.size, ones_k])             # [3,T,K]
+        tr_pred = jnp.stack(
+            [inp.valid, inp.valid, inp.valid & inp.begin_pic]
+        )                                                           # [3,T,K]
+        routed = jnp.where(
+            t_lane[None] & tr_pred[:, :, :, None], tr_vals[:, :, :, None], 0
+        )                                                           # [3,T,K,L]
+        tr_sums = jnp.sum(routed, axis=2).reshape(3, T * L)
+    else:
+        st, tr_sums = routed_stats
     stats = rtpstats.update_tick(
         state.stats, st[0], st[1], st[2], st[3], st[4].astype(jnp.bool_)
     )
@@ -338,21 +367,8 @@ def _room_tick(
     # DD-driven tracker variant (streamtracker_dd.go): an SVC layer's row
     # goes LIVE/STOPPED as decode targets appear/vanish. Frame starts
     # feed the frame-rate rule + fps estimation (streamtracker_frame.go,
-    # fps.go).
-    true_layer = jnp.clip(inp.layer, 0, L - 1)
-    t_lane = true_layer[:, :, None] == lanes                        # [T,K,L]
-    # One stacked routed-sum for (pkts, bytes, frames) — three separate
-    # reduces cost ~0.9 ms/tick at cfg4; stacked they share the routing
-    # select and fuse into one pass.
-    ones_k = jnp.ones((T, K), jnp.int32)
-    tr_vals = jnp.stack([ones_k, inp.size, ones_k])                 # [3,T,K]
-    tr_pred = jnp.stack(
-        [inp.valid, inp.valid, inp.valid & inp.begin_pic]
-    )                                                               # [3,T,K]
-    routed = jnp.where(
-        t_lane[None] & tr_pred[:, :, :, None], tr_vals[:, :, :, None], 0
-    )                                                               # [3,T,K,L]
-    tr_sums = jnp.sum(routed, axis=2).reshape(3, T * L)
+    # fps.go). (The routed sums themselves are computed above, next to
+    # the stats routing, so `routed_stats` can replace both at once.)
     st_pkts, st_bytes, st_frames = tr_sums[0], tr_sums[1], tr_sums[2]
     tracker, layer_status, _status_changed, tracker_bps, layer_fps = (
         streamtracker.update_tick(

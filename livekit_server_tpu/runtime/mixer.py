@@ -20,9 +20,12 @@ individual audio tracks at the same time — the mix replaces them.
 
 from __future__ import annotations
 
+import functools
 import time
 from collections import deque
 
+import jax
+import jax.numpy as jnp
 import numpy as np
 
 from livekit_server_tpu.interop import opus
@@ -35,6 +38,36 @@ OPUS_PT = 111
 ACTIVE_TTL_S = 0.4
 # Brief gaps inside an active stream are concealed by the decoder.
 PLC_MAX_FRAMES = 10
+# Rooms mixing this frame before the batched einsum path takes over from
+# the per-room numpy sum. Below it, one device dispatch costs more than
+# the host loop; at the 1000-room shape (bench audio_mix_1kroom) the
+# einsum is the only tractable form.
+DEVICE_MIX_MIN_ROOMS = 64
+
+
+@functools.lru_cache(maxsize=None)
+def _device_mix(T: int, S: int, N: int):
+    """Batched room mix, one einsum for every enabled room at once —
+    the same "rst,rtn->rsn" contraction as ops/mix.mix_tick with the
+    include weight reduced to presence & self-exclusion (the host path's
+    sum-all-tracks policy, NOT the top-K speaker gate). int16 samples
+    summed in float32 are exact below 2^24, so the result is bit-equal
+    to the numpy int32 sum after rounding."""
+
+    @jax.jit
+    def mixf(pcm, present, exclude):
+        # pcm [R,T,N] f32; present [R,T] bool; exclude [R,S] int32
+        # (column index of the subscriber's own track, T = none).
+        inc = present[:, None, :] & (
+            jnp.arange(T, dtype=jnp.int32)[None, None, :]
+            != exclude[:, :, None])
+        return jnp.einsum("rst,rtn->rsn", inc.astype(jnp.float32), pcm)
+
+    return mixf
+
+
+def _p2(n: int) -> int:
+    return 1 << max(n - 1, 1).bit_length()
 
 
 class _TrackLane:
@@ -72,7 +105,9 @@ class AudioMixer:
         self.rooms: dict[int, _RoomMix] = {}
         self._room_arr = np.zeros(0, np.int64)
         self._next_at = 0.0
-        self.stats = {"frames_mixed": 0, "packets_out": 0, "decode_errors": 0}
+        self.device_mix_min_rooms = DEVICE_MIX_MIN_ROOMS
+        self.stats = {"frames_mixed": 0, "packets_out": 0,
+                      "decode_errors": 0, "device_mix_frames": 0}
 
     # -- control ----------------------------------------------------------
 
@@ -163,8 +198,16 @@ class AudioMixer:
         self.tick(now)
 
     def tick(self, now: float | None = None) -> None:
-        """Mix + emit one 20 ms frame for every enabled room."""
+        """Mix + emit one 20 ms frame for every enabled room.
+
+        Decode is always host-side (Opus is stateful C); the mix itself
+        runs per room in numpy until DEVICE_MIX_MIN_ROOMS rooms are
+        active in the same frame, then switches to one batched einsum
+        over every room at once (_device_mix) — the only form that holds
+        the 20 ms deadline at the 1000-room shape. Both paths produce
+        identical int16 frames."""
         now = time.monotonic() if now is None else now
+        staged: list[tuple[int, _RoomMix, dict[int, np.ndarray]]] = []
         for room, rm in list(self.rooms.items()):
             pcm_by_track: dict[int, np.ndarray] = {}
             for track, lane in list(rm.tracks.items()):
@@ -193,23 +236,70 @@ class AudioMixer:
                     pcm_by_track[track] = pcm.astype(np.int32)
             if not pcm_by_track:
                 continue
-            tracks = list(pcm_by_track)
-            stack = np.stack([pcm_by_track[t] for t in tracks])  # [T, N]
-            total = stack.sum(axis=0)
             self.stats["frames_mixed"] += 1
-            for sub, lane in rm.subs.items():
-                mix = total
-                if lane.exclude_track in pcm_by_track:
-                    mix = total - pcm_by_track[lane.exclude_track]
-                out = np.clip(mix, -32768, 32767).astype(np.int16)
-                if not out.any() and lane.exclude_track in pcm_by_track \
-                        and len(tracks) == 1:
-                    continue  # only their own voice was active
-                try:
-                    pkt = lane.enc.encode(out)
-                except opus.OpusError:
-                    continue
-                self._emit(room, sub, lane, pkt)
+            staged.append((room, rm, pcm_by_track))
+        if len(staged) >= self.device_mix_min_rooms:
+            self._mix_device(staged)
+        else:
+            for room, rm, pcm_by_track in staged:
+                self._mix_host(room, rm, pcm_by_track)
+
+    def _mix_host(
+        self, room: int, rm: _RoomMix, pcm_by_track: dict[int, np.ndarray]
+    ) -> None:
+        tracks = list(pcm_by_track)
+        stack = np.stack([pcm_by_track[t] for t in tracks])  # [T, N]
+        total = stack.sum(axis=0)
+        for sub, lane in rm.subs.items():
+            mix = total
+            if lane.exclude_track in pcm_by_track:
+                mix = total - pcm_by_track[lane.exclude_track]
+            out = np.clip(mix, -32768, 32767).astype(np.int16)
+            self._encode_emit(room, sub, lane, out, pcm_by_track)
+
+    def _mix_device(
+        self, staged: list[tuple[int, _RoomMix, dict[int, np.ndarray]]]
+    ) -> None:
+        # Pad the frame's rooms into one [R, T, N] slab (pow2 track/sub
+        # buckets keep the jit cache small across churn) and contract
+        # once; emit walks the real subscribers only.
+        N = opus.FRAME_SAMPLES
+        Tm = _p2(max(len(p) for _, _, p in staged))
+        Sm = _p2(max(1, max(len(rm.subs) for _, rm, _ in staged)))
+        R = len(staged)
+        pcm = np.zeros((R, Tm, N), np.float32)
+        present = np.zeros((R, Tm), bool)
+        exclude = np.full((R, Sm), Tm, np.int32)
+        cols: list[dict[int, int]] = []
+        for i, (_room, rm, ptk) in enumerate(staged):
+            col = {t: j for j, t in enumerate(ptk)}
+            cols.append(col)
+            for t, j in col.items():
+                pcm[i, j] = ptk[t]
+                present[i, j] = True
+            for s, lane in enumerate(rm.subs.values()):
+                exclude[i, s] = col.get(lane.exclude_track, Tm)
+        out = np.asarray(_device_mix(Tm, Sm, N)(
+            jnp.asarray(pcm), jnp.asarray(present), jnp.asarray(exclude)))
+        self.stats["device_mix_frames"] += 1
+        for i, (room, rm, ptk) in enumerate(staged):
+            for s, (sub, lane) in enumerate(rm.subs.items()):
+                mixed = np.clip(
+                    np.rint(out[i, s]), -32768, 32767).astype(np.int16)
+                self._encode_emit(room, sub, lane, mixed, ptk)
+
+    def _encode_emit(
+        self, room: int, sub: int, lane: _SubLane,
+        out: np.ndarray, pcm_by_track: dict[int, np.ndarray],
+    ) -> None:
+        if not out.any() and lane.exclude_track in pcm_by_track \
+                and len(pcm_by_track) == 1:
+            return  # only their own voice was active
+        try:
+            pkt = lane.enc.encode(out)
+        except opus.OpusError:
+            return
+        self._emit(room, sub, lane, pkt)
 
     def _emit(self, room: int, sub: int, lane: _SubLane, payload: bytes) -> None:
         t = self.transport

@@ -24,6 +24,15 @@ Checkpoints, row repair, and migration all serialize the LOGICAL form
 (LayoutXlate translates at the boundary), which keeps snapshot bytes
 identical across pool layouts and lets rooms migrate dense↔paged.
 
+Tick variants (`paged_kernel` ctor knob / `plane.paged_kernel`): "off"
+runs the stock full-pool jit tick; "auto" (TPU) / "on" / "interpret"
+run the live-extent path — a timed decide dispatch through the fused
+`ops/paged_kernel.py` grid-over-live-pages kernel (recorded per tick as
+`paged_kernel_ms` + grid steps) and a donated-state rest phase, with
+`live_rows` refreshed in `_sync_pages` under the same epoch pinning as
+`_step_xlate`. Zero live pages short-circuits to a broadcast dead-page
+tick. Forced "off" under a pool mesh (the sharded tick stays stock).
+
 Staleness discipline (graftcheck GC08): page indices are only valid
 under the pager epoch they were read at. Everything here that crosses a
 thread or an await uses an epoch-pinned `LayoutXlate` snapshot —
@@ -38,12 +47,14 @@ re-initialized (unsubscribed) pages and drop, never misroute.
 from __future__ import annotations
 
 import functools
+import time
 from typing import Any
 
 import jax
 import numpy as np
 
 from livekit_server_tpu.models import paged, plane
+from livekit_server_tpu.ops import pacer
 from livekit_server_tpu.runtime.pager import RoomPager
 from livekit_server_tpu.runtime.plane_runtime import (
     PlaneRuntime,
@@ -66,6 +77,67 @@ def _build_paged_step(audio_params, bwe_params, red_enabled=True):
         return state, plane.pack_tick_outputs(out)
 
     return jax.jit(tick, donate_argnums=(0,))
+
+
+@functools.lru_cache(maxsize=None)
+def _build_live_decide(interpret: bool):
+    """Phase 0 of the live-extent tick (ops/paged_kernel.decide_pages) as
+    its OWN dispatch, so the worker thread can time the kernel span
+    (`paged_kernel_ms`) separately from the rest of the device step. The
+    fb/tf operands ride along only to reuse unpack_tick_inputs — the
+    decide algebra reads packet fields, XLA drops the rest."""
+    from livekit_server_tpu.ops import paged_kernel
+
+    def decide(sel, is_svc, is_video, subscribed, sub_muted,
+               published, pub_muted, pkt, fb, tf, tick_ms, roll, live_rows):
+        inp = plane.unpack_tick_inputs(pkt, fb, tf, tick_ms, roll)
+        base = subscribed & ~sub_muted & (published & ~pub_muted)[:, :, None]
+        return paged_kernel.decide_pages(
+            sel, is_svc, is_video, base, inp, live_rows,
+            wire_overhead=pacer.WIRE_OVERHEAD_BYTES,
+            use_pallas=None, interpret=interpret,
+        )
+
+    return jax.jit(decide)
+
+
+@functools.lru_cache(maxsize=None)
+def _build_live_rest(audio_params, bwe_params, red_enabled=True):
+    """Phases 1–2 + scatter of the live-extent tick, consuming the
+    LiveDecide produced by _build_live_decide. State donated, table and
+    live-row indices read-only."""
+
+    def rest(state, table, live_rows, live_inv, dec, pkt, fb, tf,
+             tick_ms, roll_quality):
+        inp = plane.unpack_tick_inputs(pkt, fb, tf, tick_ms, roll_quality)
+        state, out = paged.paged_plane_tick_live(
+            state, inp, table, live_rows, live_inv, dec,
+            audio_params, bwe_params, red_enabled,
+        )
+        return state, plane.pack_tick_outputs(out)
+
+    return jax.jit(rest, donate_argnums=(0,))
+
+
+@functools.lru_cache(maxsize=None)
+def _build_dead_step(audio_params, bwe_params, red_enabled, max_tpages):
+    """Zero-live-pages tick: no grid to schedule. State is untouched (the
+    freeze-the-dead invariant — every free page already holds pristine
+    init state) and the outputs are the representative dead page's,
+    broadcast across the pool."""
+
+    def tick(state, pkt, fb, tf, tick_ms, roll_quality):
+        inp = plane.unpack_tick_inputs(pkt, fb, tf, tick_ms, roll_quality)
+        P, TP, K = inp.sn.shape
+        SP = inp.estimate.shape[1]
+        rep = paged.dead_page_outputs(
+            max_tpages, TP, K, SP, inp,
+            audio_params, bwe_params, red_enabled,
+        )
+        out = paged.broadcast_dead_outputs(rep, P)
+        return state, plane.pack_tick_outputs(out)
+
+    return jax.jit(tick)
 
 
 @functools.lru_cache(maxsize=None)
@@ -102,10 +174,42 @@ def _pad_rows(to: int, *arrays):
 class PagedPlaneRuntime(PlaneRuntime):
     """PlaneRuntime over the pooled paged device layout."""
 
-    def __init__(self, dims: paged.PagedDims, *, mesh=None, **kwargs):
+    def __init__(self, dims: paged.PagedDims, *, mesh=None,
+                 paged_kernel: str = "auto", **kwargs):
         if not isinstance(dims, paged.PagedDims):
             raise TypeError("PagedPlaneRuntime requires paged.PagedDims")
         self.pdims = dims
+        # Live-extent tick variant (ops/paged_kernel.py): "auto" runs it
+        # where the Pallas kernel actually exists (TPU), "on" forces the
+        # live path everywhere (kernel on TPU, gathered-decide fallback
+        # on CPU), "interpret" runs the kernel in Pallas interpret mode
+        # (CPU CI parity), "off" keeps the stock full-pool tick.
+        if isinstance(paged_kernel, bool):
+            paged_kernel = "on" if paged_kernel else "off"
+        if paged_kernel not in ("auto", "on", "off", "interpret"):
+            raise ValueError(
+                f"paged_kernel must be auto|on|off|interpret, "
+                f"got {paged_kernel!r}"
+            )
+        if mesh is not None and paged_kernel != "off":
+            # The fused path is single-chip: its cross-page member
+            # gathers defeat GSPMD pool sharding. The sharded pooled
+            # tick stays the stock one (parallel/mesh.py page_sharding).
+            from livekit_server_tpu.utils.logger import Logger
+
+            if paged_kernel != "auto":
+                Logger(plane="paged").warn(
+                    "paged_kernel forced off: pool-mesh sharding uses "
+                    "the stock pooled tick", requested=paged_kernel,
+                )
+            paged_kernel = "off"
+        self._pk_mode = paged_kernel
+        self._pk_interpret = paged_kernel == "interpret"
+        self._pk_enabled = paged_kernel in ("on", "interpret") or (
+            paged_kernel == "auto" and jax.default_backend() == "tpu"
+        )
+        self._kernel_s_scratch = 0.0
+        self._kernel_steps_scratch = 0
         self.pager = RoomPager(
             dims.rooms, dims.tracks, dims.subs,
             tpage=dims.tpage, spage=dims.spage, pool_pages=dims.pool_pages,
@@ -127,6 +231,16 @@ class PagedPlaneRuntime(PlaneRuntime):
             np.full(P, -1, np.int32), np.full((P, MT), -1, np.int32),
         )
         self.table_repairs = 0
+        # Live-row cache for the kernel grid and the live-fraction gauge:
+        # derived from `_dev_tables` (the device table as of the last
+        # page sync), refreshed by `_sync_pages` — same epoch pinning as
+        # `_step_xlate` (GC08). `_live_rows` is the pow2-padded mapped
+        # pool ids (padding repeats a LIVE row — models/paged.py needs a
+        # live representative, never a dead one); `_live_inv` maps pool
+        # id → compact index (dead rows 0, read only clipped+masked).
+        self._live_rows = np.empty(0, np.int32)
+        self._live_inv = np.zeros(P, np.int32)
+        self._live_n = 0
         super().__init__(dims.logical, mesh=None, **kwargs)
         # The base ctor wired a dense SlotAllocator; rooms actually claim
         # page grids, so admission/occupancy route through the pager.
@@ -135,6 +249,10 @@ class PagedPlaneRuntime(PlaneRuntime):
         self.stats.update({
             "page_delta_uploads": 0, "page_rows_uploaded": 0,
             "pages_reinit": 0, "page_moves": 0,
+            # Kernel grid accounting: steps == the padded live-page
+            # bucket per tick — the "work ∝ live pages" probe the bench
+            # and tier-1 assert against.
+            "paged_kernel_ticks": 0, "paged_kernel_steps": 0,
         })
 
     # -- seam hooks -------------------------------------------------------
@@ -165,6 +283,41 @@ class PagedPlaneRuntime(PlaneRuntime):
             return self._paged_step(state, self.table, *packed)
 
         self._step = step
+        if self._pk_enabled:
+            self._live_decide = _build_live_decide(self._pk_interpret)
+            self._live_rest = _build_live_rest(
+                self._ap, self._bp, self.red_enabled
+            )
+            self._dead_step = _build_dead_step(
+                self._ap, self._bp, self.red_enabled, self.pdims.max_tpages
+            )
+            self._step = self._live_step
+
+    def _live_step(self, state, *packed):
+        """Live-extent device step: phase-0 kernel dispatch timed into
+        `_kernel_s_scratch` (the worker thread copies it onto the
+        StagedTick in `_device_step` — same thread, no race), then the
+        rest of the tick. Live rows read at call time: `_sync_pages` at
+        the preceding upload edge pinned them with the device table."""
+        pkt, fb, tf, tick_ms, roll = packed
+        lr, li = self._live_rows, self._live_inv
+        if lr.shape[0] == 0:
+            self._kernel_s_scratch = 0.0
+            self._kernel_steps_scratch = 0
+            return self._dead_step(state, pkt, fb, tf, tick_ms, roll)
+        t0 = time.perf_counter()
+        dec = self._live_decide(
+            state.sel, state.meta.is_svc, state.meta.is_video,
+            state.ctrl.subscribed, state.ctrl.sub_muted,
+            state.meta.published, state.meta.pub_muted,
+            pkt, fb, tf, tick_ms, roll, lr,
+        )
+        dec = jax.block_until_ready(dec)
+        self._kernel_s_scratch = time.perf_counter() - t0
+        self._kernel_steps_scratch = int(lr.shape[0])
+        return self._live_rest(
+            state, self.table, lr, li, dec, pkt, fb, tf, tick_ms, roll
+        )
 
     def _pack_inputs(self, inp: plane.TickInputs) -> tuple:
         pkt, fb, tf, tick_ms, roll = plane.pack_tick_inputs(inp)
@@ -278,7 +431,22 @@ class PagedPlaneRuntime(PlaneRuntime):
                 self.integrity.on_layout_change()
             self.stats["page_delta_uploads"] += 1
             self.stats["page_rows_uploaded"] += len(page_rows)
+            self._refresh_live_rows()
         self._step_xlate = self._xlate_cached()
+
+    def _refresh_live_rows(self) -> None:
+        """Rebuild the live-row cache from the device-table mirror (see
+        __init__). Called whenever `_dev_tables` changes; the pow2 bucket
+        keeps the kernel grid compiling once per size class."""
+        pg_room = self._dev_tables[0]
+        rows = np.nonzero(pg_room >= 0)[0].astype(np.int32)
+        inv = np.zeros(len(pg_room), np.int32)
+        inv[rows] = np.arange(len(rows), dtype=np.int32)
+        self._live_n = len(rows)
+        if len(rows):
+            (rows,) = _pad_rows(_p2(len(rows)), rows)
+        self._live_rows = rows
+        self._live_inv = inv
 
     def _upload_ctrl(self) -> None:
         """Page lane first (table delta / moves / re-init), then the
@@ -335,6 +503,32 @@ class PagedPlaneRuntime(PlaneRuntime):
             for c in ctrl
         ])
         return pr, meta_rows, ctrl_rows
+
+    # -- kernel span accounting --------------------------------------------
+
+    def _device_step(self, st):
+        """Stamp the kernel span/grid-steps scratches (written by
+        `_live_step` on this same worker thread) onto the StagedTick
+        before it crosses back to the event loop."""
+        out = super()._device_step(st)
+        if out is not None and self._pk_enabled:
+            st.kernel_s = self._kernel_s_scratch
+            st.kernel_steps = self._kernel_steps_scratch
+        return out
+
+    def _tick_rec_extras(self, st) -> dict:
+        """recent_ticks extras + the per-tick stats fold (runs exactly
+        once per completed tick, on the event loop)."""
+        if not self._pk_enabled:
+            return {}
+        self.stats["paged_kernel_ticks"] += 1
+        self.stats["paged_kernel_steps"] += st.kernel_steps
+        return {
+            "paged_kernel_ms": round(st.kernel_s * 1000.0, 3),
+            "page_live_fraction": round(
+                self._live_n / self.pdims.pool_pages, 4
+            ),
+        }
 
     # -- integrity plane ---------------------------------------------------
 
@@ -557,4 +751,8 @@ class PagedPlaneRuntime(PlaneRuntime):
     def pager_stats(self) -> dict:
         st = self.pager.stats()
         st["table_repairs"] = self.table_repairs
+        st["paged_kernel"] = self._pk_mode if self._pk_enabled else "off"
+        st["page_live_fraction"] = round(
+            self._live_n / self.pdims.pool_pages, 4
+        )
         return st

@@ -281,6 +281,11 @@ class StagedTick:
     packed: tuple | None = None
     stage_s: float = 0.0
     device_s: float = 0.0
+    # Paged-kernel slice of device_s (phase-0 decide dispatch when the
+    # live-extent path ran — runtime/paged_runtime.py) and the grid
+    # steps it scheduled (== padded live-page bucket). 0 on the stock tick.
+    kernel_s: float = 0.0
+    kernel_steps: int = 0
     edge: float = 0.0      # scheduled dispatch edge (perf_counter)
     deadline: float = 0.0  # owning-tick egress deadline; 0 = unaccounted
     depth: int = 0         # pipeline depth this tick ran at
@@ -693,6 +698,12 @@ class PlaneRuntime:
         self._dirty_rows = set()
         self._ctrl_dirty = False
 
+    def _tick_rec_extras(self, st: StagedTick) -> dict:
+        """Subclass hook: extra fields for this tick's `recent_ticks`
+        record (event loop, after the device step committed). The paged
+        runtime adds the kernel span and live-page fraction here."""
+        return {}
+
     def _device_step(self, st: StagedTick):
         """The blocking device round trip; runs off the event loop.
         Inputs were pre-packed at stage time (non-mesh), so this thread's
@@ -879,6 +890,7 @@ class PlaneRuntime:
             tick_rec["egress_shard_ms"] = [
                 s["ms"] for s in ep.last_send.get("shards", [])
             ]
+        tick_rec.update(self._tick_rec_extras(st))
         self.recent_ticks.append(tick_rec)
         if self.trace is not None:
             # Trace ring: scalar stores into preallocated columns only
@@ -887,6 +899,7 @@ class PlaneRuntime:
                 st.idx, st.edge, st.stage_t0, st.stage_s, st.retier_s,
                 st.upload_t0, st.upload_s, st.device_t0, st.device_s,
                 c0, fanout_s, send_s, st.edge_over_us, st.depth, late,
+                kernel_s=st.kernel_s,
             )
             if ep.last_send:
                 shards = ep.last_send.get("shards", ())

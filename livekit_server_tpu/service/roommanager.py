@@ -78,10 +78,12 @@ class RoomManager:
             dims = plane.PlaneDims(
                 p.rooms, p.tracks_per_room, p.pkts_per_track, p.subs_per_room
             )
+        extra = {"paged_kernel": p.paged_kernel} if p.pager_enabled else {}
         self.runtime = runtime_cls(
             dims,
             tick_ms=p.tick_ms,
             mesh=mesh,
+            **extra,
             low_latency=p.low_latency,
             red_enabled="audio/red" in config.room.enabled_codecs,
             audio_params=audio_ops.AudioLevelParams(

@@ -235,6 +235,12 @@ class TelemetryService:
         self.set_gauge(
             "livekit_page_internal_slack", snap.get("internal_slack", 0)
         )
+        # Mapped fraction of the pool == the paged kernel's scheduled-
+        # grid fraction (ops/paged_kernel.py: one grid step per live
+        # page — dead pages are never scheduled).
+        self.set_gauge(
+            "livekit_page_live_fraction", snap.get("page_live_fraction", 0.0)
+        )
         for k in ("allocs", "frees", "grows", "compactions",
                   "alloc_failures", "table_repairs"):
             self.set_gauge(f"livekit_pager_{k}_total", snap.get(k, 0))

@@ -94,6 +94,15 @@ def to_chrome(records: list[dict[str, Any]], tick_ms: int = 0) -> list[dict]:
                 "dur": dur_us(r.get("device_s", 0.0)),
                 "pid": 1, "tid": TID_DEVICE, "args": args,
             })
+            # Paged-kernel slice: the phase-0 decide dispatch nested at
+            # the head of the device span (0 when the stock tick ran).
+            if r.get("kernel_s", 0.0) > 0.0:
+                events.append({
+                    "name": "paged_kernel", "ph": "X",
+                    "ts": us(r["device_t0"]),
+                    "dur": dur_us(r["kernel_s"]),
+                    "pid": 1, "tid": TID_DEVICE, "args": {"tick": tick},
+                })
         f0 = r.get("fanout_t0", 0.0)
         if f0 > 0.0:
             fan_s = r.get("fanout_s", 0.0)

@@ -112,3 +112,28 @@ def test_pallas_rooms_budget_matches_per_room():
         assert np.array_equal(np.asarray(t0), np.asarray(t1))
         assert np.allclose(np.asarray(u0), np.asarray(u1), rtol=1e-5)
         assert np.array_equal(np.asarray(d0), np.asarray(d1))
+
+
+def test_pallas_rooms_budget_edge_cases_match():
+    """Kernel/fallback parity at the boundary conditions the random
+    sweep rarely lands on: zero budget, every track muted, and a budget
+    large enough to admit every top layer. These are the branches that
+    drift silently when the two-pass greedy is edited in one place."""
+    rng = np.random.default_rng(29)
+    R, T, S = 3, 4, 8
+    bit = (rng.random((R, T, 4, 4)) * 2e6).astype(np.float32)
+    ms = np.full((R, S, T), 3, np.int32)
+    mt = np.full((R, S, T), 3, np.int32)
+    cases = [
+        (np.zeros((R, S, T), bool), np.zeros((R, S), np.float32)),
+        (np.ones((R, S, T), bool),
+         (rng.random((R, S)) * 5e6).astype(np.float32)),
+        (np.zeros((R, S, T), bool), np.full((R, S), 1e9, np.float32)),
+    ]
+    for mu, bud in cases:
+        args = tuple(jnp.asarray(x) for x in (bit, ms, mt, mu, bud))
+        t0, u0, d0 = al.allocate_budget_rooms(*args, use_pallas=False)
+        t1, u1, d1 = al.allocate_budget_rooms(*args, interpret=True)
+        assert np.array_equal(np.asarray(t0), np.asarray(t1))
+        assert np.allclose(np.asarray(u0), np.asarray(u1), rtol=1e-5)
+        assert np.array_equal(np.asarray(d0), np.asarray(d1))

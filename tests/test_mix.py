@@ -94,3 +94,31 @@ def test_mix_room_batch_shape():
     )
     assert out.shape == (R, S, N)
     assert np.isfinite(np.asarray(out)).all()
+
+
+def test_runtime_device_mix_matches_host_sum():
+    """runtime/mixer.py's batched einsum path (the 1000-room MCU form)
+    is sample-exact against the per-room host policy it replaces:
+    sum every present track, minus the subscriber's own column. int16
+    samples summed in float32 stay below 2^24, so rounding recovers the
+    integer sum bit-exactly."""
+    from livekit_server_tpu.runtime import mixer as rtmixer
+
+    rng = np.random.default_rng(5)
+    R, T, S, N = 5, 3, 4, 64
+    pcm_i = rng.integers(-32768, 32768, (R, T, N)).astype(np.int64)
+    present = rng.random((R, T)) < 0.8
+    pcm_i[~present] = 0
+    exclude = rng.integers(0, T + 1, (R, S)).astype(np.int32)
+    out = np.asarray(rtmixer._device_mix(T, S, N)(
+        jnp.asarray(pcm_i.astype(np.float32)),
+        jnp.asarray(present),
+        jnp.asarray(exclude),
+    ))
+    for r in range(R):
+        for s in range(S):
+            ref = np.zeros(N, np.int64)
+            for t in range(T):
+                if present[r, t] and t != exclude[r, s]:
+                    ref += pcm_i[r, t]
+            assert np.array_equal(np.rint(out[r, s]).astype(np.int64), ref)

@@ -226,3 +226,58 @@ async def test_mixer_opt_out_does_not_instantiate():
     finally:
         transport.transport.close()
         await runtime.stop()
+
+
+class _StubTransport:
+    """Just enough UDPMediaTransport surface for AudioMixer: ssrc mint,
+    subscriber address book, and the _sendto chokepoint (captured)."""
+
+    def __init__(self):
+        self.sent = []
+        self.sub_addrs = {}
+        self.sub_sessions = {}
+        self.stats = {"tx": 0}
+        self._ssrc = 100
+
+    def _new_ssrc(self):
+        self._ssrc += 1
+        return self._ssrc
+
+    def _sendto(self, data, addr, session):
+        self.sent.append((addr, data))
+
+
+def test_device_mix_path_emits_identical_packets():
+    """The batched-einsum mix path (device_mix_min_rooms crossed — the
+    1000-room bench shape) must emit byte-identical Opus packets to the
+    per-room host path: the mix is a layout/batching decision, not an
+    audio one. Opus encode is deterministic for identical PCM, so any
+    sample drift in the einsum would surface as differing payloads."""
+    from livekit_server_tpu.runtime.mixer import AudioMixer
+
+    captured = []
+    for min_rooms in (1, 99):  # 1 = force device path; 99 = host path
+        t = _StubTransport()
+        mixer = AudioMixer(t)
+        mixer.device_mix_min_rooms = min_rooms
+        encs = {}
+        for room in range(3):
+            for sub in range(2):
+                t.sub_addrs[(room, sub)] = ("127.0.0.1", 4000 + room * 8 + sub)
+            mixer.enable_sub(room, 0, exclude_track=0)  # hears track 1 only
+            mixer.enable_sub(room, 1)                   # hears both
+            for track in range(2):
+                encs[(room, track)] = opus.OpusEncoder()
+        for frame in range(4):
+            for (room, track), enc in encs.items():
+                tone = _tone(300 + 200 * track + 40 * room, frame)
+                mixer.push(room, track, frame * 960, enc.encode(tone))
+            mixer.tick()
+        if min_rooms == 1:
+            assert mixer.stats["device_mix_frames"] == 4
+        else:
+            assert mixer.stats["device_mix_frames"] == 0
+        assert mixer.stats["packets_out"] == 3 * 2 * 4
+        captured.append(t.sent)
+        mixer.close()
+    assert captured[0] == captured[1]

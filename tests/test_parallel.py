@@ -131,6 +131,59 @@ def _interpreted_pallas_body() -> None:
     )
 
 
+def test_pooled_tick_page_sharded_matches_single_chip(mesh):
+    """parallel/mesh.py page_sharding's promised multi-chip run: the
+    STOCK pooled paged tick under plain GSPMD jit with every leaf's
+    page-pool axis split over the 8-device mesh (2 pages/device at
+    pool=16), against the single-chip tick on identical state. The
+    partitioner inserts the cross-shard tmembers gathers; per-page
+    results must be bit-identical — pool sharding is a layout decision,
+    not a numeric one. (The fused live-extent kernel stays single-chip:
+    PagedPlaneRuntime forces paged_kernel off under a pool mesh.)"""
+    from livekit_server_tpu.models import paged
+    from livekit_server_tpu.parallel.mesh import page_sharding, shard_pool
+    from tests.test_paged_kernel import (
+        _populated_state,
+        _rand_inputs,
+        _table_and_rows,
+    )
+
+    rng_a = np.random.default_rng(31)
+    rng_b = np.random.default_rng(31)
+    table, live, _, _ = _table_and_rows()
+    # shard_pool splits EVERY leaf's leading axis, including the
+    # room-indexed rooms_pages directory (host-delta bookkeeping the
+    # tick never reads) — widen it to one row per device so the 4-room
+    # fixture shards over the 8-device mesh.
+    table = table._replace(rooms_pages=jnp.full(
+        (8, table.rooms_pages.shape[1]), -1, jnp.int32))
+    ref_state = _populated_state(rng_a)
+    sh_state = shard_pool(_populated_state(rng_b), mesh)
+    sh_table = shard_pool(table, mesh)
+    shardings = {
+        s.sharding for s in jax.tree.leaves(sh_state) if s.ndim > 0
+    }
+    assert shardings == {page_sharding(mesh)}
+
+    ref_tick = jax.jit(lambda s, i: paged.paged_plane_tick(s, i, table))
+    sh_tick = jax.jit(paged.paged_plane_tick)
+    for t in range(3):
+        inp = _rand_inputs(rng_a, live)
+        sh_inp = shard_pool(_rand_inputs(rng_b, live), mesh)
+        ref_state, ref_out = ref_tick(ref_state, inp)
+        sh_state, sh_out = sh_tick(sh_state, sh_inp, sh_table)
+        jax.tree.map(
+            lambda a, b: np.testing.assert_array_equal(
+                np.asarray(a), np.asarray(b)),
+            ref_out, sh_out,
+        )
+        jax.tree.map(
+            lambda a, b: np.testing.assert_array_equal(
+                np.asarray(a), np.asarray(b)),
+            ref_state, sh_state,
+        )
+
+
 def test_sharded_tick_with_pallas_kernels_interpreted():
     """The TPU hot path runs the Pallas allocation + selection kernels
     INSIDE the room-vmapped, mesh-sharded tick (vmap batching rule under

@@ -135,3 +135,42 @@ def test_pallas_decide_rooms_matches_fallback():
             assert np.array_equal(np.asarray(xv), np.asarray(yv))
         for x, y in zip(a[1:], b[1:]):
             assert np.array_equal(np.asarray(x), np.asarray(y))
+
+
+def test_pallas_decide_rooms_state_feedback_parity():
+    """Sequence parity: the kernel's UPDATED selector state fed back
+    across ticks stays bit-identical to the fallback's — single-call
+    parity alone would miss drift that only compounds through the
+    current/target feedback loop (the production steady state)."""
+    import numpy as np
+
+    from livekit_server_tpu.ops import selector as sel
+
+    rng = np.random.default_rng(23)
+    R, T, K, S = 4, 3, 4, 8
+    st_a = st_b = sel.SelectorState(
+        current_spatial=jnp.full((R, T, S), -1, jnp.int32),
+        current_temporal=jnp.full((R, T, S), -1, jnp.int32),
+        target_spatial=jnp.asarray(rng.integers(0, 3, (R, T, S)), jnp.int32),
+        target_temporal=jnp.asarray(rng.integers(0, 4, (R, T, S)), jnp.int32),
+    )
+    is_svc = jnp.asarray(rng.random((R, T)) < 0.5)
+    is_video = jnp.asarray(rng.random((R, T)) < 0.7)
+    base = jnp.asarray(rng.random((R, T, S)) < 0.8)
+    for tick in range(4):
+        args = [jnp.asarray(rng.integers(0, 3, (R, T, K)), jnp.int32),
+                jnp.asarray(rng.integers(0, 4, (R, T, K)), jnp.int32),
+                jnp.asarray(rng.random((R, T, K)) < 0.3),
+                jnp.asarray(rng.random((R, T, K)) < 0.6),
+                jnp.asarray(rng.random((R, T, K)) < 0.4),
+                jnp.asarray(rng.random((R, T, K)) < 0.9),
+                jnp.asarray(rng.integers(40, 1300, (R, T, K)), jnp.int32)]
+        a = sel.decide_rooms(st_a, is_svc, is_video, base, *args,
+                             wire_overhead=46, use_pallas=False)
+        b = sel.decide_rooms(st_b, is_svc, is_video, base, *args,
+                             wire_overhead=46, interpret=True)
+        st_a, st_b = a[0], b[0]
+        for xv, yv in zip(st_a, st_b):
+            assert np.array_equal(np.asarray(xv), np.asarray(yv)), tick
+        for x, y in zip(a[1:], b[1:]):
+            assert np.array_equal(np.asarray(x), np.asarray(y)), tick

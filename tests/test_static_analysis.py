@@ -224,6 +224,41 @@ def test_gc02_rebound_shard_map_and_decorator(tmp_path):
     assert lines_of(findings, "GC02") == [7, 13]
 
 
+def test_gc02_pallas_call_with_prefetch_table(tmp_path):
+    """The ragged paged-tick idiom: a kernel body handed to pl.pallas_call
+    whose grid spec scalar-prefetches a page table. The body and the
+    index-map lambdas both trace — host impurities inside either must
+    flag; the builder around them is host code and must not."""
+    src = """\
+        import time
+        import jax
+        import numpy as np
+        from jax.experimental import pallas as pl
+        from jax.experimental.pallas import tpu as pltpu
+
+        def kernel(live_ref, x_ref, o_ref):
+            o_ref[...] = x_ref[...] * 2
+            t = time.perf_counter()      # line 9
+            np.asarray(o_ref)            # line 10
+
+        def build(live_rows, x):
+            t0 = time.perf_counter()     # host: builder, no finding
+            grid = pltpu.PrefetchScalarGridSpec(
+                num_scalar_prefetch=1, grid=(live_rows.shape[0],),
+                in_specs=[pl.BlockSpec(
+                    (1, 8), lambda i, lr: (lr[i], 0))],
+                out_specs=pl.BlockSpec((1, 8), lambda i, lr: (i, 0)),
+            )
+            return pl.pallas_call(
+                kernel, grid_spec=grid,
+                out_shape=jax.ShapeDtypeStruct(x.shape, x.dtype),
+            )(live_rows, x)
+    """
+    project = make_project(tmp_path, {"pkg/pk.py": src})
+    findings = gc02.run(project, cfg_for("gc02"))
+    assert lines_of(findings, "GC02") == [9, 10]
+
+
 # -- GC03 lock discipline ---------------------------------------------------
 
 GC03_FIXTURE = """\

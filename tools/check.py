@@ -100,6 +100,9 @@ def main(argv: list[str] | None = None) -> int:
         # planner still tiles exactly and never splits a room when fed an
         # extent-skewed entry distribution from a real pager.
         native_failures.extend(_pager_shard_smoke())
+        # Ragged paged-tick kernel: interpret-mode compile + run on a
+        # tiny page table, decide bits cross-checked vs the fallback.
+        native_failures.extend(_paged_kernel_smoke())
 
     # Opt-in latency smoke: the slow-marked express-lane wire-p99 test
     # (excluded from tier-1 by the `slow` marker). Runs in a subprocess
@@ -221,6 +224,73 @@ def _pager_shard_smoke() -> list[str]:
                     f"{int(seg[0])} across a cut at entry {int(a)}"
                 )
     return failures
+
+
+def _paged_kernel_smoke() -> list[str]:
+    """Compile-and-run the ragged paged-tick kernel (ops/paged_kernel.py)
+    in Pallas interpret mode on a tiny hand-built page table, and check
+    the forward decision bits against the gathered CPU fallback. Catches
+    a kernel that no longer traces (Mosaic/Pallas API drift) and decide
+    algebra divergence, without needing a TPU."""
+    import numpy as np
+
+    try:
+        import jax.numpy as jnp
+
+        from livekit_server_tpu.models import paged, plane
+        from livekit_server_tpu.ops import paged_kernel
+
+        PD = paged.PagedDims(rooms=2, tracks=4, pkts=2, subs=8,
+                             tpage=2, spage=4, pool_pages=8)
+        P, TP, K, SP = 8, 2, 2, 4
+        st = plane.init_state(PD.pooled())
+        sub = np.zeros((P, TP, SP), bool)
+        sub[[0, 2, 3]] = True
+        pub = np.zeros((P, TP), bool)
+        pub[[0, 2, 3]] = True
+        st = st._replace(
+            meta=st.meta._replace(published=jnp.asarray(pub)),
+            ctrl=st.ctrl._replace(subscribed=jnp.asarray(sub)),
+        )
+        rng = np.random.default_rng(11)
+        z = lambda sh, dt=np.int32: jnp.zeros(sh, dt)
+        inp = plane.TickInputs(
+            sn=jnp.asarray(rng.integers(0, 1000, (P, TP, K)), jnp.int32),
+            ts=z((P, TP, K)), layer=z((P, TP, K)), temporal=z((P, TP, K)),
+            keyframe=z((P, TP, K), bool), layer_sync=z((P, TP, K), bool),
+            begin_pic=z((P, TP, K), bool), end_frame=z((P, TP, K), bool),
+            pid=z((P, TP, K)), tl0=z((P, TP, K)), keyidx=z((P, TP, K)),
+            size=jnp.full((P, TP, K), 100, jnp.int32),
+            frame_ms=z((P, TP, K)), audio_level=z((P, TP, K)),
+            arrival_rtp=z((P, TP, K)), ts_jump=z((P, TP, K)),
+            valid=jnp.ones((P, TP, K), bool),
+            estimate=z((P, SP), np.float32),
+            estimate_valid=z((P, SP), bool), nacks=z((P, SP), np.float32),
+            pub_rtt_ms=z((P, TP), np.float32),
+            fb_delay_ms=z((P, SP), np.float32),
+            fb_recv_bps=z((P, SP), np.float32), fb_valid=z((P, SP), bool),
+            fb_enabled=z((P, SP), bool), sub_reset=z((P, SP), bool),
+            pad_num=z((P, SP)), pad_track=z((P, SP)) - 1,
+            tick_ms=jnp.asarray(10, jnp.int32),
+            roll_quality=jnp.asarray(0, jnp.int32),
+        )
+        base = st.ctrl.subscribed & ~st.ctrl.sub_muted & (
+            st.meta.published & ~st.meta.pub_muted)[:, :, None]
+        live = np.array([0, 2, 3, 0], np.int32)  # pow2-padded live rows
+        ik = paged_kernel.decide_pages(
+            st.sel, st.meta.is_svc, st.meta.is_video, base, inp, live,
+            wire_overhead=42, use_pallas=False, interpret=True)
+        fb = paged_kernel.decide_pages(
+            st.sel, st.meta.is_svc, st.meta.is_video, base, inp, live,
+            wire_overhead=42, use_pallas=False)
+        for f in ("send_bits", "drop_bits", "need_kf", "pkts_sent"):
+            a, b = np.asarray(getattr(ik, f)), np.asarray(getattr(fb, f))
+            if not np.array_equal(a, b):
+                return [f"paged kernel smoke: interpret vs fallback "
+                        f"diverge on {f}"]
+    except Exception as exc:
+        return [f"paged kernel smoke crashed: {exc!r}"]
+    return []
 
 
 if __name__ == "__main__":
