@@ -667,6 +667,10 @@ async def wire_bench(
         ):
             await asyncio.sleep(0.05)
 
+        # Close the recompile watchdog's warmup window with the warm
+        # ticks: compiles during the measurement window below are
+        # steady-state retraces (reported in the summary; should be 0).
+        runtime.mark_warm()
         # Measurement window: reset every counter the report reads.
         udp.fwd_latency.reset()
         udp.fwd_latency_express.reset()
@@ -771,6 +775,10 @@ async def wire_bench(
         # time actually goes — staging wait vs device step vs egress.
         "stages": (runtime.wire_stages.summary()
                    if runtime.wire_stages is not None else {}),
+        # Recompile watchdog over the measurement window: >0 means the
+        # steady-state tick path retraced mid-run.
+        "xla_compiles_post_warmup": runtime.compile_ledger.post_warmup,
+        "xla_warmup_compile_ms": round(runtime.compile_ledger.warmup_ms, 1),
         **({"task_errors": task_errors} if task_errors else {}),
     }
     trace_out = os.environ.get("BENCH_TRACE_OUT")
@@ -1632,6 +1640,15 @@ def main() -> None:
                 s: {"p50_ms": v.get("p50_ms"), "p99_ms": v.get("p99_ms")}
                 for s, v in st.items()
             }
+            break
+    # Recompile watchdog from the preferred wire run: post-warmup XLA
+    # compiles during the measurement window (0 = the steady-state tick
+    # path never retraced) and the warmup window's total compile time.
+    for wk in ("wire_local", "wire"):
+        w = RESULT.get(wk) or {}
+        if "xla_compiles_post_warmup" in w:
+            summary["xla_compiles_post_warmup"] = w["xla_compiles_post_warmup"]
+            summary["xla_warmup_compile_ms"] = w.get("xla_warmup_compile_ms")
             break
     if "skipped" in RESULT:
         summary["skipped"] = sorted(RESULT["skipped"])

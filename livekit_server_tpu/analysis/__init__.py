@@ -40,11 +40,39 @@ analyzers wired into the tier-1 gate:
        (RoomFence guarded writes, the KVRouter pin movers); a raw bus
        mutation on a literal fenced key bypasses the epoch CAS that
        keeps a stale owner from clobbering the takeover winner.
+  GC10 donation-discipline — every jax.jit wrap site's donate spec must
+       be live: a donate index naming an unused (or nonexistent)
+       parameter aliases nothing, and a traced tick that takes and
+       returns the plane state without donating it copies the whole
+       buffer per call. The AST half lives in gc10.py; the semantic
+       half (do donated leaves actually alias an output of matching
+       shape/dtype at canonical dims?) runs in devicecheck.py over the
+       `@device_entry` registry.
+  GC11 retrace-stability — static args to jit wraps must be hashable
+       and cache-stable: mutable literals at static call sites, typo'd
+       static_argnames, mutable static defaults, and per-call
+       `jax.jit(f)(x)` wrappers are findings. The runtime half is the
+       recompile watchdog (runtime/compile_ledger.py): post-warmup XLA
+       compile counts at /debug/compiles + livekit_xla_compiles_total,
+       asserted zero by the seeded tier-1 drills.
+  GC12 host-sync-hygiene — blocking device reads (block_until_ready,
+       device_get, .item(), np.asarray/float()/int() on device-named
+       values) reachable from the tick-path roots outside the declared
+       drain/telemetry seams stall the pipeline mid-tick; the one
+       sanctioned round trip per tick is the drain seam itself.
+
+The devicecheck pass (analysis/devicecheck.py, jax required, invoked by
+tools/check) complements these with abstract-eval compile contracts:
+every `@device_entry` point is eval_shape'd at canonical north-star and
+paged dims, and output shapes/dtypes/shardings plus jaxpr-derived
+FLOP/byte costs are pinned in tools/devicecheck_baseline.json.
 
 Suppressions: `# graftcheck: disable=GC01` on the finding's exact line
 (with a justification comment), `# graftcheck: disable-file=GC02` for a
 whole file, or a committed baseline for pre-existing findings — the
-baseline only shrinks (a stale entry fails the run).
+baseline only shrinks (a stale entry fails the run), and so do the
+suppressions themselves (a disable= that no longer matches any finding
+is reported as stale).
 
 Entry point: `python -m tools.check` (see tools/check.py).
 """

@@ -10,8 +10,10 @@ from __future__ import annotations
 
 import ast
 import fnmatch
+import io
 import json
 import re
+import tokenize
 from dataclasses import dataclass, field
 from pathlib import Path
 from typing import Callable
@@ -20,6 +22,7 @@ from typing import Callable
 
 RULES = (
     "GC01", "GC02", "GC03", "GC04", "GC05", "GC06", "GC07", "GC08", "GC09",
+    "GC10", "GC11", "GC12",
 )
 
 # Parse/config failures surface as findings too (rule GC00) so the runner
@@ -70,9 +73,7 @@ class SourceFile:
         # line (1-based) → rules disabled on exactly that line
         self.line_disables: dict[int, set[str]] = {}
         self.file_disables: set[str] = set()
-        for i, line in enumerate(self.lines, start=1):
-            if "graftcheck" not in line:
-                continue
+        for i, line in self._directive_lines():
             m = _DISABLE_FILE_RE.search(line)
             if m:
                 self.file_disables |= _rule_list(m.group(1))
@@ -82,6 +83,31 @@ class SourceFile:
                 self.line_disables.setdefault(i, set()).update(
                     _rule_list(m.group(1))
                 )
+
+    def _directive_lines(self):
+        """(lineno, comment text) for real COMMENT tokens only.
+
+        Tokenizing (rather than scanning raw lines) keeps directive text
+        quoted inside docstrings — e.g. the suppression docs in
+        analysis/__init__.py — from registering as live suppressions,
+        which matters now that a suppression matching no finding is
+        itself an error. Falls back to the raw-line scan when the file
+        doesn't tokenize (it then has a parse_error finding anyway).
+        """
+        try:
+            return [
+                (tok.start[0], tok.string)
+                for tok in tokenize.generate_tokens(
+                    io.StringIO(self.text).readline
+                )
+                if tok.type == tokenize.COMMENT and "graftcheck" in tok.string
+            ]
+        except (tokenize.TokenError, IndentationError, SyntaxError):
+            return [
+                (i, line)
+                for i, line in enumerate(self.lines, start=1)
+                if "graftcheck" in line
+            ]
 
     def suppressed(self, rule: str, line: int) -> bool:
         if rule in self.file_disables:
@@ -319,6 +345,86 @@ DEFAULT_CONFIG: dict = {
             "FailoverOrchestrator.run_once",
         ],
     },
+    "gc10": {
+        # Donation discipline at jit wrap sites. The semantic half (do
+        # donated leaves actually alias an output of matching shape/
+        # dtype?) runs in devicecheck.py against the entry registry;
+        # this AST half catches the wrap-site shapes the registry can't
+        # see: a mutated-state tick jitted WITHOUT donation (a silent
+        # whole-buffer copy per tick) and donate indices that point at
+        # missing or unused parameters.
+        "paths": ["livekit_server_tpu"],
+        # parameter names that denote the mutated plane buffer: a traced
+        # function taking AND returning one must donate it.
+        "state_params": ["state"],
+        # wrap sites inside these functions (fnmatch on Class.method /
+        # outer.inner) may legitimately skip donation: init/restore
+        # paths run once and often need the un-donated source intact.
+        "allow_missing": [
+            "*restore*", "*init*", "*_build_live_decide*",
+        ],
+    },
+    "gc11": {
+        # Retrace stability: jit wrappers whose static args or wrap
+        # pattern cause per-call retraces. The runtime half is the
+        # CompileLedger watchdog (runtime/compile_ledger.py).
+        "paths": ["livekit_server_tpu"],
+        # decorators that make a per-call jit construction safe (the
+        # wrapper is built once and memoized)
+        "cache_decorators": ["lru_cache", "cache"],
+    },
+    "gc12": {
+        # Host-sync hygiene: blocking device reads reachable from the
+        # tick path must happen only at the declared drain/telemetry
+        # seams. Roots are the per-tick driver methods; seams are the
+        # sanctioned device→host transfer points (fnmatch quals).
+        "paths": ["livekit_server_tpu/runtime"],
+        "roots": [
+            "PlaneRuntime._device_step",
+            "PlaneRuntime._stage_host",
+            "PlaneRuntime._upload_ctrl",
+            "PlaneRuntime._complete",
+            "PagedPlaneRuntime._device_step",
+            "PagedPlaneRuntime._live_step",
+            "PagedPlaneRuntime._sync_pages",
+            "PagedPlaneRuntime._upload_ctrl",
+        ],
+        "seams": [
+            "*._unpack_outputs",
+            "*._sel_mirror",
+            "*.maybe_audit",
+            "*.maybe_bitflip",
+            "*._audit_page_table",
+            "*.map_audit_mask",
+            "*.post_mirror",
+            "*.record_tick",
+        ],
+        # np.asarray / np.array / float() / int() are host-side no-ops
+        # on host data; they only block when fed a device array. Flag
+        # them when the argument expression mentions one of these names
+        # (device-resident by convention in the runtime).
+        "device_names": ["state", "out", "buf", "dec", "table"],
+    },
+    "devicecheck": {
+        # Compile-contract registry (analysis/devicecheck.py): entries,
+        # canonical dims and the committed baseline live there; this
+        # table only carries the knobs.
+        "baseline": "tools/devicecheck_baseline.json",
+        # relative tolerance on the jaxpr-derived flop/byte estimates —
+        # shapes and dtypes compare exactly, cost drifts only fail past
+        # this band (a broadcast blow-up moves cost by integer factors).
+        "cost_rtol": 0.25,
+        # entries allowed to skip donation entirely (init/constant/
+        # compact-extent paths where outputs cannot alias inputs)
+        "allow_no_donate": [
+            "plane.init_state", "paged.page_init_template",
+            "paged.dead_page_outputs", "paged_kernel.decide_pages",
+            "mix.mix_tick", "mix.decode_tick", "mixer.device_mix",
+        ],
+        # minimum leaf size (bytes) above which a mutated-and-returned
+        # buffer must be donated
+        "min_donate_bytes": 1048576,
+    },
 }
 
 
@@ -366,9 +472,17 @@ def qual_allowed(qual: str, patterns: list[str]) -> bool:
 # -- engine -----------------------------------------------------------------
 
 def run_all(
-    project: Project, config: Config, rules: list[str] | None = None
+    project: Project, config: Config, rules: list[str] | None = None,
+    stale_suppressions: list[Finding] | None = None,
 ) -> list[Finding]:
-    """Run the analyzers, apply per-line/file suppressions, sort."""
+    """Run the analyzers, apply per-line/file suppressions, sort.
+
+    When `stale_suppressions` is passed, inline `# graftcheck: disable=`
+    directives that suppressed NOTHING for a rule that ran are appended
+    to it as GC00 findings — the shrink-only contract for the baseline,
+    extended to suppressions: a directive may only exist while its
+    finding does.
+    """
     from livekit_server_tpu.analysis import (
         gc01,
         gc02,
@@ -379,6 +493,9 @@ def run_all(
         gc07,
         gc08,
         gc09,
+        gc10,
+        gc11,
+        gc12,
     )
 
     impls: dict[str, Callable[[Project, dict], list[Finding]]] = {
@@ -391,6 +508,9 @@ def run_all(
         "GC07": gc07.run,
         "GC08": gc08.run,
         "GC09": gc09.run,
+        "GC10": gc10.run,
+        "GC11": gc11.run,
+        "GC12": gc12.run,
     }
     findings: list[Finding] = []
     for f in project.files:
@@ -401,14 +521,42 @@ def run_all(
                     f"syntax error: {f.parse_error.msg}",
                 )
             )
-    for rule in rules or list(impls):
+    ran = list(rules or list(impls))
+    for rule in ran:
         findings.extend(impls[rule](project, config.rule(rule.lower())))
     kept = []
+    hit: set[tuple[str, int, str]] = set()      # (path, line, rule) used
+    hit_file: set[tuple[str, str]] = set()      # (path, rule) used
     for fd in findings:
         sf = project.by_rel.get(fd.path)
         if sf is not None and sf.suppressed(fd.rule, fd.line):
+            hit_file.add((fd.path, fd.rule))
+            if fd.rule in sf.line_disables.get(fd.line, set()):
+                hit.add((fd.path, fd.line, fd.rule))
             continue
         kept.append(fd)
+    if stale_suppressions is not None:
+        ran_set = set(ran)
+        for sf in project.files:
+            for line, ruleset in sorted(sf.line_disables.items()):
+                for rule in sorted(ruleset & ran_set):
+                    if (sf.rel, line, rule) not in hit:
+                        stale_suppressions.append(Finding(
+                            PARSE_RULE, sf.rel, line,
+                            f"stale suppression: disable={rule} matches "
+                            "no finding on this line",
+                            hint="the finding it silenced is gone — "
+                            "delete the directive",
+                        ))
+            for rule in sorted(sf.file_disables & ran_set):
+                if (sf.rel, rule) not in hit_file:
+                    stale_suppressions.append(Finding(
+                        PARSE_RULE, sf.rel, 1,
+                        f"stale suppression: disable-file={rule} matches "
+                        "no finding in this file",
+                        hint="the findings it silenced are gone — "
+                        "delete the directive",
+                    ))
     kept.sort(key=lambda fd: (fd.path, fd.line, fd.rule, fd.message))
     return kept
 

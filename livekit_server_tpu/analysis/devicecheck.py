@@ -1,0 +1,644 @@
+"""devicecheck — abstract-eval compile contracts for the device plane.
+
+Every hot jit/shard_map/pallas entry point registered via
+`@device_entry` (analysis/registry.py) is `jax.eval_shape`'d against
+canonical dims derived from `config/config.py` defaults — the dense
+north-star plane and the paged pool it maps to — entirely on CPU, with
+no device execution and no backend compile. Three artifacts come out
+per entry:
+
+  * the output contract: leaf shapes + dtypes (and, for the mesh entry,
+    the room-axis partition specs) — catches accidental f64 promotion,
+    broadcast blow-ups and lost shardings at review time;
+  * a jaxpr-derived FLOP/byte estimate — a deterministic walk of the
+    traced jaxpr (dot_general counted as 2·M·N·K, everything else as
+    output elements; bytes as in+out leaf sizes). Not a profiler — a
+    drift tripwire: a broadcast that materializes a [P,T,K,S] dense
+    mask moves these numbers by integer factors;
+  * the donation contract (GC10 semantic half): each donated input
+    leaf must alias an output leaf of matching shape/dtype (dead
+    donations flagged), and any ≥1 MB input leaf whose shape/dtype
+    reappears in the outputs must be donated (missing donations
+    flagged, `allow_no_donate` for init/constant/compact-extent
+    entries).
+
+Contracts snapshot into the committed `tools/devicecheck_baseline.json`
+(shrink-only, like the graftcheck baseline: drift or stale entries fail
+`tools/check`; re-snapshot intentional changes with
+`python -m tools.check --resnapshot`).
+"""
+
+from __future__ import annotations
+
+import json
+from collections import Counter
+from pathlib import Path
+from typing import Any, Callable
+
+from livekit_server_tpu.analysis.core import Finding
+from livekit_server_tpu.analysis import registry
+
+BASELINE_VERSION = 1
+
+# rule id for contract drift (GC10 keeps the donation findings)
+DRIFT_RULE = "DEVC"
+
+
+# -- canonical dims ---------------------------------------------------------
+
+def canonical_dims():
+    """(dense PlaneDims, PagedDims) from the PlaneConfig defaults — the
+    same derivation service/roommanager.py uses (pool_pages=0 → dense-
+    equivalent capacity)."""
+    from livekit_server_tpu.config.config import PlaneConfig
+    from livekit_server_tpu.models import paged, plane
+
+    pc = PlaneConfig()
+    dense = plane.PlaneDims(
+        pc.rooms, pc.tracks_per_room, pc.pkts_per_track, pc.subs_per_room
+    )
+    pool = pc.pager_pool_pages or (
+        pc.rooms
+        * (pc.tracks_per_room // pc.pager_tpage)
+        * (pc.subs_per_room // pc.pager_spage)
+    )
+    pdims = paged.PagedDims(
+        pc.rooms, pc.tracks_per_room, pc.pkts_per_track, pc.subs_per_room,
+        pc.pager_tpage, pc.pager_spage, pool,
+    )
+    return dense, pdims
+
+
+def _zero_inputs(dims):
+    """Abstract-buildable zero TickInputs at `dims` (traced shapes only —
+    call under jax.eval_shape)."""
+    import jax.numpy as jnp
+
+    from livekit_server_tpu.models import plane
+
+    R, T, K, S = dims
+    z = jnp.zeros
+    return plane.TickInputs(
+        sn=z((R, T, K), jnp.int32), ts=z((R, T, K), jnp.int32),
+        layer=z((R, T, K), jnp.int32), temporal=z((R, T, K), jnp.int32),
+        keyframe=z((R, T, K), bool), layer_sync=z((R, T, K), bool),
+        begin_pic=z((R, T, K), bool), end_frame=z((R, T, K), bool),
+        pid=z((R, T, K), jnp.int32), tl0=z((R, T, K), jnp.int32),
+        keyidx=z((R, T, K), jnp.int32), size=z((R, T, K), jnp.int32),
+        frame_ms=z((R, T, K), jnp.int32), audio_level=z((R, T, K), jnp.int32),
+        arrival_rtp=z((R, T, K), jnp.int32), ts_jump=z((R, T, K), jnp.int32),
+        valid=z((R, T, K), bool),
+        estimate=z((R, S), jnp.float32), estimate_valid=z((R, S), bool),
+        nacks=z((R, S), jnp.float32), pub_rtt_ms=z((R, T), jnp.float32),
+        fb_delay_ms=z((R, S), jnp.float32),
+        fb_recv_bps=z((R, S), jnp.float32),
+        fb_valid=z((R, S), bool), fb_enabled=z((R, S), bool),
+        sub_reset=z((R, S), bool), pad_num=z((R, S), jnp.int32),
+        pad_track=z((R, S), jnp.int32),
+        tick_ms=jnp.int32(10), roll_quality=jnp.int32(0),
+    )
+
+
+# -- entry specs ------------------------------------------------------------
+#
+# Each spec: a thunk returning (fn, args tuple, kwargs) where args are
+# built INSIDE jax.eval_shape (so north-star dims never allocate), plus
+# the donation contract the runtime applies when it jits the entry.
+
+class EntrySpec:
+    def __init__(self, name: str, build: Callable[[], tuple],
+                 donate: tuple[int, ...] = (), mesh_sharded: bool = False,
+                 cost_from: str | None = None):
+        self.name = name
+        self.build = build        # () -> (fn, abstract args tuple, kwargs)
+        self.donate = donate
+        self.mesh_sharded = mesh_sharded
+        # name of an earlier entry this one's contract derives from
+        # without tracing: make_sharded_tick shard_maps that same tick,
+        # so its out tree, cost and (shape-derived) partition specs are
+        # the referenced entry's by construction — re-tracing it through
+        # shard_map costs >1 s of the <5 s budget for no new info
+        self.cost_from = cost_from
+
+
+def _specs() -> list[EntrySpec]:
+    import jax
+    import jax.numpy as jnp
+
+    registry.import_all()
+    from livekit_server_tpu.models import paged, plane
+
+    dense, pdims = canonical_dims()
+    pooled = pdims.pooled()
+
+    def abstract(th):
+        return jax.eval_shape(th)
+
+    def dense_state():
+        return abstract(lambda: plane.init_state(dense))
+
+    def dense_inp():
+        return abstract(lambda: _zero_inputs(dense))
+
+    def pooled_state():
+        return abstract(lambda: plane.init_state(pooled))
+
+    def pooled_inp():
+        return abstract(lambda: _zero_inputs(pooled))
+
+    def table():
+        return abstract(lambda: paged.init_table(pdims))
+
+    P = pdims.pool_pages
+    NL = max(1, P // 2)   # compact live extent: half the pool live
+    sds = jax.ShapeDtypeStruct
+
+    def live_rows():
+        return sds((NL,), jnp.int32)
+
+    def live_inv():
+        return sds((P,), jnp.int32)
+
+    def decide():
+        from livekit_server_tpu.ops import paged_kernel
+        from livekit_server_tpu.ops import pacer
+
+        st = pooled_state()
+        return jax.eval_shape(
+            lambda s, i, lr: paged_kernel.decide_pages(
+                s.sel, s.meta.is_svc, s.meta.is_video,
+                s.ctrl.subscribed & ~s.ctrl.sub_muted
+                & (s.meta.published & ~s.meta.pub_muted)[:, :, None],
+                i, lr, wire_overhead=pacer.WIRE_OVERHEAD_BYTES,
+                use_pallas=False,
+            ),
+            st, pooled_inp(), sds((NL,), jnp.int32),
+        )
+
+    specs = [
+        EntrySpec(
+            "plane.init_state",
+            lambda: (lambda: registry.entry("plane.init_state")(dense),
+                     (), {}),
+        ),
+        EntrySpec(
+            "plane.media_plane_tick",
+            lambda: (registry.entry("plane.media_plane_tick"),
+                     (dense_state(), dense_inp()), {}),
+            donate=(0,),
+        ),
+        EntrySpec(
+            "plane.apply_ctrl_delta",
+            lambda: (registry.entry("plane.apply_ctrl_delta"),
+                     (dense_state(), sds((8,), jnp.int32),
+                      sds((4, 8, dense.tracks), jnp.int32),
+                      sds((4, 8, dense.tracks, dense.subs), jnp.int32)), {}),
+            donate=(0,),
+        ),
+        EntrySpec(
+            "paged.page_init_template",
+            lambda: (lambda: registry.entry("paged.page_init_template")(
+                         pdims),
+                     (), {}),
+        ),
+        EntrySpec(
+            "paged.paged_plane_tick",
+            lambda: (registry.entry("paged.paged_plane_tick"),
+                     (pooled_state(), pooled_inp(), table()), {}),
+            donate=(0,),
+        ),
+        EntrySpec(
+            "paged.paged_plane_tick_live",
+            lambda: (registry.entry("paged.paged_plane_tick_live"),
+                     (pooled_state(), pooled_inp(), table(),
+                      live_rows(), live_inv(), decide()), {}),
+            donate=(0,),
+        ),
+        EntrySpec(
+            "paged.paged_plane_tick_fused",
+            lambda: (registry.entry("paged.paged_plane_tick_fused"),
+                     (pooled_state(), pooled_inp(), table(),
+                      live_rows(), live_inv()), {"use_pallas": False}),
+            donate=(0,),
+        ),
+        EntrySpec(
+            "paged.dead_page_outputs",
+            lambda: (lambda inp: registry.entry("paged.dead_page_outputs")(
+                         pdims.max_tpages, pdims.tpage, pdims.pkts,
+                         pdims.spage, inp),
+                     (pooled_inp(),), {}),
+        ),
+        EntrySpec(
+            "paged.apply_table_delta",
+            lambda: (registry.entry("paged.apply_table_delta"),
+                     (table(), sds((16,), jnp.int32),
+                      sds((16, pdims.max_tpages), jnp.int32),
+                      sds((16,), jnp.int32), sds((16,), jnp.int32),
+                      sds((16,), jnp.int32), sds((8,), jnp.int32),
+                      sds((8, pdims.max_tpages * pdims.max_spages),
+                          jnp.int32)), {}),
+            donate=(0,),
+        ),
+        EntrySpec(
+            "paged.reinit_pages",
+            lambda: (registry.entry("paged.reinit_pages"),
+                     (pooled_state(), sds((16,), jnp.int32),
+                      abstract(lambda: paged.page_init_template(pdims))),
+                     {}),
+            donate=(0,),
+        ),
+        EntrySpec(
+            "paged.move_state_rows",
+            lambda: (registry.entry("paged.move_state_rows"),
+                     (pooled_state(), sds((16,), jnp.int32),
+                      sds((16,), jnp.int32)), {}),
+            donate=(0,),
+        ),
+        EntrySpec(
+            "paged_kernel.decide_pages",
+            lambda: (_decide_entry(),
+                     (pooled_state(), pooled_inp(), live_rows()), {}),
+        ),
+        EntrySpec(
+            "mix.mix_tick",
+            lambda: (registry.entry("mix.mix_tick"),
+                     (sds((dense.rooms, dense.tracks, 240), jnp.float32),
+                      sds((dense.rooms, dense.tracks), jnp.float32),
+                      sds((dense.rooms, dense.tracks), bool),
+                      sds((dense.rooms, dense.subs), jnp.int32),
+                      sds((dense.rooms, dense.tracks), jnp.float32)), {}),
+        ),
+        EntrySpec(
+            "mix.decode_tick",
+            lambda: (registry.entry("mix.decode_tick"),
+                     (sds((dense.rooms, dense.tracks, 240), jnp.uint8),
+                      sds((dense.rooms, dense.tracks), jnp.int32)), {}),
+        ),
+        EntrySpec(
+            "mixer.device_mix",
+            lambda: (registry.entry("mixer.device_mix")(
+                         dense.tracks, dense.subs, 240),
+                     (sds((dense.rooms, dense.tracks, 240), jnp.float32),
+                      sds((dense.rooms, dense.tracks), bool),
+                      sds((dense.rooms, dense.subs), jnp.int32)), {}),
+        ),
+        EntrySpec(
+            "mesh.sharded_tick",
+            lambda: (_mesh_entry(), (dense_state(), dense_inp()), {}),
+            donate=(0,), mesh_sharded=True,
+            cost_from="plane.media_plane_tick",
+        ),
+    ]
+    return specs
+
+
+def _decide_entry():
+    """decide_pages with the state unpacked the way the runtime calls it
+    (fallback path — the Pallas path needs a TPU; the contract covers
+    shapes, which are mode-invariant by the parity tests)."""
+    from livekit_server_tpu.ops import pacer, paged_kernel
+
+    def f(state, inp, live_rows):
+        base = (
+            state.ctrl.subscribed & ~state.ctrl.sub_muted
+            & (state.meta.published & ~state.meta.pub_muted)[:, :, None]
+        )
+        return paged_kernel.decide_pages(
+            state.sel, state.meta.is_svc, state.meta.is_video, base, inp,
+            live_rows, wire_overhead=pacer.WIRE_OVERHEAD_BYTES,
+            use_pallas=False,
+        )
+
+    return f
+
+
+def _mesh_entry():
+    from livekit_server_tpu.parallel import mesh
+
+    m = mesh.make_mesh(n_devices=1)
+    return mesh.make_sharded_tick(m)
+
+
+# -- contract computation ---------------------------------------------------
+
+def _leaf_contract(leaf) -> dict:
+    return {"shape": list(leaf.shape), "dtype": str(leaf.dtype)}
+
+
+def _jaxpr_cost(jaxpr) -> tuple[int, int]:
+    """Deterministic (flops, bytes) estimate: dot_general as 2·M·N·K,
+    every other eqn as its output element count; bytes as in+out leaf
+    bytes of the top-level jaxpr. Recurses into pjit/scan/while/cond
+    sub-jaxprs (counted once — an estimator, not a simulator)."""
+    import numpy as np
+
+    def aval_elems(v) -> int:
+        try:
+            return int(np.prod(v.aval.shape))
+        except Exception:
+            return 0
+
+    def walk(jx) -> int:
+        flops = 0
+        for eqn in jx.eqns:
+            subs = [
+                p for p in eqn.params.values()
+                if hasattr(p, "jaxpr") or hasattr(p, "eqns")
+            ]
+            if subs:
+                for s in subs:
+                    flops += walk(s.jaxpr if hasattr(s, "jaxpr") else s)
+                continue
+            out_elems = sum(aval_elems(v) for v in eqn.outvars)
+            if eqn.primitive.name == "dot_general":
+                dn = eqn.params["dimension_numbers"]
+                (lc, _), _ = dn
+                lhs = eqn.invars[0].aval.shape
+                k = int(np.prod([lhs[i] for i in lc])) or 1
+                flops += 2 * k * out_elems
+            else:
+                flops += out_elems
+        return flops
+
+    core = jaxpr.jaxpr if hasattr(jaxpr, "jaxpr") else jaxpr
+    flops = walk(core)
+
+    def leaf_bytes(v) -> int:
+        try:
+            return int(np.prod(v.aval.shape)) * v.aval.dtype.itemsize
+        except Exception:
+            return 0
+
+    nbytes = sum(leaf_bytes(v) for v in core.invars) + sum(
+        leaf_bytes(v) for v in core.outvars
+    )
+    return flops, nbytes
+
+
+def _mesh_specs(tree) -> list[str]:
+    """Textual partition specs the mesh entry promises: the room axis
+    sharded on every non-scalar leaf (the same derivation
+    make_sharded_tick uses for its out_specs)."""
+    import jax
+
+    return [
+        "P()" if getattr(leaf, "ndim", 0) == 0 else "P('rooms')"
+        for leaf in jax.tree.leaves(tree)
+    ]
+
+
+def entry_contract(spec: EntrySpec) -> dict:
+    """Trace one entry: output contract + cost + donation audit input."""
+    import jax
+
+    fn, args, kwargs = spec.build()
+    # kwargs are static policy knobs (use_pallas=False, ...): close over
+    # them so eval_shape never sees — and never traces — a python bool
+    wrapped = (lambda *a: fn(*a, **kwargs)) if kwargs else fn
+    # one trace yields both the output pytree and the jaxpr (a
+    # separate eval_shape would re-trace every entry and blow the
+    # <5 s budget)
+    jaxpr, out = jax.make_jaxpr(wrapped, return_shape=True)(*args)
+    flops, nbytes = _jaxpr_cost(jaxpr)
+    contract = {
+        "out": [_leaf_contract(leaf) for leaf in jax.tree.leaves(out)],
+        "flops": int(flops),
+        "bytes": int(nbytes),
+        "donate": list(spec.donate),
+    }
+    if spec.mesh_sharded:
+        contract["sharding"] = _mesh_specs(out)
+    return contract, args, out
+
+
+def audit_donation(
+    args, out, donate: tuple[int, ...], *,
+    min_bytes: int = 1 << 20, allow_no_donate: bool = False,
+) -> list[str]:
+    """GC10 semantic audit over abstract in/out trees. Returns human
+    reasons ('' prefix dead:/missing:) — the caller attaches file/line.
+    """
+    import jax
+    import numpy as np
+
+    def leaves(tree):
+        return [
+            leaf for leaf in jax.tree.leaves(tree)
+            if hasattr(leaf, "shape")
+        ]
+
+    def key(leaf):
+        return (tuple(leaf.shape), str(leaf.dtype))
+
+    def size(leaf):
+        return int(np.prod(leaf.shape)) * np.dtype(leaf.dtype).itemsize
+
+    avail = Counter(key(leaf) for leaf in leaves(out))
+    problems: list[str] = []
+    for i in donate:
+        if i >= len(args):
+            problems.append(f"dead: donate index {i} out of range")
+            continue
+        for leaf in leaves(args[i]):
+            if avail.get(key(leaf), 0) > 0:
+                avail[key(leaf)] -= 1
+            else:
+                problems.append(
+                    f"dead: donated arg {i} leaf {key(leaf)[0]}/"
+                    f"{key(leaf)[1]} aliases no output of matching "
+                    "shape/dtype"
+                )
+    if not allow_no_donate:
+        for i, a in enumerate(args):
+            if i in donate:
+                continue
+            for leaf in leaves(a):
+                if size(leaf) >= min_bytes and avail.get(key(leaf), 0) > 0:
+                    avail[key(leaf)] -= 1
+                    problems.append(
+                        f"missing: arg {i} leaf {key(leaf)[0]}/"
+                        f"{key(leaf)[1]} "
+                        f"({size(leaf) // 1024} KiB) matches an output "
+                        "but is not donated — a full copy per call"
+                    )
+    return problems
+
+
+# -- baseline + runner ------------------------------------------------------
+
+def load_baseline(path: Path) -> dict:
+    p = Path(path)
+    if not p.exists():
+        return {}
+    return json.loads(p.read_text()).get("entries", {})
+
+
+def write_baseline(path: Path, contracts: dict) -> None:
+    Path(path).write_text(
+        json.dumps(
+            {"version": BASELINE_VERSION,
+             "entries": dict(sorted(contracts.items()))},
+            indent=1, sort_keys=False,
+        ) + "\n"
+    )
+
+
+def _entry_site(name: str) -> tuple[str, int]:
+    """(repo-relative path, lineno) of the registered entry, for
+    file:line findings."""
+    import inspect
+
+    registry.import_all()
+    info = registry.DEVICE_ENTRIES.get(name)
+    if info is None:
+        return ("livekit_server_tpu/analysis/devicecheck.py", 1)
+    try:
+        fn = info["fn"]
+        fn = inspect.unwrap(fn)
+        code = getattr(fn, "__code__", None) or getattr(
+            getattr(fn, "__wrapped__", None), "__code__", None
+        )
+        src = inspect.getsourcefile(fn) or ""
+        line = (code.co_firstlineno if code is not None
+                else inspect.getsourcelines(fn)[1])
+        idx = src.find("livekit_server_tpu")
+        return (src[idx:] if idx >= 0 else src, line)
+    except (TypeError, OSError):
+        return ("livekit_server_tpu/analysis/devicecheck.py", 1)
+
+
+def compute_contracts() -> tuple[dict, list[Finding]]:
+    """Trace every registered entry; returns (contracts by name,
+    donation findings)."""
+    cfg = _cfg()
+    contracts: dict[str, dict] = {}
+    findings: list[Finding] = []
+    allow = set(cfg.get("allow_no_donate", []))
+    min_bytes = int(cfg.get("min_donate_bytes", 1 << 20))
+    for spec in _specs():
+        if spec.cost_from is not None and spec.cost_from in contracts:
+            # derived entry: contract copied from the entry it wraps;
+            # partition specs follow _mesh_specs' shape rule. The
+            # donation audit already ran on the referenced entry.
+            ref = contracts[spec.cost_from]
+            contract = {
+                "out": [dict(leaf) for leaf in ref["out"]],
+                "flops": ref["flops"],
+                "bytes": ref["bytes"],
+                "donate": list(spec.donate),
+            }
+            if spec.mesh_sharded:
+                contract["sharding"] = [
+                    "P()" if not leaf["shape"] else "P('rooms')"
+                    for leaf in ref["out"]
+                ]
+            contracts[spec.name] = contract
+            continue
+        contract, args, out = entry_contract(spec)
+        contracts[spec.name] = contract
+        path, line = _entry_site(spec.name)
+        for why in audit_donation(
+            args, out, spec.donate, min_bytes=min_bytes,
+            allow_no_donate=spec.name in allow,
+        ):
+            findings.append(Finding(
+                "GC10", path, line,
+                f"devicecheck entry `{spec.name}`: {why}",
+                hint="fix the donation contract, or allowlist the "
+                "entry under [tool.graftcheck.devicecheck] "
+                "allow_no_donate if outputs genuinely cannot alias",
+            ))
+    return contracts, findings
+
+
+def _cfg() -> dict:
+    from livekit_server_tpu.analysis.core import DEFAULT_CONFIG, load_config
+
+    root = Path(__file__).resolve().parents[2]
+    try:
+        return load_config(root).rule("devicecheck")
+    except Exception:
+        return dict(DEFAULT_CONFIG["devicecheck"])
+
+
+def diff_contracts(
+    contracts: dict, baseline: dict, *, cost_rtol: float = 0.25,
+) -> tuple[list[Finding], list[str]]:
+    """(drift findings, stale baseline entry names). Shapes/dtypes/
+    shardings compare exactly; flops/bytes within ±cost_rtol."""
+    findings: list[Finding] = []
+    for name, got in contracts.items():
+        path, line = _entry_site(name)
+        want = baseline.get(name)
+        if want is None:
+            findings.append(Finding(
+                DRIFT_RULE, path, line,
+                f"entry `{name}` has no committed contract",
+                hint="python -m tools.check --resnapshot",
+            ))
+            continue
+        if got["out"] != want.get("out"):
+            findings.append(Finding(
+                DRIFT_RULE, path, line,
+                f"entry `{name}` output contract drifted: "
+                f"{_shape_diff(want.get('out', []), got['out'])}",
+                hint="shape/dtype drift — fix the regression, or "
+                "re-snapshot if intentional (--resnapshot)",
+            ))
+        if got.get("sharding") != want.get("sharding"):
+            findings.append(Finding(
+                DRIFT_RULE, path, line,
+                f"entry `{name}` output sharding drifted",
+                hint="the mesh entry lost/changed a room-axis "
+                "partition spec",
+            ))
+        if list(got.get("donate", [])) != list(want.get("donate", [])):
+            findings.append(Finding(
+                DRIFT_RULE, path, line,
+                f"entry `{name}` donation contract drifted: "
+                f"{want.get('donate')} → {got.get('donate')}",
+                hint="--resnapshot if intentional",
+            ))
+        for k in ("flops", "bytes"):
+            w, g = want.get(k, 0), got.get(k, 0)
+            if w and abs(g - w) > cost_rtol * w:
+                findings.append(Finding(
+                    DRIFT_RULE, path, line,
+                    f"entry `{name}` {k} drifted {w} → {g} "
+                    f"(>{int(cost_rtol * 100)}% — broadcast blow-up or "
+                    "dtype promotion?)",
+                    hint="inspect the jaxpr; --resnapshot if "
+                    "intentional",
+                ))
+    stale = sorted(set(baseline) - set(contracts))
+    return findings, stale
+
+
+def _shape_diff(want: list[dict], got: list[dict]) -> str:
+    if len(want) != len(got):
+        return f"{len(want)} output leaves → {len(got)}"
+    for i, (w, g) in enumerate(zip(want, got)):
+        if w != g:
+            return (f"leaf {i}: {w.get('shape')}/{w.get('dtype')} → "
+                    f"{g.get('shape')}/{g.get('dtype')}")
+    return "contract changed"
+
+
+def run_check(
+    root: Path | None = None, *, resnapshot: bool = False,
+) -> tuple[list[Finding], list[str]]:
+    """The tools/check entry: (findings, stale baseline names). With
+    `resnapshot`, rewrite the baseline from the live tree first (the
+    sanctioned way to land an intentional contract change)."""
+    cfg = _cfg()
+    root = Path(root) if root is not None else Path(
+        __file__).resolve().parents[2]
+    bpath = root / cfg.get("baseline", "tools/devicecheck_baseline.json")
+    contracts, findings = compute_contracts()
+    if resnapshot:
+        write_baseline(bpath, contracts)
+    drift, stale = diff_contracts(
+        contracts, load_baseline(bpath),
+        cost_rtol=float(cfg.get("cost_rtol", 0.25)),
+    )
+    return findings + drift, stale

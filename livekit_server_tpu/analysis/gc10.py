@@ -1,0 +1,216 @@
+"""GC10 — donation discipline at jit wrap sites.
+
+A jitted tick that takes the plane state and returns its successor
+without `donate_argnums` forces XLA to materialize the output in fresh
+HBM every call — a whole-pool copy per tick for the paged plane. The
+inverse bug is quieter: a donate index naming a parameter the function
+never uses (or that doesn't exist) donates a buffer XLA can't alias,
+silently freeing the caller's array for nothing.
+
+This rule walks every `jax.jit` wrap site (call form, decorator form,
+`functools.partial(jax.jit, ...)` decorators) and checks, per traced
+function:
+
+  * missing donation — a parameter named in `state_params` (default:
+    `state`) is taken and flows into the return value, but the wrap has
+    no donate spec. Allowlisted for init/restore-style builders
+    (`allow_missing` fnmatch on the enclosing function's qual).
+  * dead donation — a literal donate index that is out of range, or
+    names a parameter the function body never references.
+
+The semantic half — do donated leaves actually alias an output of
+matching shape/dtype at canonical dims? — runs in devicecheck.py over
+the `@device_entry` registry, where real avals are available.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from livekit_server_tpu.analysis.callgraph import (
+    FuncInfo,
+    dotted_name,
+    local_assignments,
+)
+from livekit_server_tpu.analysis.core import Finding, Project, qual_allowed
+
+
+def _is_jit(expr: ast.AST, cg, modname: str) -> bool:
+    dotted = dotted_name(expr)
+    if dotted is None:
+        return False
+    return cg.expand_alias(dotted, modname).rsplit(".", 1)[-1] == "jit"
+
+
+def _donate_spec(call: ast.Call) -> tuple[bool, list[int]]:
+    """(has donate kwarg at all, literal int indices when statically
+    known). A dynamic spec (`(0,) if donate else ()`) counts as
+    donating — conditional donation is a caller policy, not a bug."""
+    for kw in call.keywords:
+        if kw.arg in ("donate_argnums", "donate_argnames"):
+            idxs: list[int] = []
+            if isinstance(kw.value, (ast.Tuple, ast.List)):
+                for el in kw.value.elts:
+                    if isinstance(el, ast.Constant) and isinstance(
+                        el.value, int
+                    ):
+                        idxs.append(el.value)
+            elif isinstance(kw.value, ast.Constant) and isinstance(
+                kw.value.value, int
+            ):
+                idxs.append(kw.value.value)
+            return True, idxs
+    return False, []
+
+
+def _params(fn_node: ast.AST) -> list[str]:
+    a = getattr(fn_node, "args", None)
+    if a is None:
+        return []
+    return [p.arg for p in a.posonlyargs + a.args]
+
+
+def _names_in(node: ast.AST) -> set[str]:
+    return {n.id for n in ast.walk(node) if isinstance(n, ast.Name)}
+
+
+def _returned_names(fn_node: ast.AST) -> set[str]:
+    """Names appearing anywhere in this function's return expressions
+    (nested defs excluded — they return for themselves)."""
+    out: set[str] = set()
+    stack = list(getattr(fn_node, "body", []))
+    while stack:
+        node = stack.pop()
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                             ast.Lambda, ast.ClassDef)):
+            continue
+        if isinstance(node, ast.Return) and node.value is not None:
+            out |= _names_in(node.value)
+            continue
+        stack.extend(ast.iter_child_nodes(node))
+    return out
+
+
+def _body_names(fn_node: ast.AST) -> set[str]:
+    out: set[str] = set()
+    for node in getattr(fn_node, "body", []):
+        out |= _names_in(node)
+    return out
+
+
+def _wrap_sites(project: Project, cfg: dict):
+    """(jit Call node | decorator, traced FuncInfo, enclosing qual,
+    SourceFile, lineno, has_donate, donate_idxs) per jit wrap site."""
+    cg = project.callgraph
+    sites = []
+
+    def add_call(call: ast.Call, scope, sf, assigns):
+        if not call.args:
+            return
+        target = cg.resolve(call.args[0], scope, sf, assigns)
+        if target is None:
+            return
+        has, idxs = _donate_spec(call)
+        qual = scope.qual if scope is not None else "<module>"
+        sites.append((call, target, qual, sf, call.lineno, has, idxs))
+
+    for sf in project.under(cfg["paths"]):
+        if sf.tree is None:
+            continue
+        for (mod, _), fi in cg.funcs.items():
+            if mod != sf.modname:
+                continue
+            assigns = local_assignments(fi.node)
+            # decorator form: @jax.jit / @partial(jax.jit, ...)
+            for dec in getattr(fi.node, "decorator_list", []):
+                if _is_jit(dec, cg, sf.modname):
+                    sites.append((dec, fi, fi.qual, sf, fi.node.lineno,
+                                  False, []))
+                elif isinstance(dec, ast.Call):
+                    inner = dec.args[0] if dec.args else None
+                    if _is_jit(dec.func, cg, sf.modname):
+                        has, idxs = _donate_spec(dec)
+                        sites.append((dec, fi, fi.qual, sf,
+                                      fi.node.lineno, has, idxs))
+                    elif inner is not None and _is_jit(inner, cg, sf.modname):
+                        # functools.partial(jax.jit, ...) decorator
+                        has, idxs = _donate_spec(dec)
+                        sites.append((dec, fi, fi.qual, sf,
+                                      fi.node.lineno, has, idxs))
+            # call form inside this function: jax.jit(f, ...)
+            for node in ast.walk(fi.node):
+                if isinstance(node, ast.Call) and node is not fi.node and \
+                        _is_jit(node.func, cg, sf.modname):
+                    add_call(node, fi, sf, assigns)
+        # module-level wrap calls
+        for stmt in sf.tree.body:
+            if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                 ast.ClassDef)):
+                continue
+            for node in ast.walk(stmt):
+                if isinstance(node, ast.Call) and \
+                        _is_jit(node.func, cg, sf.modname):
+                    add_call(node, None, sf, None)
+    return sites
+
+
+def run(project: Project, cfg: dict) -> list[Finding]:
+    findings: list[Finding] = []
+    seen: set[tuple[str, int, str]] = set()
+    state_params = set(cfg.get("state_params", ["state"]))
+    allow = cfg.get("allow_missing", [])
+    for (_, target, encl_qual, sf, lineno, has_donate,
+         idxs) in _wrap_sites(project, cfg):
+        params = _params(target.node)
+        if not params:
+            continue
+        if has_donate:
+            body_names = _body_names(target.node)
+            for i in idxs:
+                if i >= len(params):
+                    key = (sf.rel, lineno, f"range{i}")
+                    if key not in seen:
+                        seen.add(key)
+                        findings.append(Finding(
+                            "GC10", sf.rel, lineno,
+                            f"dead donation: donate index {i} is out of "
+                            f"range for `{target.qual}` "
+                            f"({len(params)} positional params)",
+                            hint="point donate_argnums at the mutated "
+                            "buffer parameter",
+                        ))
+                elif params[i] not in body_names:
+                    key = (sf.rel, lineno, f"unused{i}")
+                    if key not in seen:
+                        seen.add(key)
+                        findings.append(Finding(
+                            "GC10", sf.rel, lineno,
+                            f"dead donation: `{target.qual}` never uses "
+                            f"donated parameter `{params[i]}` — XLA "
+                            "cannot alias it to any output",
+                            hint="donate the buffer the function "
+                            "actually mutates and returns",
+                        ))
+        else:
+            mutated = [
+                p for p in params
+                if p in state_params and p in _returned_names(target.node)
+            ]
+            if mutated and not (
+                qual_allowed(encl_qual, allow)
+                or qual_allowed(target.qual, allow)
+            ):
+                key = (sf.rel, lineno, "missing")
+                if key not in seen:
+                    seen.add(key)
+                    findings.append(Finding(
+                        "GC10", sf.rel, lineno,
+                        f"missing donation: `{target.qual}` takes and "
+                        f"returns plane buffer `{mutated[0]}` but the "
+                        "jit wrap does not donate it — every call "
+                        "copies the whole buffer",
+                        hint=f"jit with donate_argnums="
+                        f"({params.index(mutated[0])},), or allowlist "
+                        "the wrap site if it is an init/restore path",
+                    ))
+    return findings

@@ -1,0 +1,226 @@
+"""GC11 — retrace stability (the static half of the recompile watchdog).
+
+XLA caches compiled executables per (jaxpr, static-arg values): a
+static argument that is unhashable kills the wrapper at call time, a
+mutable one that callers rebuild per call (fresh list/dict) defeats the
+cache and retraces every tick, and a `jax.jit(f)` constructed inside a
+hot function is a *new* wrapper — and a new cache — per invocation.
+None of these fail loudly; they show up as a compile storm on real
+hardware. The runtime half is `runtime/compile_ledger.py`, which counts
+post-warmup XLA compilations during seeded drills.
+
+Statically flagged:
+
+  * mutable static — a call site passes a list/dict/set literal (or
+    comprehension) for a parameter the jit wrap declared static via
+    `static_argnums`/`static_argnames`, or the traced function gives a
+    static parameter a mutable default.
+  * per-call jit — `jax.jit(f)(...)` invoked immediately inside a
+    function body, where the enclosing function is not memoized with a
+    `cache_decorators` decorator (`lru_cache`/`cache`). Builders that
+    store the wrapper (`self.x = jax.jit(f)`, `cache["fn"] = ...`)
+    construct once and are fine.
+  * unknown static name — `static_argnames` naming a parameter the
+    traced function does not have (the typo compiles until called).
+"""
+
+from __future__ import annotations
+
+import ast
+
+from livekit_server_tpu.analysis.callgraph import (
+    FuncInfo,
+    dotted_name,
+    local_assignments,
+)
+from livekit_server_tpu.analysis.core import Finding, Project
+
+_MUTABLE = (ast.List, ast.Dict, ast.Set, ast.ListComp, ast.DictComp,
+            ast.SetComp)
+
+
+def _is_jit(expr: ast.AST, cg, modname: str) -> bool:
+    dotted = dotted_name(expr)
+    if dotted is None:
+        return False
+    return cg.expand_alias(dotted, modname).rsplit(".", 1)[-1] == "jit"
+
+
+def _static_spec(call: ast.Call) -> tuple[list[int], list[str]]:
+    nums: list[int] = []
+    names: list[str] = []
+    for kw in call.keywords:
+        if kw.arg == "static_argnums":
+            vals = kw.value.elts if isinstance(
+                kw.value, (ast.Tuple, ast.List)) else [kw.value]
+            nums = [v.value for v in vals
+                    if isinstance(v, ast.Constant) and isinstance(v.value, int)]
+        elif kw.arg == "static_argnames":
+            vals = kw.value.elts if isinstance(
+                kw.value, (ast.Tuple, ast.List)) else [kw.value]
+            names = [v.value for v in vals
+                     if isinstance(v, ast.Constant) and isinstance(v.value, str)]
+    return nums, names
+
+
+def _params(fn_node: ast.AST) -> list[str]:
+    a = getattr(fn_node, "args", None)
+    if a is None:
+        return []
+    return [p.arg for p in a.posonlyargs + a.args]
+
+
+def _defaults(fn_node: ast.AST) -> dict[str, ast.AST]:
+    a = getattr(fn_node, "args", None)
+    if a is None:
+        return {}
+    out: dict[str, ast.AST] = {}
+    pos = a.posonlyargs + a.args
+    for p, d in zip(pos[len(pos) - len(a.defaults):], a.defaults):
+        out[p.arg] = d
+    for p, d in zip(a.kwonlyargs, a.kw_defaults):
+        if d is not None:
+            out[p.arg] = d
+    return out
+
+
+def _is_cached(fn_node: ast.AST, cache_decs: set[str]) -> bool:
+    for dec in getattr(fn_node, "decorator_list", []):
+        expr = dec.func if isinstance(dec, ast.Call) else dec
+        dotted = dotted_name(expr)
+        if dotted is not None and dotted.rsplit(".", 1)[-1] in cache_decs:
+            return True
+    return False
+
+
+def _jit_targets(project: Project, cfg: dict):
+    """Map traced FuncInfo id → (static param names, wrap lineno, rel)
+    for every jit wrap with a static spec."""
+    cg = project.callgraph
+    out: dict[int, tuple[FuncInfo, set[str], int, str]] = {}
+
+    def record(call: ast.Call, target: FuncInfo | None, sf):
+        if target is None:
+            return
+        nums, names = _static_spec(call)
+        params = _params(target.node)
+        statics = set(names)
+        for i in nums:
+            if i < len(params):
+                statics.add(params[i])
+        if statics:
+            out[id(target)] = (target, statics, call.lineno, sf.rel)
+
+    for sf in project.under(cfg["paths"]):
+        if sf.tree is None:
+            continue
+        for (mod, _), fi in cg.funcs.items():
+            if mod != sf.modname:
+                continue
+            assigns = local_assignments(fi.node)
+            for dec in getattr(fi.node, "decorator_list", []):
+                if isinstance(dec, ast.Call):
+                    inner = dec.args[0] if dec.args else None
+                    if _is_jit(dec.func, cg, sf.modname) or (
+                        inner is not None and _is_jit(inner, cg, sf.modname)
+                    ):
+                        record(dec, fi, sf)
+            for node in ast.walk(fi.node):
+                if isinstance(node, ast.Call) and \
+                        _is_jit(node.func, cg, sf.modname) and node.args:
+                    record(node, cg.resolve(node.args[0], fi, sf, assigns), sf)
+        for stmt in sf.tree.body:
+            if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                 ast.ClassDef)):
+                continue
+            for node in ast.walk(stmt):
+                if isinstance(node, ast.Call) and \
+                        _is_jit(node.func, cg, sf.modname) and node.args:
+                    record(node, cg.resolve(node.args[0], None, sf, None), sf)
+    return out
+
+
+def run(project: Project, cfg: dict) -> list[Finding]:
+    cg = project.callgraph
+    findings: list[Finding] = []
+    seen: set[tuple[str, int, str]] = set()
+    cache_decs = set(cfg.get("cache_decorators", ["lru_cache", "cache"]))
+
+    def emit(rel, line, msg, hint, tag):
+        key = (rel, line, tag)
+        if key not in seen:
+            seen.add(key)
+            findings.append(Finding("GC11", rel, line, msg, hint=hint))
+
+    targets = _jit_targets(project, cfg)
+
+    # mutable defaults / unknown names on the static spec itself
+    for target, statics, wline, wrel in targets.values():
+        params = set(_params(target.node))
+        defaults = _defaults(target.node)
+        for name in sorted(statics):
+            if name not in params:
+                emit(wrel, wline,
+                     f"static_argnames names `{name}`, which is not a "
+                     f"parameter of `{target.qual}`",
+                     "fix the name — the typo only fails at call time",
+                     f"unknown:{name}")
+            elif name in defaults and isinstance(defaults[name], _MUTABLE):
+                emit(target.module.rel, target.node.lineno,
+                     f"static parameter `{name}` of `{target.qual}` has a "
+                     "mutable default — unhashable at the jit cache key",
+                     "use a tuple/frozen value for static defaults",
+                     f"default:{name}")
+
+    by_info = {k: (t, s) for k, (t, s, _l, _r) in targets.items()}
+
+    for sf in project.under(cfg["paths"]):
+        if sf.tree is None:
+            continue
+        for (mod, _), fi in cg.funcs.items():
+            if mod != sf.modname:
+                continue
+            assigns = local_assignments(fi.node)
+            cached = _is_cached(fi.node, cache_decs)
+            for node in ast.walk(fi.node):
+                if not isinstance(node, ast.Call):
+                    continue
+                # per-call jit: jax.jit(f)(...) immediately invoked
+                if isinstance(node.func, ast.Call) and \
+                        _is_jit(node.func.func, cg, sf.modname) and \
+                        not cached:
+                    emit(sf.rel, node.lineno,
+                         f"`jax.jit(...)` built and called in one "
+                         f"expression inside `{fi.qual}` — a fresh "
+                         "wrapper (and compile cache) per invocation",
+                         "build the jitted function once (module level, "
+                         "lru_cache'd builder, or self attribute) and "
+                         "reuse it",
+                         "percall")
+                    continue
+                # unhashable literal passed for a static parameter
+                callee = cg.resolve(node.func, fi, sf, assigns)
+                if callee is None or id(callee) not in by_info:
+                    continue
+                target, statics = by_info[id(callee)]
+                params = _params(target.node)
+                for i, arg in enumerate(node.args):
+                    if i < len(params) and params[i] in statics and \
+                            isinstance(arg, _MUTABLE):
+                        emit(sf.rel, node.lineno,
+                             f"mutable literal passed for static "
+                             f"parameter `{params[i]}` of `{target.qual}`"
+                             " — unhashable (TypeError) or a retrace "
+                             "per call",
+                             "pass a hashable value (tuple/int/str)",
+                             f"staticarg:{params[i]}")
+                for kw in node.keywords:
+                    if kw.arg in statics and isinstance(kw.value, _MUTABLE):
+                        emit(sf.rel, node.lineno,
+                             f"mutable literal passed for static "
+                             f"parameter `{kw.arg}` of `{target.qual}` — "
+                             "unhashable (TypeError) or a retrace per "
+                             "call",
+                             "pass a hashable value (tuple/int/str)",
+                             f"staticarg:{kw.arg}")
+    return findings

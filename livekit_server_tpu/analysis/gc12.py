@@ -1,0 +1,127 @@
+"""GC12 — host-sync hygiene on the tick path.
+
+The tick window budget assumes exactly one blocking device round trip
+per tick, at the declared drain seam. Any other blocking read —
+`jax.block_until_ready`, `jax.device_get`, `.item()`, or
+`np.asarray`/`float()`/`int()` fed a device array — inserts a hidden
+pipeline bubble: the host stalls mid-tick waiting on the device stream,
+and on real hardware the stall covers the whole in-flight dispatch, not
+just the one array.
+
+The rule walks the call graph from the configured tick-path roots
+(`PlaneRuntime._device_step`, the paged live step, the upload/stage
+slices), skipping the declared seams (`_unpack_outputs`, `_sel_mirror`,
+the integrity audit, ...), and flags blocking reads anywhere in the
+reachable set. `block_until_ready` / `device_get` / `.item()` are
+flagged unconditionally; `np.asarray` / `np.array` / `float()` /
+`int()` are host no-ops on host data, so they only flag when the
+argument expression mentions a `device_names` identifier (`state`,
+`out`, `buf`, `dec`, `table` — device-resident by convention in
+runtime/).
+"""
+
+from __future__ import annotations
+
+import ast
+
+from livekit_server_tpu.analysis.callgraph import dotted_name
+from livekit_server_tpu.analysis.core import Finding, Project, qual_allowed
+
+_NP_SINKS = {"numpy.asarray", "numpy.array", "numpy.copy"}
+_CAST_SINKS = {"float", "int", "bool"}
+
+
+def _mentions_device(node: ast.AST, device_names: set[str]) -> bool:
+    for n in ast.walk(node):
+        if isinstance(n, ast.Name) and n.id in device_names:
+            return True
+        if isinstance(n, ast.Attribute) and n.attr in device_names:
+            return True
+    return False
+
+
+def _blocking(call: ast.Call, cg, modname: str, cfg: dict) -> str | None:
+    """Reason string when this call is a blocking device read."""
+    device_names = set(cfg.get("device_names", []))
+    dotted = dotted_name(call.func)
+    if dotted is not None:
+        full = cg.expand_alias(dotted, modname)
+        tail = full.rsplit(".", 1)[-1]
+        if tail == "block_until_ready":
+            return f"`{dotted}` blocks on the device stream"
+        if tail == "device_get":
+            return f"`{dotted}` is a blocking device→host copy"
+        if full in _NP_SINKS and call.args and _mentions_device(
+            call.args[0], device_names
+        ):
+            return (f"`{dotted}` on a device-resident value forces a "
+                    "blocking transfer")
+        if full in _CAST_SINKS and call.args and _mentions_device(
+            call.args[0], device_names
+        ):
+            return (f"`{dotted}()` on a device-resident value forces a "
+                    "blocking scalar read")
+    if isinstance(call.func, ast.Attribute) and call.func.attr == "item":
+        return "`.item()` forces a blocking scalar read"
+    if isinstance(call.func, ast.Attribute) and \
+            call.func.attr == "block_until_ready":
+        return "`.block_until_ready()` blocks on the device stream"
+    # np.asarray passed as a callback (jax.tree.map(np.asarray, out))
+    for arg in call.args:
+        d = dotted_name(arg)
+        if d is not None and cg.expand_alias(d, modname) in _NP_SINKS:
+            if _mentions_device(call, device_names):
+                return (f"`{d}` mapped over a device tree forces a "
+                        "blocking transfer")
+    return None
+
+
+def run(project: Project, cfg: dict) -> list[Finding]:
+    cg = project.callgraph
+    findings: list[Finding] = []
+    seams = cfg.get("seams", [])
+    roots = []
+    for sf in project.under(cfg["paths"]):
+        for (mod, qual), fi in cg.funcs.items():
+            if mod == sf.modname and qual in cfg.get("roots", []):
+                roots.append(fi)
+    seen: set[int] = set()
+    seen_sites: set[tuple[str, int, str]] = set()
+    queue = [(fi, fi.qual) for fi in roots]
+    while queue:
+        fi, root = queue.pop()
+        if id(fi) in seen:
+            continue
+        seen.add(id(fi))
+        sf = fi.module
+        # walk the whole body incl. nested defs: closures run on the
+        # same thread when called from here
+        for node in ast.walk(fi.node):
+            if not isinstance(node, ast.Call):
+                continue
+            why = _blocking(node, cg, sf.modname, cfg)
+            if why is not None:
+                key = (sf.rel, node.lineno, why)
+                if key not in seen_sites:
+                    seen_sites.add(key)
+                    findings.append(Finding(
+                        "GC12", sf.rel, node.lineno,
+                        f"{why} on the tick path (reachable from "
+                        f"`{root}`)",
+                        hint="move the read to a declared drain/"
+                        "telemetry seam, or defer it off the tick "
+                        "thread",
+                    ))
+                continue
+            callee = cg.resolve_unique(node.func, fi, sf)
+            if callee is None:
+                continue
+            if qual_allowed(callee.qual, seams):
+                continue
+            # only descend into runtime-path callees; library helpers
+            # outside cfg paths are out of scope
+            if callee.module.rel.startswith(tuple(
+                p.rstrip("/") for p in cfg["paths"]
+            )):
+                queue.append((callee, root))
+    return findings

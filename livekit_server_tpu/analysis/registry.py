@@ -1,0 +1,60 @@
+"""Device-entry registry for the devicecheck compile-contract pass.
+
+`@device_entry("name")` marks a function (or a builder returning a
+jitted callable) as a device-plane entry point. The decorator only
+records the callable in a module-level table and returns it unchanged —
+zero runtime cost, no jax import — so models/ops/runtime modules can
+register themselves without pulling the analysis stack into the tick
+path. `analysis/devicecheck.py` owns the per-entry argument specs and
+runs `jax.eval_shape` contracts against this table.
+
+Names are stable contract keys: they appear in the committed
+`tools/devicecheck_baseline.json`, so renaming one is a contract change
+(re-snapshot with `python -m tools.check --resnapshot`).
+"""
+
+from __future__ import annotations
+
+from typing import Callable
+
+# name → {"fn": callable, "module": str, "qualname": str, "builder": bool}
+DEVICE_ENTRIES: dict[str, dict] = {}
+
+
+def device_entry(name: str, *, builder: bool = False) -> Callable:
+    """Register a device entry point under a stable contract name.
+
+    `builder=True` marks a factory whose RETURN VALUE is the traced
+    callable (e.g. runtime/mixer._device_mix, parallel/mesh.
+    make_sharded_tick); devicecheck calls the factory with canonical
+    params before eval_shape'ing the result.
+    """
+
+    def wrap(fn: Callable) -> Callable:
+        DEVICE_ENTRIES[name] = {
+            "fn": fn,
+            "module": getattr(fn, "__module__", ""),
+            "qualname": getattr(fn, "__qualname__", name),
+            "builder": builder,
+        }
+        return fn
+
+    return wrap
+
+
+def entry(name: str) -> Callable:
+    """Resolve a registered entry, importing the hosting modules on
+    first use (registration happens at import time)."""
+    if name not in DEVICE_ENTRIES:
+        import_all()
+    return DEVICE_ENTRIES[name]["fn"]
+
+
+def import_all() -> None:
+    """Import every module that registers device entries."""
+    import livekit_server_tpu.models.paged  # noqa: F401
+    import livekit_server_tpu.models.plane  # noqa: F401
+    import livekit_server_tpu.ops.mix  # noqa: F401
+    import livekit_server_tpu.ops.paged_kernel  # noqa: F401
+    import livekit_server_tpu.parallel.mesh  # noqa: F401
+    import livekit_server_tpu.runtime.mixer  # noqa: F401

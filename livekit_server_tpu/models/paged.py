@@ -56,6 +56,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from livekit_server_tpu.analysis.registry import device_entry
 from livekit_server_tpu.models import plane
 from livekit_server_tpu.models.plane import (
     MAX_LAYERS,
@@ -138,6 +139,7 @@ def init_table(dims: PagedDims) -> PageTable:
 # ---------------------------------------------------------------------------
 
 
+@device_entry("paged.paged_plane_tick")
 def paged_plane_tick(
     state: PlaneState,
     inp: TickInputs,
@@ -298,6 +300,7 @@ def paged_plane_tick(
 # ---------------------------------------------------------------------------
 
 
+@device_entry("paged.dead_page_outputs")
 def dead_page_outputs(
     MT: int, TP: int, K: int, SP: int,
     inp: TickInputs,
@@ -340,6 +343,7 @@ def broadcast_dead_outputs(rep_out: TickOutputs, P: int) -> TickOutputs:
     )
 
 
+@device_entry("paged.paged_plane_tick_live")
 def paged_plane_tick_live(
     state: PlaneState,
     inp: TickInputs,
@@ -475,6 +479,7 @@ def paged_plane_tick_live(
     return new_state, outputs
 
 
+@device_entry("paged.paged_plane_tick_fused")
 def paged_plane_tick_fused(
     state: PlaneState,
     inp: TickInputs,
@@ -561,6 +566,7 @@ def pack_table_delta(pager, delta, pad_pages_to=None, pad_rooms_to=None):
     )
 
 
+@device_entry("paged.apply_table_delta")
 def apply_table_delta(
     table: PageTable,
     page_rows, tmember_rows, pg_room_rows, pg_tp_rows, pg_sp_rows,
@@ -577,6 +583,7 @@ def apply_table_delta(
     )
 
 
+@device_entry("paged.page_init_template")
 def page_init_template(dims: PagedDims) -> PlaneState:
     """A single init page ([1, TP, K, SP] PlaneState) — the scatter
     source for fresh/freed page re-init and the fill for unmapped
@@ -584,6 +591,7 @@ def page_init_template(dims: PagedDims) -> PlaneState:
     return plane.init_state(PlaneDims(1, dims.tpage, dims.pkts, dims.spage))
 
 
+@device_entry("paged.reinit_pages")
 def reinit_pages(state: PlaneState, rows, template: PlaneState) -> PlaneState:
     """Device side (traced): reset `rows` to pristine init state — run
     for freshly allocated pages (a new room must not inherit the prior
@@ -599,6 +607,7 @@ def reinit_pages(state: PlaneState, rows, template: PlaneState) -> PlaneState:
     return jax.tree.map(f, state, template)
 
 
+@device_entry("paged.move_state_rows")
 def move_state_rows(state: PlaneState, src, dst) -> PlaneState:
     """Device side (traced): replay compaction relocations as page-row
     copies. Gather-then-scatter on the functional pre-move state, so

@@ -47,6 +47,7 @@ from typing import NamedTuple
 import jax
 import jax.numpy as jnp
 
+from livekit_server_tpu.analysis.registry import device_entry
 from livekit_server_tpu.ops import (
     allocation,
     audio,
@@ -233,6 +234,7 @@ class TickOutputs(NamedTuple):
     red_ok: jax.Array          # [R, T, K, D] bool
 
 
+@device_entry("plane.init_state")
 def init_state(dims: PlaneDims) -> PlaneState:
     R, T, K, S = dims
     L = MAX_LAYERS
@@ -581,6 +583,7 @@ def _room_tick(
     return new_state, outputs, bitrates
 
 
+@device_entry("plane.media_plane_tick")
 def media_plane_tick(
     state: PlaneState,
     inp: TickInputs,
@@ -773,6 +776,7 @@ def pack_ctrl_rows(meta: TrackMeta, ctrl: SubControl, rows, pad_to: int | None =
     return rows, meta_rows, ctrl_rows
 
 
+@device_entry("plane.apply_ctrl_delta")
 def apply_ctrl_delta(state: PlaneState, rows, meta_rows, ctrl_rows) -> PlaneState:
     """Device-side (traced) half: scatter the dirtied rows into the
     control tensors via `.at[rows].set(...)` — the delta-upload analog of

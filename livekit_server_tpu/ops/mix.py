@@ -31,6 +31,8 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from livekit_server_tpu.analysis.registry import device_entry
+
 MIX_TOP_K = 3  # speakers mixed per subscriber (reference fan-out policy
                # for active speakers — room.go speaker updates top-3)
 
@@ -62,6 +64,7 @@ CODEC_PCMU = 1
 CODEC_PCMA = 2
 
 
+@device_entry("mix.decode_tick")
 def decode_tick(payload_u8: jax.Array, codec: jax.Array) -> jax.Array:
     """[R, T, N] raw bytes (+[R, T] codec ids) → [R, T, N] float PCM.
 
@@ -94,6 +97,7 @@ def encode_ulaw(pcm: jax.Array) -> jax.Array:
     return ((sign | (exp << 4) | mant) ^ 0xFF).astype(jnp.uint8)
 
 
+@device_entry("mix.mix_tick")
 @functools.partial(jax.jit, static_argnames=("top_k",))
 def mix_tick(
     pcm: jax.Array,        # [R, T, N] float PCM (decoded)

@@ -46,6 +46,7 @@ from typing import Any, NamedTuple
 import jax
 import jax.numpy as jnp
 
+from livekit_server_tpu.analysis.registry import device_entry
 from livekit_server_tpu.ops import selector
 
 NUM_LAYERS = 3   # spatial routing lanes (models/plane.py MAX_LAYERS)
@@ -401,6 +402,7 @@ def _decide_fallback(sel_state, is_svc, is_video, base, inp, live_rows,
                       None, None)
 
 
+@device_entry("paged_kernel.decide_pages")
 def decide_pages(sel_state, is_svc, is_video, base, inp, live_rows, *,
                  wire_overhead: int, num_layers: int = NUM_LAYERS,
                  use_pallas: bool | None = None, interpret: bool = False):

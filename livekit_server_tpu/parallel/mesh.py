@@ -20,6 +20,7 @@ import jax.numpy as jnp
 import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
+from livekit_server_tpu.analysis.registry import device_entry
 from livekit_server_tpu.models import plane
 
 # jax.shard_map (with check_vma) landed after 0.4.x; older versions ship
@@ -99,6 +100,7 @@ def shard_pool(tree: Any, mesh: Mesh) -> Any:
     return jax.tree.map(put, tree)
 
 
+@device_entry("mesh.sharded_tick", builder=True)
 def make_sharded_tick(
     mesh: Mesh,
     audio_params: Any | None = None,

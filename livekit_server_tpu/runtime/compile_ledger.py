@@ -1,0 +1,104 @@
+"""Recompile watchdog: count XLA compilations, fail drills that retrace.
+
+The static half (GC11) catches retrace hazards it can see in the AST;
+this module catches the ones it can't — a shape that escapes the pow2
+padding buckets, a weak-type flip, a new donate spec — by counting what
+the backend actually does. `jax.monitoring` fires a duration event per
+*backend compile* (`/jax/core/compile/backend_compile_duration`); cache
+hits emit only trace events, so filtering on "backend_compile" counts
+real XLA compilations and nothing else.
+
+Usage: the runtime installs the listener at construction, the server
+calls `mark_warm()` after the warmup step, and from then on
+`post_warmup` must stay 0 on the steady-state tick path — pager churn
+runs through pow2 buckets precisely so that it does. The seeded tier-1
+drills (grow-on-join, compaction, governor shed, express retier,
+migration) assert that; `/debug/compiles` and the
+`livekit_xla_compiles_total` gauge expose the same ledger in prod.
+
+jax.monitoring has no unregister API, so the ledger is a process-wide
+singleton: one listener, installed once, shared by every runtime in the
+process (tests reset the counters, not the listener).
+"""
+
+from __future__ import annotations
+
+import threading
+from collections import deque
+
+import jax
+
+
+class CompileLedger:
+    """Process-wide XLA compile counter with a warmup watermark."""
+
+    def __init__(self) -> None:
+        self._lock = threading.Lock()
+        self._installed = False
+        self.total = 0
+        self.total_ms = 0.0
+        self._warm_total = 0
+        self._warm_ms = 0.0
+        # (event, ms) ring for /debug/compiles — enough to see what
+        # recompiled without growing unbounded
+        self.recent: deque[tuple[str, float]] = deque(maxlen=64)
+
+    def install(self) -> "CompileLedger":
+        with self._lock:
+            if not self._installed:
+                jax.monitoring.register_event_duration_secs_listener(
+                    self._on_event
+                )
+                self._installed = True
+        return self
+
+    def _on_event(self, event: str, duration_secs: float, **kw) -> None:
+        if "backend_compile" not in event:
+            return
+        with self._lock:
+            self.total += 1
+            self.total_ms += duration_secs * 1e3
+            self.recent.append((event, round(duration_secs * 1e3, 2)))
+
+    def mark_warm(self) -> None:
+        """Set the watermark: compiles after this are steady-state
+        recompiles — the thing the watchdog exists to catch."""
+        with self._lock:
+            self._warm_total = self.total
+            self._warm_ms = self.total_ms
+
+    @property
+    def post_warmup(self) -> int:
+        with self._lock:
+            return self.total - self._warm_total
+
+    @property
+    def warmup_ms(self) -> float:
+        """Compile time spent before the watermark."""
+        with self._lock:
+            return self._warm_ms if self._warm_total else self.total_ms
+
+    def snapshot(self) -> dict:
+        with self._lock:
+            return {
+                "xla_compiles_total": self.total,
+                "xla_compiles_post_warmup": self.total - self._warm_total,
+                "xla_compile_ms": round(self.total_ms, 1),
+                "xla_warmup_compile_ms": round(
+                    self._warm_ms if self._warm_total else self.total_ms, 1
+                ),
+                "recent": list(self.recent)[-8:],
+            }
+
+    def reset(self) -> None:
+        """Test seam: zero the counters (the listener stays — there is
+        no unregister)."""
+        with self._lock:
+            self.total = 0
+            self.total_ms = 0.0
+            self._warm_total = 0
+            self._warm_ms = 0.0
+            self.recent.clear()
+
+
+LEDGER = CompileLedger()

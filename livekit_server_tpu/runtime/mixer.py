@@ -28,6 +28,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from livekit_server_tpu.analysis.registry import device_entry
 from livekit_server_tpu.interop import opus
 
 __all__ = ["AudioMixer"]
@@ -45,6 +46,7 @@ PLC_MAX_FRAMES = 10
 DEVICE_MIX_MIN_ROOMS = 64
 
 
+@device_entry("mixer.device_mix", builder=True)
 @functools.lru_cache(maxsize=None)
 def _device_mix(T: int, S: int, N: int):
     """Batched room mix, one einsum for every enabled room at once —

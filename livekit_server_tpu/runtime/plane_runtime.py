@@ -460,6 +460,14 @@ class PlaneRuntime:
 
         self._executor = ThreadPoolExecutor(max_workers=1, thread_name_prefix="plane")
 
+        # Recompile watchdog: process-wide XLA compile counter. The
+        # server marks the warmup watermark after its warm step; the
+        # steady-state tick path must not compile past it (GC11's
+        # runtime half — see runtime/compile_ledger.py).
+        from livekit_server_tpu.runtime.compile_ledger import LEDGER
+
+        self.compile_ledger = LEDGER.install()
+
         # Flight-recorder tracing plane (runtime/trace.py): fixed ring of
         # per-tick span records, the sampled wire-latency attribution
         # stage decomposer, and the per-room black-box event recorder.
@@ -725,7 +733,9 @@ class PlaneRuntime:
             self.fault.maybe_bitflip(self, st.idx)
         if self._mesh is not None:
             state, out = self._step(self.state, st.inp)
-            out = jax.tree.map(np.asarray, out)
+            # The mesh path's one per-tick drain: outputs land host-side
+            # here (the non-mesh path drains in _unpack_outputs instead).
+            out = jax.tree.map(np.asarray, out)  # graftcheck: disable=GC12
         else:
             state, buf = self._step(self.state, *st.packed)
             out = self._unpack_outputs(buf)
@@ -917,6 +927,12 @@ class PlaneRuntime:
             # Close the overload loop on the finished tick's verdict.
             self.governor.on_tick(self.recent_ticks[-1])
         return result
+
+    def mark_warm(self) -> None:
+        """Close the warmup window: XLA compiles after this are
+        steady-state recompiles the watchdog reports (and the seeded
+        drills fail on). Call after the warm step(s) have run."""
+        self.compile_ledger.mark_warm()
 
     async def step_once(self) -> TickResult:
         """One sequential tick (tests, warmup, manual stepping); the device
@@ -1155,8 +1171,11 @@ class PlaneRuntime:
             speakers=speakers,
             need_keyframe=nk,
             congested=congested,
-            fwd_packets=int(out.fwd_packets.sum()),
-            fwd_bytes=int(out.fwd_bytes.sum()),
+            # `out` is post-drain host numpy by the time _fan_out runs
+            # (materialized in _device_step), so these casts are host
+            # no-ops the device-name heuristic cannot see through.
+            fwd_packets=int(out.fwd_packets.sum()),  # graftcheck: disable=GC12
+            fwd_bytes=int(out.fwd_bytes.sum()),  # graftcheck: disable=GC12
             tick_s=tick_s,
             track_quality=out.track_quality,
             track_mos=out.track_mos,

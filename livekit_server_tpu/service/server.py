@@ -79,6 +79,7 @@ class LivekitServer:
         self.app.router.add_get("/debug/overload", self.debug_overload)
         self.app.router.add_get("/debug/pager", self.debug_pager)
         self.app.router.add_get("/debug/integrity", self.debug_integrity)
+        self.app.router.add_get("/debug/compiles", self.debug_compiles)
         self.app.router.add_get("/debug/egress", self.debug_egress)
         self.app.router.add_get("/debug/migration", self.debug_migration)
         self.app.router.add_get("/debug/fleet", self.debug_fleet)
@@ -283,6 +284,14 @@ class LivekitServer:
         if bus is not None and hasattr(bus, "retries"):
             self.telemetry.set_gauge("livekit_bus_retries_total", bus.retries)
             self.telemetry.set_gauge("livekit_bus_reconnects_total", bus.reconnects)
+        ledger = self.room_manager.runtime.compile_ledger.snapshot()
+        self.telemetry.set_gauge(
+            "livekit_xla_compiles_total", ledger["xla_compiles_total"]
+        )
+        self.telemetry.set_gauge(
+            "livekit_xla_compiles_post_warmup",
+            ledger["xla_compiles_post_warmup"],
+        )
         self.telemetry.observe_queue_drops()
         return web.Response(
             text=self.telemetry.prometheus_text(), content_type="text/plain"
@@ -415,6 +424,16 @@ class LivekitServer:
             }
         )
 
+    async def debug_compiles(self, request: web.Request) -> web.Response:
+        """Recompile watchdog: XLA compile counts against the warmup
+        watermark, total compile time, and the most recent compile
+        events. `xla_compiles_post_warmup` > 0 means the steady-state
+        tick path is retracing — a shape escaped the pow2 buckets or a
+        static arg lost cache identity (GC11's runtime half)."""
+        return web.json_response(
+            self.room_manager.runtime.compile_ledger.snapshot()
+        )
+
     async def debug_analytics(self, request: web.Request) -> web.Response:
         """Recent per-track analytics records (statsworker.go stream seat)."""
         try:
@@ -460,6 +479,10 @@ class LivekitServer:
         # first tick doesn't stall the event loop mid-session (XLA compiles
         # once per (shapes, params); later ticks hit the cache).
         await self.room_manager.runtime.step_once()
+        # Watermark for the recompile watchdog: anything XLA compiles
+        # after this point is a steady-state retrace (surfaced at
+        # /debug/compiles and livekit_xla_compiles_total).
+        self.room_manager.runtime.mark_warm()
         # Native UDP media transport on the RTC port (rtc/config.go UDPMux).
         if self.config.rtc.udp_port:
             from livekit_server_tpu.runtime.udp import start_udp_transport

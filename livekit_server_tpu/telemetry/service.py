@@ -89,6 +89,8 @@ _STAGE_BUCKETS = (0.5, 1, 2, 5, 10, 20, 50, 100, 250)
 # One-line HELP strings per metric family (exposition-format HELP/TYPE
 # headers; families not listed fall back to the family name itself).
 _HELP = {
+    "livekit_xla_compiles_total": "XLA backend compilations since process start",
+    "livekit_xla_compiles_post_warmup": "XLA compilations after the warmup watermark (first-use paths may add a handful; sustained growth is a retrace storm)",
     "livekit_forward_latency_ms": "Sampled packet arrival-to-wire latency (both egress tiers)",
     "livekit_wire_latency_stage_ms": "Sampled wire latency decomposed by pipeline stage",
     "livekit_tick_duration_ms": "Media-plane tick work time (stage+device+fanout)",

@@ -1,0 +1,391 @@
+"""devicecheck + GC10/GC11/GC12 unit tests.
+
+Fixture projects exercise each of the three device-plane AST rules on
+minimal good/bad modules; the contract half (`diff_contracts`,
+`audit_donation`, the CompileLedger watchdog) is tested directly on
+fake avals and the committed baseline — never through a full
+`compute_contracts()` trace, which belongs to `tools/check` and would
+blow this module's CPU budget.
+"""
+
+from __future__ import annotations
+
+import json
+import textwrap
+from pathlib import Path
+
+import pytest
+
+from livekit_server_tpu.analysis import (
+    core,
+    devicecheck,
+    gc10,
+    gc11,
+    gc12,
+    load_project,
+    run_all,
+)
+
+REPO_ROOT = Path(__file__).resolve().parent.parent
+
+
+def make_project(tmp_path, files: dict[str, str]):
+    for rel, src in files.items():
+        p = tmp_path / rel
+        p.parent.mkdir(parents=True, exist_ok=True)
+        p.write_text(textwrap.dedent(src))
+    return load_project(tmp_path, ["pkg"])
+
+
+def cfg_for(rule: str, **overrides) -> dict:
+    merged = dict(core.DEFAULT_CONFIG[rule])
+    merged["paths"] = ["pkg"]
+    merged.update(overrides)
+    return merged
+
+
+def lines_of(findings, rule):
+    return sorted(f.line for f in findings if f.rule == rule)
+
+
+# -- GC10: donation discipline ----------------------------------------------
+
+GC10_MISSING = """\
+    import jax
+
+    def tick(state, pkt):
+        return state + pkt
+
+    step = jax.jit(tick)  # line 6: mutated state, no donation
+"""
+
+
+def test_gc10_missing_donation(tmp_path):
+    project = make_project(tmp_path, {"pkg/rt.py": GC10_MISSING})
+    findings = gc10.run(project, cfg_for("gc10"))
+    assert lines_of(findings, "GC10") == [6]
+    assert "missing donation" in findings[0].message
+
+
+def test_gc10_donated_is_clean(tmp_path):
+    src = GC10_MISSING.replace(
+        "jax.jit(tick)  # line 6: mutated state, no donation",
+        "jax.jit(tick, donate_argnums=(0,))",
+    )
+    project = make_project(tmp_path, {"pkg/rt.py": src})
+    assert gc10.run(project, cfg_for("gc10")) == []
+
+
+def test_gc10_init_paths_allowlisted(tmp_path):
+    src = """\
+        import jax
+
+        def init_state(state):
+            return state * 0
+
+        build = jax.jit(init_state)
+    """
+    project = make_project(tmp_path, {"pkg/rt.py": src})
+    assert gc10.run(project, cfg_for("gc10")) == []
+
+
+def test_gc10_dead_donation_out_of_range_and_unused(tmp_path):
+    src = """\
+        import jax
+
+        def tick(state, aux):
+            return state * 2
+
+        a = jax.jit(tick, donate_argnums=(5,))   # line 6: out of range
+        b = jax.jit(tick, donate_argnums=(1,))   # line 7: aux never used
+    """
+    project = make_project(tmp_path, {"pkg/rt.py": src})
+    findings = gc10.run(project, cfg_for("gc10"))
+    assert lines_of(findings, "GC10") == [6, 7]
+    assert any("out of range" in f.message for f in findings)
+    assert any("never uses" in f.message for f in findings)
+
+
+def test_gc10_semantic_audit_on_avals():
+    import jax
+    import jax.numpy as jnp
+
+    big = jax.ShapeDtypeStruct((512, 1024), jnp.float32)   # 2 MiB
+    small = jax.ShapeDtypeStruct((8,), jnp.int32)
+    out = {"state": big, "count": small}
+
+    # donated state aliases the matching output leaf: clean
+    assert devicecheck.audit_donation((big, small), out, (0,)) == []
+
+    # donate index past the argument list
+    probs = devicecheck.audit_donation((big,), out, (3,))
+    assert any("out of range" in p for p in probs)
+
+    # donated leaf with no shape/dtype match in the outputs
+    lone = jax.ShapeDtypeStruct((7, 7), jnp.float64)
+    probs = devicecheck.audit_donation((big, lone), out, (1,))
+    assert any(p.startswith("dead:") for p in probs)
+
+    # >=1 MiB input matching an output but not donated
+    probs = devicecheck.audit_donation((big,), out, ())
+    assert any(p.startswith("missing:") for p in probs)
+    assert devicecheck.audit_donation(
+        (big,), out, (), allow_no_donate=True) == []
+
+
+# -- GC11: retrace stability ------------------------------------------------
+
+def test_gc11_unknown_static_name_and_mutable_default(tmp_path):
+    src = """\
+        import jax
+
+        def mix(x, top_k):
+            return x * top_k
+
+        def pool(x, knobs={}):
+            return x
+
+        a = jax.jit(mix, static_argnames=("topk",))    # typo
+        b = jax.jit(pool, static_argnames=("knobs",))  # default is a dict
+    """
+    project = make_project(tmp_path, {"pkg/ops.py": src})
+    findings = gc11.run(project, cfg_for("gc11"))
+    assert any("not a parameter" in f.message for f in findings)
+    assert any("mutable default" in f.message for f in findings)
+
+
+def test_gc11_mutable_literal_for_static_param(tmp_path):
+    src = """\
+        import jax
+
+        def mix(x, ks):
+            return x
+
+        jmix = jax.jit(mix, static_argnames=("ks",))
+
+        def caller(x):
+            return mix(x, ks=[1, 2])    # line 9: unhashable static
+    """
+    project = make_project(tmp_path, {"pkg/ops.py": src})
+    findings = gc11.run(project, cfg_for("gc11"))
+    assert lines_of(findings, "GC11") == [9]
+    assert "mutable literal" in findings[0].message
+
+
+def test_gc11_per_call_jit(tmp_path):
+    src = """\
+        import functools
+        import jax
+
+        def hot(x):
+            return jax.jit(lambda y: y * 2)(x)    # line 5: fresh wrapper
+
+        @functools.lru_cache(maxsize=None)
+        def builder(n):
+            return jax.jit(lambda y: y * n)       # memoized: fine
+    """
+    project = make_project(tmp_path, {"pkg/ops.py": src})
+    findings = gc11.run(project, cfg_for("gc11"))
+    assert lines_of(findings, "GC11") == [5]
+    assert "fresh" in findings[0].message
+
+
+# -- GC12: host-sync hygiene ------------------------------------------------
+
+GC12_SRC = """\
+    import jax
+    import numpy as np
+
+    class Rt:
+        def _device_step(self, state):
+            out = self._fwd(state)
+            jax.block_until_ready(out)       # line 7: mid-tick stall
+            n = int(out.sum())               # line 8: blocking scalar read
+            self._drain(out)
+            self._helper(out)
+            return out
+
+        def _fwd(self, state):
+            return state
+
+        def _drain(self, out):
+            return np.asarray(out)           # declared seam: fine
+
+        def _helper(self, out):
+            return out.item()                # line 20: reachable read
+"""
+
+
+def test_gc12_flags_reads_outside_seams(tmp_path):
+    project = make_project(tmp_path, {"pkg/rt.py": GC12_SRC})
+    cfg = cfg_for(
+        "gc12",
+        roots=["Rt._device_step"],
+        seams=["*._drain"],
+    )
+    findings = gc12.run(project, cfg)
+    assert lines_of(findings, "GC12") == [7, 8, 20]
+    # the seam's own np.asarray is sanctioned
+    assert all(f.line != 17 for f in findings)
+
+
+def test_gc12_host_data_casts_are_clean(tmp_path):
+    src = """\
+        class Rt:
+            def _device_step(self, state):
+                host = self._counts()
+                return int(host.sum())       # host numpy: no device name
+
+            def _counts(self):
+                return None
+    """
+    project = make_project(tmp_path, {"pkg/rt.py": src})
+    cfg = cfg_for("gc12", roots=["Rt._device_step"], seams=[])
+    assert gc12.run(project, cfg) == []
+
+
+# -- stale suppressions -----------------------------------------------------
+
+def run_all_pkg(project, stale=None, rules=None):
+    config = core.Config(root=project.root, paths=["pkg"])
+    config.rules = {r.lower(): {"paths": ["pkg"]} for r in core.RULES}
+    return run_all(project, config, rules=rules, stale_suppressions=stale)
+
+
+def test_live_suppression_is_not_stale(tmp_path):
+    src = GC10_MISSING.replace(
+        "# line 6: mutated state, no donation",
+        "# graftcheck: disable=GC10",
+    )
+    project = make_project(tmp_path, {"pkg/rt.py": src})
+    stale: list = []
+    assert run_all_pkg(project, stale, rules=["GC10"]) == []
+    assert stale == []
+
+
+def test_stale_suppression_is_flagged(tmp_path):
+    src = """\
+        def fine():
+            return 1  # graftcheck: disable=GC10
+    """
+    project = make_project(tmp_path, {"pkg/rt.py": src})
+    stale: list = []
+    assert run_all_pkg(project, stale, rules=["GC10"]) == []
+    assert [f.rule for f in stale] == [core.PARSE_RULE]
+    assert "stale suppression" in stale[0].message
+
+
+# -- compile contracts: baseline drift --------------------------------------
+
+def _committed_baseline() -> dict:
+    cfg = core.load_config(REPO_ROOT).rule("devicecheck")
+    return devicecheck.load_baseline(REPO_ROOT / cfg["baseline"])
+
+
+def test_committed_baseline_matches_registry():
+    base = _committed_baseline()
+    assert "plane.media_plane_tick" in base
+    assert "mesh.sharded_tick" in base
+    tick = base["plane.media_plane_tick"]
+    assert tick["donate"] == [0] and tick["flops"] > 0
+    # the mesh entry carries explicit output sharding specs
+    assert any("rooms" in s for s in base["mesh.sharded_tick"]["sharding"])
+
+
+def test_diff_contracts_clean_on_identity():
+    base = _committed_baseline()
+    findings, stale = devicecheck.diff_contracts(base, base)
+    assert findings == [] and stale == []
+
+
+def test_diff_contracts_detects_drift():
+    base = _committed_baseline()
+    name = "plane.media_plane_tick"
+    got = {name: json.loads(json.dumps(base[name]))}
+
+    # shape drift on an output leaf
+    got[name]["out"][0]["shape"] = [1, 2, 3]
+    findings, _ = devicecheck.diff_contracts(got, base)
+    assert any("output contract drifted" in f.message for f in findings)
+
+    # cost drift beyond the tolerance band
+    got = {name: json.loads(json.dumps(base[name]))}
+    got[name]["flops"] = base[name]["flops"] * 3
+    findings, _ = devicecheck.diff_contracts(got, base)
+    assert any("flops drifted" in f.message for f in findings)
+
+    # cost drift inside the band is tolerated
+    got[name]["flops"] = int(base[name]["flops"] * 1.1)
+    findings, _ = devicecheck.diff_contracts(
+        got, base, cost_rtol=0.25)
+    assert findings == []
+
+    # donation drift
+    got = {name: json.loads(json.dumps(base[name]))}
+    got[name]["donate"] = []
+    findings, _ = devicecheck.diff_contracts(got, base)
+    assert any("donation contract drifted" in f.message for f in findings)
+
+
+def test_diff_contracts_new_and_stale_entries():
+    base = _committed_baseline()
+    name = "plane.media_plane_tick"
+    # an uncommitted entry must fail until snapshotted...
+    got = dict(base)
+    got["plane.brand_new"] = dict(base[name])
+    findings, stale = devicecheck.diff_contracts(got, base)
+    assert any("no committed contract" in f.message for f in findings)
+    # ...and a deleted entry leaves its contract stale (shrink-only)
+    got = {k: v for k, v in base.items() if k != name}
+    findings, stale = devicecheck.diff_contracts(got, base)
+    assert stale == [name]
+    # drift findings carry a real file:line anchor
+    sited, _ = devicecheck.diff_contracts(
+        {name: {**base[name], "donate": []}}, base)
+    assert sited[0].path.endswith("models/plane.py") and sited[0].line > 0
+
+
+# -- recompile watchdog: CompileLedger --------------------------------------
+
+def test_compile_ledger_counts_post_warmup_retraces():
+    import jax
+    import jax.numpy as jnp
+
+    from livekit_server_tpu.runtime.compile_ledger import LEDGER
+
+    LEDGER.install()
+    LEDGER.reset()
+    step = jax.jit(lambda x: x * 2.0 + 1.0)
+    step(jnp.zeros((8,), jnp.float32)).block_until_ready()
+    assert LEDGER.total >= 1, "warmup compile not observed"
+    LEDGER.mark_warm()
+
+    # same shape → executable cache hit, no compile event
+    step(jnp.ones((8,), jnp.float32)).block_until_ready()
+    assert LEDGER.post_warmup == 0
+
+    # new shape → retrace + fresh XLA compile, the watchdog trips
+    step(jnp.zeros((9,), jnp.float32)).block_until_ready()
+    assert LEDGER.post_warmup >= 1
+    snap = LEDGER.snapshot()
+    assert snap["xla_compiles_post_warmup"] == LEDGER.post_warmup
+    assert snap["xla_compiles_total"] >= 2
+    assert snap["xla_warmup_compile_ms"] >= 0.0
+    LEDGER.reset()
+    LEDGER.install()
+
+
+# -- the real tree ----------------------------------------------------------
+
+def test_real_tree_device_rules_clean():
+    """GC10–GC12 + the stale-suppression pass over the live repo: zero
+    findings, zero dead directives."""
+    config = core.load_config(REPO_ROOT)
+    project = load_project(REPO_ROOT, config.paths)
+    stale: list = []
+    findings = run_all(
+        project, config, rules=["GC10", "GC11", "GC12"],
+        stale_suppressions=stale,
+    )
+    assert findings == [], "\n".join(f.render() for f in findings)
+    assert stale == [], "\n".join(f.render() for f in stale)

@@ -158,6 +158,9 @@ async def test_promote_shed_demote_audio_continuity():
 
     await run_windows(2)                 # batched; 2nd boundary promotes
     assert rt.express.active[0]
+    # Retier is a host-side lane swap: promote, shed, and demote below
+    # must never retrace the device tick (recompile watchdog).
+    rt.mark_warm()
     await run_windows(3)                 # express steady state
     rt.set_shed(pause_video=True)        # overload: audio is never shed
     await run_windows(2)
@@ -165,6 +168,7 @@ async def test_promote_shed_demote_audio_continuity():
     rt.set_express_pin(0, False)         # force back to batched
     await run_windows(2)
     assert not rt.express.active[0]
+    assert rt.compile_ledger.post_warmup == 0
     for s in (1, 2):
         assert got[s] == list(range(100, sn)), f"sub {s} lost or reordered"
     assert express_sns, "express tier never carried audio"

@@ -134,6 +134,16 @@ async def test_two_phase_commit_moves_room_and_state():
         assert st_a["commits"] == 1 and st_a["rollbacks"] == 0
         assert st_b["adoptions"] == 1 and st_b["commits_in"] == 1
         assert st_b["bridged_in"] == 3
+
+        # Recompile watchdog (GC11 drill): the adoption restore and the
+        # bridged-window drain pay their compiles above; steady post-
+        # migration ticks on the adopting node must not retrace.
+        rt_b.mark_warm()
+        for i in range(6, 9):
+            rt_b.ingest.push(PacketIn(room=row_b, track=0, sn=100 + i,
+                                      ts=0, size=10, payload=b"s"))
+        await pump_until(rt_b, row_b, 108)
+        assert rt_b.compile_ledger.post_warmup == 0
     finally:
         await stop_all(a, b)
 

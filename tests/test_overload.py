@@ -217,6 +217,13 @@ async def test_flood_sheds_video_keeps_audio_and_recovers():
     flood_ticks = 40
     for tick in range(flood_ticks):
         await one_tick(tick, video_pkts=4)
+        if tick == 19:
+            # The ladder is at L4 by ~tick 12: every actuator (policer,
+            # shed caps, pause) has fired and compiled its paths. The
+            # rest of the flood and the whole recovery must then hold
+            # the jit cache — shedding is a data change, not a shape
+            # change (recompile watchdog, GC11 runtime half).
+            rt.mark_warm()
 
     # Ladder climbed in order, one rung per 3-tick streak, to L4.
     ups = [(t["from"], t["to"]) for t in gov.transitions]
@@ -258,6 +265,9 @@ async def test_flood_sheds_video_keeps_audio_and_recovers():
     assert len(uniq) == flood_ticks + recovery_ticks
     assert len(audio_sns) == len(uniq)
     assert all(b - a == 1 for a, b in zip(uniq, uniq[1:]))
+
+    # Governor actuation up AND down the ladder never retraced the tick.
+    assert rt.compile_ledger.post_warmup == 0
 
 
 # -- supervisor interaction: governed lateness is not a stall ---------------

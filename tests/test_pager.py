@@ -449,6 +449,12 @@ async def test_compaction_preserves_live_room_state():
     _push(prt, 5)
     res = await prt.step_once()
     assert res.fwd_packets > 0
+    # Recompile watchdog: the first post-compaction tick above paid any
+    # new pow2-bucket compiles; steady state on the compacted layout
+    # must then hold the cache (zero XLA compiles per tick).
+    prt.mark_warm()
+    await _run_ticks(prt, 3, start=6)
+    assert prt.compile_ledger.post_warmup == 0
 
 
 async def test_grow_on_join_across_page_boundary():
@@ -477,9 +483,15 @@ async def test_grow_on_join_across_page_boundary():
     assert prt.pager.extent(0) == (2, 8)
     prt.set_subscription(0, 0, 6, subscribed=True)
     fwd = 0
-    for t in range(4, 8):
+    # First tick on the grown extent pays the new pow2 bucket's compile;
+    # after that the watchdog must see a held cache (GC11 runtime half).
+    res = await tick(4)
+    fwd += res.fwd_packets
+    prt.mark_warm()
+    for t in range(5, 8):
         res = await tick(t)
         fwd += res.fwd_packets
+    assert prt.compile_ledger.post_warmup == 0
     assert fwd > 0
     assert prt.pager.stats()["grows"] == 1
 

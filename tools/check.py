@@ -1,13 +1,18 @@
 """graftcheck runner — the repo's pre-commit / tier-1 static gate.
 
-    python -m tools.check              # lint + compileall; exit 0 iff clean
+    python -m tools.check              # lint + devicecheck + compileall
     python -m tools.check --json       # findings as JSON on stdout
     python -m tools.check --baseline   # (re)write the committed baseline
+    python -m tools.check --resnapshot # rewrite the devicecheck contracts
 
 Exit codes: 0 clean, 1 findings (or compile errors), 2 stale baseline /
 config problems. The baseline may only shrink: a baselined finding that
 no longer reproduces must be removed from the baseline file, otherwise
-the run fails with the stale entries listed.
+the run fails with the stale entries listed. The same shrink-only
+contract covers inline suppressions (a `# graftcheck: disable=` that no
+longer suppresses anything is itself a finding) and the devicecheck
+contract baseline (a registered entry that disappears, or a committed
+contract the live tree no longer matches, fails the run).
 """
 
 from __future__ import annotations
@@ -40,6 +45,14 @@ def main(argv: list[str] | None = None) -> int:
                          "traced run (telemetry/trace_export --selftest)")
     ap.add_argument("--rules", default=None,
                     help="comma-separated subset, e.g. GC01,GC04")
+    ap.add_argument("--no-devicecheck", action="store_true",
+                    help="skip the abstract-eval compile-contract pass "
+                         "(eval_shape + jaxpr audit of the @device_entry "
+                         "registry; needs jax importable)")
+    ap.add_argument("--resnapshot", action="store_true",
+                    help="rewrite tools/devicecheck_baseline.json from "
+                         "the live tree (the sanctioned way to land an "
+                         "intentional contract change)")
     args = ap.parse_args(argv)
 
     sys.path.insert(0, str(REPO_ROOT))
@@ -62,7 +75,9 @@ def main(argv: list[str] | None = None) -> int:
             print(f"unknown rules: {', '.join(bad)}", file=sys.stderr)
             return 2
     project = load_project(REPO_ROOT, config.paths)
-    findings = run_all(project, config, rules)
+    stale_suppressions: list[core.Finding] = []
+    findings = run_all(project, config, rules,
+                       stale_suppressions=stale_suppressions)
 
     baseline_path = REPO_ROOT / config.baseline
     if args.baseline:
@@ -72,6 +87,34 @@ def main(argv: list[str] | None = None) -> int:
         return 0
 
     new, stale = diff_baseline(findings, load_baseline(baseline_path), project)
+    # Stale inline suppressions ride the same shrink-only contract as
+    # the baseline: a disable= that suppresses nothing must go.
+    new = list(new) + stale_suppressions
+
+    # Abstract-eval compile contracts over the @device_entry registry
+    # (eval_shape + jaxpr cost + donation audit at canonical dims).
+    device_findings: list[core.Finding] = []
+    device_stale: list[str] = []
+    device_s = 0.0
+    if not args.no_devicecheck:
+        try:
+            import jax  # noqa: F401  (pay the import before the timer)
+
+            from livekit_server_tpu.analysis import devicecheck
+        except ImportError as exc:   # jax absent: the AST gates still ran
+            print(f"devicecheck: skipped (jax unavailable: {exc})",
+                  file=sys.stderr)
+            devicecheck = None
+        if devicecheck is not None:
+            d0 = time.perf_counter()
+            device_findings, device_stale = devicecheck.run_check(
+                REPO_ROOT, resnapshot=args.resnapshot
+            )
+            device_s = time.perf_counter() - d0
+        if args.resnapshot:
+            print(f"devicecheck baseline rewritten "
+                  f"({device_s:.2f}s) -> tools/devicecheck_baseline.json")
+        new.extend(device_findings)
 
     # Bytecode-compile the tree: catches syntax errors in files the
     # analyzers never import (plugins, dead branches) — cheap and total.
@@ -152,6 +195,7 @@ def main(argv: list[str] | None = None) -> int:
         print(json.dumps({
             "findings": [vars(f) for f in new],
             "stale_baseline": stale,
+            "stale_device_contracts": device_stale,
             "compile_ok": bool(compiled_ok),
             "native_failures": native_failures,
         }, indent=1))
@@ -161,19 +205,24 @@ def main(argv: list[str] | None = None) -> int:
         for e in stale:
             print(f"STALE baseline entry (fixed? remove it): "
                   f"{e.get('rule')} {e.get('path')}: {e.get('content')}")
+        for name in device_stale:
+            print(f"STALE device contract (entry gone? --resnapshot): "
+                  f"{name}")
         if not compiled_ok:
             print("compileall: errors (see above)")
         for msg in native_failures:
             print(f"native: {msg}")
         dt = time.perf_counter() - t0
-        ok = not (new or stale or native_failures) and compiled_ok
+        ok = not (new or stale or device_stale or native_failures) \
+            and compiled_ok
         status = "clean" if ok else "FAILED"
         print(f"graftcheck: {len(new)} finding(s), {len(stale)} stale "
-              f"baseline entr(ies), {len(native_failures)} native "
-              f"failure(s), {len(project.files)} files in "
-              f"{dt:.2f}s — {status}")
+              f"baseline entr(ies), {len(device_stale)} stale device "
+              f"contract(s), {len(native_failures)} native failure(s), "
+              f"{len(project.files)} files in {dt:.2f}s "
+              f"(devicecheck {device_s:.2f}s) — {status}")
 
-    if stale:
+    if stale or device_stale:
         return 2
     if new or not compiled_ok or native_failures:
         return 1
