@@ -100,6 +100,20 @@ def _on_kill(signum, frame):  # noqa: ARG001
         os._exit(0)
 
 
+def absorb_twin_json(stdout: str) -> dict:
+    """Parse a twin subprocess's stdout under the last-line-JSON contract:
+    the child may print anything, but its result is the LAST line that
+    starts with `{` (both the CPU wire twin and the fleet traffic twin
+    emit incrementally, so a timeout kill loses at most the step in
+    flight). Raises ValueError when no JSON line survived — the caller
+    records that as the section error rather than crashing the bench."""
+    lines = [ln for ln in (stdout or "").strip().splitlines()
+             if ln.startswith("{")]
+    if not lines:
+        raise ValueError("twin produced no JSON")
+    return json.loads(lines[-1])
+
+
 # -- device throughput ------------------------------------------------------
 
 def device_bench(dims, spec, ticks: int, warmup: int) -> dict:
@@ -1018,11 +1032,7 @@ def main() -> None:
         t_sec = time.perf_counter()
 
         def _absorb_twin(stdout: str) -> None:
-            lines = [ln for ln in (stdout or "").strip().splitlines()
-                     if ln.startswith("{")]
-            if not lines:
-                raise ValueError("twin produced no JSON")
-            twin = json.loads(lines[-1])
+            twin = absorb_twin_json(stdout)
             RESULT["wire_local"] = twin.get("wire")
             RESULT["wire_local_tick2"] = twin.get("wire_tick2")
             RESULT["wire_local_express"] = twin.get("wire_tick2e")
@@ -1087,6 +1097,43 @@ def main() -> None:
         except Exception as e:  # noqa: BLE001
             RESULT["wire_local_error"] = f"{type(e).__name__}: {e}"
         section_done("wire_local", t_sec)
+
+    # -- fleet traffic twin (capacity/SLO envelope) -----------------------
+    # Deterministic production-shaped load (runtime/traffic_twin): diurnal
+    # churn + flash crowd + rolling drain replayed across a 2-node bus,
+    # swept over >= 4 offered-load multipliers. Runs as an XLA:CPU
+    # subprocess — the twin drives virtual time through the full
+    # admission → governor → plane → egress stack, so it measures
+    # robustness SLOs (admission rate, audio continuity, rung residency,
+    # recovery ticks), not device speed. The child emits a partial curve
+    # after every load step, so a timeout kill salvages the completed
+    # steps via the same last-line-JSON contract as the wire twin.
+    if section_ok("fleet_twin", 90):
+        import subprocess
+
+        t_sec = time.perf_counter()
+        try:
+            twin_budget = max(min(_remaining() - 20, 240), 60)
+            cp = subprocess.run(
+                [sys.executable, "-m",
+                 "livekit_server_tpu.runtime.traffic_twin",
+                 "--seed", "20", "--ticks", "60", "--nodes", "2",
+                 "--loads", "0.5,1.0,2.0,4.0"],
+                capture_output=True, text=True, timeout=twin_budget,
+                env={**os.environ, "JAX_PLATFORMS": "cpu"},
+            )
+            RESULT["fleet_twin"] = absorb_twin_json(cp.stdout)
+        except subprocess.TimeoutExpired as e:
+            RESULT["fleet_twin_error"] = "TimeoutExpired"
+            try:
+                out = e.stdout
+                RESULT["fleet_twin"] = absorb_twin_json(
+                    out.decode() if isinstance(out, bytes) else out)
+            except Exception:  # noqa: BLE001
+                pass
+        except Exception as e:  # noqa: BLE001
+            RESULT["fleet_twin_error"] = f"{type(e).__name__}: {e}"
+        section_done("fleet_twin", t_sec)
 
     # -- BASELINE.md ladder (device throughput) ---------------------------
     ladder = {
@@ -1631,6 +1678,26 @@ def main() -> None:
         summary["wire_ramp_max_rooms_ok"] = RESULT["wire_ramp"].get(
             "max_rooms_ok", 0
         )
+    # Capacity/SLO curve from the fleet traffic twin: one row per
+    # offered-load step with the headline robustness SLOs, plus the knee
+    # (first load where admission dips below ~100%).
+    if "fleet_twin" in RESULT:
+        ft = RESULT["fleet_twin"]
+        summary["fleet_twin"] = {
+            "capacity_knee_load": ft.get("capacity_knee_load"),
+            "steps": [
+                {
+                    "load": s.get("offered_load"),
+                    "admission_rate": s.get("admission_rate"),
+                    "audio_continuity": s.get("audio_continuity"),
+                    "dup_wire_packets": s.get("dup_wire_packets"),
+                    "wire_p99_ms": s.get("wire_p99_ms"),
+                    "rung_residency": s.get("rung_residency"),
+                    "recovery_ticks": s.get("recovery_ticks"),
+                }
+                for s in ft.get("steps", [])
+            ],
+        }
     # Sampled wire-latency stage decomposition (flight-recorder plane):
     # p50/p99 per stage from the preferred wire section that ran.
     for wk in ("wire_local", "wire"):

@@ -17,6 +17,7 @@ from livekit_server_tpu.config.config import (
     RegionConfig,
     RoomConfig,
     RTCConfig,
+    TwinConfig,
     generate_cli_flags,
     load_config,
 )
@@ -32,6 +33,7 @@ __all__ = [
     "RegionConfig",
     "RoomConfig",
     "RTCConfig",
+    "TwinConfig",
     "generate_cli_flags",
     "load_config",
 ]

@@ -438,6 +438,25 @@ class TraceConfig:
 
 
 @dataclass
+class TwinConfig:
+    """Traffic-twin scenario knobs (runtime/traffic_twin.py): the
+    deterministic fleet-scale load harness behind `bench.py fleet_twin`
+    and `tools/check --twin-smoke`. All randomness derives from `seed`;
+    two runs with the same knobs produce byte-identical event timelines
+    and identical counter-derived SLO numbers."""
+
+    enabled: bool = False        # opt-in: the twin is a harness, not a serving path
+    seed: int = 20
+    nodes: int = 2               # fleet size replayed against (>=2 for drain)
+    ticks: int = 120             # scenario length in virtual ticks
+    # Offered-load multipliers for the capacity/SLO curve (>= 4 steps).
+    loads: list[float] = field(default_factory=lambda: [0.5, 1.0, 2.0, 4.0])
+    video_room_frac: float = 0.4  # codec mix: P(room publishes video)
+    probe_every: int = 2          # every Nth admitted room carries SLO probes
+    wire_probes: int = 0          # real UDP probe subscribers (wire p99 feed)
+
+
+@dataclass
 class Config:
     """Top-level server config (pkg/config/config.go Config)."""
 
@@ -464,6 +483,7 @@ class Config:
     migration: MigrationConfig = field(default_factory=MigrationConfig)
     trace: TraceConfig = field(default_factory=TraceConfig)
     fleet: FleetConfig = field(default_factory=FleetConfig)
+    twin: TwinConfig = field(default_factory=TwinConfig)
 
 
 _SCALARS = (int, float, str, bool)
@@ -724,3 +744,22 @@ def _validate(cfg: Config) -> None:
     for name in ("ring_ticks", "sample_every", "blackbox_events"):
         if getattr(tr, name) <= 0:
             raise ConfigError(f"trace.{name} must be positive")
+    tw = cfg.twin
+    for name in ("nodes", "ticks", "probe_every"):
+        if getattr(tw, name) <= 0:
+            raise ConfigError(f"twin.{name} must be positive")
+    if tw.seed < 0:
+        raise ConfigError(f"twin.seed must be >= 0, got {tw.seed}")
+    if tw.wire_probes < 0:
+        raise ConfigError(f"twin.wire_probes must be >= 0, got {tw.wire_probes}")
+    if not 0.0 <= tw.video_room_frac <= 1.0:
+        raise ConfigError(
+            f"twin.video_room_frac must be in [0, 1], got {tw.video_room_frac}"
+        )
+    if any(float(x) <= 0 for x in tw.loads):
+        raise ConfigError("twin.loads must all be positive multipliers")
+    if tw.enabled and len(tw.loads) < 4:
+        raise ConfigError(
+            "twin.loads needs >= 4 offered-load steps for the capacity/SLO "
+            f"curve, got {len(tw.loads)}"
+        )

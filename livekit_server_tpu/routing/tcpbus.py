@@ -28,6 +28,7 @@ import asyncio
 import fnmatch
 import hmac
 import json
+import random
 from collections import deque
 from typing import Any
 
@@ -242,7 +243,8 @@ class TCPBusClient:
     CALL_TIMEOUT_S = 10.0  # per-attempt; a bus that accepts but never answers
 
     def __init__(self, reader: asyncio.StreamReader, writer: asyncio.StreamWriter,
-                 host: str = "", port: int = 0, token: str = ""):
+                 host: str = "", port: int = 0, token: str = "",
+                 jitter_seed: int | None = None):
         self._reader = reader
         self._writer = writer
         self._host, self._port, self._token = host, port, token
@@ -256,6 +258,11 @@ class TCPBusClient:
         self.reconnects = 0
         self.retries = 0  # call-level retry count (telemetry gauge feed)
         self._dial_backoff = BackoffPolicy(base=0.05, max_delay=self.RECONNECT_MAX_S)
+        # Full-jitter reconnect is default-on, with a PER-CLIENT rng: a
+        # fleet re-dialing after a regional cut must de-correlate, and a
+        # shared module-level rng would give chaos drills no seam to
+        # seed. `jitter_seed` pins the stream for reproducible storms.
+        self._dial_rng = random.Random(jitter_seed)
         # Hard-down bus: after 8 straight failed dials, stop hammering and
         # probe once per cooldown instead.
         self._dial_breaker = CircuitBreaker(threshold=8, cooldown_s=self.RECONNECT_MAX_S)
@@ -264,12 +271,14 @@ class TCPBusClient:
         self._call_policy = BackoffPolicy(base=0.05, max_delay=0.5, max_attempts=4)
 
     @classmethod
-    async def connect(cls, host: str, port: int, token: str = "") -> "TCPBusClient":
+    async def connect(cls, host: str, port: int, token: str = "",
+                      jitter_seed: int | None = None) -> "TCPBusClient":
         # Initial dial fails fast by design — the caller decides whether a
         # reachable bus is a boot requirement; only the established client
         # owns the reconnect policy.
         reader, writer = await asyncio.open_connection(host, port)  # graftcheck: disable=GC04
-        client = cls(reader, writer, host=host, port=port, token=token)
+        client = cls(reader, writer, host=host, port=port, token=token,
+                     jitter_seed=jitter_seed)
         if token:
             await client._call("auth", token)
         return client
@@ -357,6 +366,7 @@ class TCPBusClient:
                 breaker=self._dial_breaker,
                 wait_when_open=True,
                 should_abort=lambda: self.closed,
+                rng=self._dial_rng,
             )
         except RetryAborted:
             return False

@@ -208,18 +208,26 @@ class OverloadGovernor:
 
     # -- admission (L4) ---------------------------------------------------
     def should_admit(self, kind: str) -> bool:
-        """Node admission gate for new work ('room' / 'join' / 'publish').
-        Existing sessions — including resumes — are never evicted by the
-        governor; only NEW load is refused, and only at L4.
+        """Node admission gate for new work ('room' / 'join' / 'publish')
+        and failover adoption ('restore'). Existing sessions — including
+        resumes — are never evicted by the governor; only NEW load is
+        refused, and only at L4. A 'restore' is NOT new load: the fleet
+        already admitted that room and its participants before their node
+        died, so the transient ladder never refuses it — on a busy fleet
+        an L4 gate here would orphan rooms permanently, exactly when a
+        flash crowd makes the survivors late. Restores still stop on
+        drain_hold (this node is leaving) and on hard plane headroom.
 
         Room admission is additionally keyed on REAL plane headroom, not
         row count: `occupancy()["admittable_rooms"]` folds in the page
         pool on a paged runtime (free pages / min room footprint), so a
         fragmented or page-exhausted pool refuses rooms even while room
         rows remain — and a dense runtime degrades to the row check."""
-        if self.drain_hold or self.level >= L_REJECT:
+        if self.drain_hold:
             return False
-        if kind == "room":
+        if kind != "restore" and self.level >= L_REJECT:
+            return False
+        if kind in ("room", "restore"):
             occ = self.runtime.occupancy()
             if occ.get("admittable_rooms", 1) <= 0:
                 return False

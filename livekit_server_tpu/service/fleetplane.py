@@ -202,7 +202,12 @@ class FailoverOrchestrator:
                         self.stats["claim_losses"] += 1
                         continue   # raced a restorer across a lock lapse
                     try:
-                        await self.mgr.get_or_create_room(name)
+                        # 'restore' admission: the fleet already admitted
+                        # this room — a survivor at L4 must still adopt it
+                        # (hard gates only; see governor.should_admit).
+                        await self.mgr.get_or_create_room(
+                            name, admission_kind="restore"
+                        )
                         won = True
                     except CapacityError:
                         # Claimed but cannot host. Keep the bumped epoch

@@ -44,6 +44,21 @@ from livekit_server_tpu.utils import ids
 # cannot be resurrected much later from a stale row image.
 CHECKPOINT_TTL_S = 30.0
 
+# Canonical admission-denial causes for telemetry and the traffic twin:
+# every human-readable refusal string from _admission_denied rolls up to
+# one of overload | draining | no_capacity | fenced, so dashboards and
+# twin runs can attribute rejected joins without string-matching prose.
+DENIAL_REASON_LABELS = {
+    "node fenced (quorum lost)": "fenced",
+    "node draining": "draining",
+    "no plane capacity for a new room": "no_capacity",
+    "node overloaded": "overload",
+    "max rooms on node": "no_capacity",
+    "max tracks on node": "no_capacity",
+    "node ingress packet rate exceeded": "overload",
+    "node ingress byte rate exceeded": "overload",
+}
+
 
 class RoomManager:
     def __init__(
@@ -172,6 +187,10 @@ class RoomManager:
         # supervisor reads runtime.governor for its stall grace.
         self.governor = None
         self.admission_rejected: dict[str, int] = {}
+        # Same refusals keyed by canonical cause (overload | draining |
+        # no_capacity | fenced) — the twin and telemetry attribute
+        # rejected joins by WHY, not just by kind.
+        self.admission_denied_reasons: dict[str, int] = {}
         if config.limits.governor_enabled:
             from livekit_server_tpu.runtime.governor import OverloadGovernor
 
@@ -230,7 +249,13 @@ class RoomManager:
         self._update_node_stats()
 
     # -- room lifecycle ---------------------------------------------------
-    async def get_or_create_room(self, name: str, info: pm.RoomInfo | None = None) -> Room:
+    async def get_or_create_room(
+        self, name: str, info: pm.RoomInfo | None = None,
+        *, admission_kind: str = "room",
+    ) -> Room:
+        # admission_kind: 'room' for client-driven creates; 'restore' when
+        # the failover orchestrator re-homes a dead node's room (same hard
+        # gates, exempt from the governor's transient overload ladder).
         room = self.rooms.get(name)
         if room is not None:
             return room
@@ -244,7 +269,7 @@ class RoomManager:
             room = self.rooms.get(name)
             if room is not None:
                 return room
-            reason = self._admission_denied("room")
+            reason = self._admission_denied(admission_kind)
             if reason:
                 raise CapacityError(reason)
             stored = await self.store.load_room(name)
@@ -491,10 +516,15 @@ class RoomManager:
 
     def _admission_denied(self, kind: str) -> str:
         """Non-empty rejection reason when the node must refuse new work
-        of `kind` ('room' / 'join' / 'publish') — the config.go
-        LimitConfig seat plus the governor's L4. Every refusal is
-        explicit (signal response) and counted; existing sessions are
-        never evicted by any of these gates."""
+        of `kind` ('room' / 'join' / 'publish'), or a failover adoption
+        ('restore') — the config.go LimitConfig seat plus the governor's
+        L4. Every refusal is explicit (signal response) and counted;
+        existing sessions are never evicted by any of these gates. A
+        'restore' passes the same hard gates as 'room' (fenced, draining,
+        plane headroom, max_rooms) but never the transient overload
+        ladder — the fleet already admitted that room before its node
+        died, and refusing its restore on a busy survivor would orphan
+        it permanently (governor.should_admit carries the carve-out)."""
         lim = self.config.limits
         st = self.router.local_node.stats
         reason = ""
@@ -507,7 +537,7 @@ class RoomManager:
             # Drain works with the governor disabled too: the orchestrator
             # itself refuses every admission kind while rooms move off.
             reason = "node draining"
-        elif kind == "room" and (
+        elif kind in ("room", "restore") and (
             self.runtime.occupancy().get("admittable_rooms", 1) <= 0
         ):
             # Real plane headroom (paged: free pages / min room footprint;
@@ -516,7 +546,9 @@ class RoomManager:
             reason = "no plane capacity for a new room"
         elif self.governor is not None and not self.governor.should_admit(kind):
             reason = "node overloaded"
-        elif kind == "room" and lim.max_rooms and len(self.rooms) >= lim.max_rooms:
+        elif kind in ("room", "restore") and (
+            lim.max_rooms and len(self.rooms) >= lim.max_rooms
+        ):
             reason = "max rooms on node"
         elif kind == "publish" and lim.num_tracks and (
             sum(len(r.tracks) for r in self.rooms.values()) >= lim.num_tracks
@@ -532,6 +564,10 @@ class RoomManager:
             reason = "node ingress byte rate exceeded"
         if reason:
             self.admission_rejected[kind] = self.admission_rejected.get(kind, 0) + 1
+            label = DENIAL_REASON_LABELS.get(reason, "overload")
+            self.admission_denied_reasons[label] = (
+                self.admission_denied_reasons.get(label, 0) + 1
+            )
             if self.governor is not None:
                 self.governor.note_rejection(kind)
             self.log.warn("admission refused", kind=kind, reason=reason)
@@ -920,7 +956,10 @@ class RoomManager:
             if self.udp is not None:
                 self.telemetry.observe_transport(self.udp.stats)
             if self.governor is not None:
-                self.telemetry.observe_overload(self.governor.stats_dict())
+                self.telemetry.observe_overload({
+                    **self.governor.stats_dict(),
+                    "denied_reasons": dict(self.admission_denied_reasons),
+                })
             if self.integrity is not None:
                 self.telemetry.observe_integrity(self.integrity_stats())
             self.telemetry.observe_egress(self.runtime.egress_plane.observe())

@@ -318,6 +318,7 @@ class LivekitServer:
                     "dropped_policed": ing.dropped_policed,
                 },
                 "admission_rejected": dict(rm.admission_rejected),
+                "admission_denied_reasons": dict(rm.admission_denied_reasons),
                 "queue_drops": {
                     "signal_channel": MessageChannel.total_dropped,
                     "bus_subscription": Subscription.total_dropped,

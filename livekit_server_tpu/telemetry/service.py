@@ -175,6 +175,13 @@ class TelemetryService:
             self.set_gauge(
                 "livekit_admission_rejected_total", n, kind=str(kind)
             )
+        # The same refusals keyed by canonical cause (roommanager
+        # DENIAL_REASON_LABELS: overload | draining | no_capacity |
+        # fenced) — twin runs attribute rejected joins by this series.
+        for reason, n in snap.get("denied_reasons", {}).items():
+            self.set_gauge(
+                "livekit_admission_denied_total", n, reason=str(reason)
+            )
 
     def observe_integrity(self, snap: dict[str, Any]) -> None:
         """State-integrity plane (runtime/integrity.py stats_dict +

@@ -21,11 +21,17 @@ T = TypeVar("T")
 
 @dataclass(frozen=True)
 class BackoffPolicy:
-    """Exponential backoff with full jitter.
+    """Exponential backoff with full jitter (default on).
 
-    delay(n) ~ uniform(0, min(base * mult^n, max_delay)) — full jitter
-    decorrelates a fleet of clients re-dialing the same dead bus, where
-    the old deterministic ladder had every node land on the same beat.
+    delay(n) ~ uniform(floor·cap, cap) with cap = min(base · mult^n,
+    max_delay) — the AWS architecture-blog full-jitter shape, floored at
+    `jitter_floor`·cap so a pathological draw cannot spin-dial at ~0 ms.
+    Full jitter decorrelates a fleet of clients re-dialing the same dead
+    bus after a regional cut: N clients draw independently across 90% of
+    the cap instead of landing on the same deterministic beat and
+    thundering the bus in synchronized waves. Pass a seeded
+    `random.Random` for reproducible chaos drills (each simulated client
+    gets its own seed; same seeds → byte-identical delay sequences).
     """
 
     base: float = 0.05
@@ -33,14 +39,14 @@ class BackoffPolicy:
     multiplier: float = 2.0
     max_attempts: int = 0        # 0 = unbounded
     jitter: bool = True
+    jitter_floor: float = 0.1    # fraction of cap a draw can never go below
 
     def delay(self, attempt: int, rng: random.Random | None = None) -> float:
         cap = min(self.base * (self.multiplier ** attempt), self.max_delay)
         if not self.jitter:
             return cap
         r = rng.random() if rng is not None else random.random()
-        # Floor at half the ceiling: pure full-jitter can draw ~0 and spin.
-        return cap * (0.5 + 0.5 * r)
+        return cap * (self.jitter_floor + (1.0 - self.jitter_floor) * r)
 
     def exhausted(self, attempt: int) -> bool:
         return bool(self.max_attempts) and attempt >= self.max_attempts

@@ -41,6 +41,14 @@ jax.config.update("jax_platforms", "cpu")
 import asyncio  # noqa: E402
 import inspect  # noqa: E402
 
+# Per-test ceiling (seconds) for async tests. The whole tier-1 suite must
+# fit one wall-clock budget, so a single wedged await must surface as ONE
+# failing test, not eat the entire run: asyncio.wait_for cancels the test
+# coroutine (its finally blocks still run teardown) and asyncio.run then
+# reaps whatever tasks the test leaked. No timing-sensitive test should
+# come anywhere near this — it is a hang backstop, not a perf budget.
+ASYNC_TEST_TIMEOUT_S = float(os.environ.get("LK_TEST_TIMEOUT_S", "180"))
+
 
 def pytest_pyfunc_call(pyfuncitem):
     fn = pyfuncitem.obj
@@ -49,7 +57,7 @@ def pytest_pyfunc_call(pyfuncitem):
             name: pyfuncitem.funcargs[name]
             for name in pyfuncitem._fixtureinfo.argnames
         }
-        asyncio.run(fn(**kwargs))
+        asyncio.run(asyncio.wait_for(fn(**kwargs), ASYNC_TEST_TIMEOUT_S))
         return True
     return None
 

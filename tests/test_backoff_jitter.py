@@ -1,0 +1,147 @@
+"""Full-jitter backoff (utils/backoff.py): bounds, seeded reproducibility,
+and the property the jitter exists for — N clients retrying concurrently
+against the same dead dependency DE-correlate instead of thundering in
+synchronized waves. Plus the tcpbus seam: every client's reconnect loop
+draws from its own seedable rng."""
+
+import asyncio
+import random
+
+import pytest
+
+from livekit_server_tpu.routing.tcpbus import BusServer, TCPBusClient
+from livekit_server_tpu.utils import backoff as backoff_mod
+from livekit_server_tpu.utils.backoff import BackoffPolicy, retry_async
+
+
+def test_full_jitter_bounds_and_cap():
+    p = BackoffPolicy(base=0.05, max_delay=5.0, multiplier=2.0)
+    rng = random.Random(3)
+    for attempt in range(12):
+        cap = min(0.05 * 2 ** attempt, 5.0)
+        d = p.delay(attempt, rng)
+        assert p.jitter_floor * cap <= d <= cap, (attempt, d, cap)
+    # Deep attempts saturate at max_delay, never beyond.
+    assert p.delay(50, rng) <= 5.0
+
+    ladder = BackoffPolicy(base=0.05, max_delay=5.0, jitter=False)
+    assert [ladder.delay(n) for n in range(4)] == [0.05, 0.1, 0.2, 0.4]
+
+
+def test_seeded_sequences_reproducible_and_decorrelated():
+    p = BackoffPolicy(base=0.05, max_delay=5.0)
+
+    def seq(seed: int) -> list[float]:
+        rng = random.Random(seed)
+        return [p.delay(n, rng) for n in range(6)]
+
+    seqs = [seq(100 + i) for i in range(8)]
+    # Same seed, byte-identical sequence — the chaos-drill contract.
+    assert seqs[0] == seq(100)
+    # Different seeds de-correlate: no two clients share a sequence, and
+    # at every attempt the fleet spreads instead of marching in step.
+    for i in range(8):
+        for j in range(i + 1, 8):
+            assert seqs[i] != seqs[j], (i, j)
+    for attempt in range(6):
+        draws = {s[attempt] for s in seqs}
+        assert len(draws) == 8, f"attempt {attempt} synchronized: {draws}"
+
+
+async def test_concurrent_retries_decorrelate(monkeypatch):
+    """Eight concurrent retry_async loops with fixed per-client seeds:
+    each sleeps a distinct jittered schedule, and a rerun with the same
+    seeds reproduces the schedules exactly."""
+
+    async def run_fleet() -> list[list[float]]:
+        recorded: dict[asyncio.Task, list[float]] = {}
+        real_sleep = asyncio.sleep
+
+        async def spy_sleep(delay, *a, **kw):
+            task = asyncio.current_task()
+            if task in recorded:
+                recorded[task].append(delay)
+                delay = 0
+            return await real_sleep(delay and 0)
+
+        monkeypatch.setattr(backoff_mod.asyncio, "sleep", spy_sleep)
+        try:
+            policy = BackoffPolicy(base=0.05, max_delay=5.0)
+
+            def client(i: int):
+                failures = [0]
+
+                async def fn() -> str:
+                    if failures[0] < 5:
+                        failures[0] += 1
+                        raise ConnectionError("bus down")
+                    return "up"
+
+                return retry_async(fn, policy, rng=random.Random(7000 + i))
+
+            tasks = [asyncio.ensure_future(client(i)) for i in range(8)]
+            for t in tasks:
+                recorded[t] = []
+            results = await asyncio.gather(*tasks)
+            assert results == ["up"] * 8
+            return [recorded[t] for t in tasks]
+        finally:
+            monkeypatch.setattr(backoff_mod.asyncio, "sleep", real_sleep)
+
+    schedules = await run_fleet()
+    assert all(len(s) == 5 for s in schedules)
+    for i in range(8):
+        for j in range(i + 1, 8):
+            assert schedules[i] != schedules[j], (i, j)
+    # Every retry wave spreads out — no synchronized thundering herd.
+    for wave in zip(*schedules):
+        assert len(set(wave)) == 8, wave
+
+    assert await run_fleet() == schedules, "same seeds must replay exactly"
+
+
+async def test_tcpbus_client_gets_seeded_dial_rng():
+    bus = BusServer()
+    await bus.start("127.0.0.1", 0)
+    try:
+        c1 = await TCPBusClient.connect("127.0.0.1", bus.port, jitter_seed=11)
+        c2 = await TCPBusClient.connect("127.0.0.1", bus.port, jitter_seed=12)
+        try:
+            # The reconnect loop's rng is per-client and seed-determined:
+            # seed 11 replays random.Random(11), and two clients with
+            # different seeds will draw different dial schedules.
+            draws1 = [c1._dial_rng.random() for _ in range(4)]
+            draws2 = [c2._dial_rng.random() for _ in range(4)]
+            ref = random.Random(11)
+            assert draws1 == [ref.random() for _ in range(4)]
+            assert draws1 != draws2
+            assert c1._dial_backoff.jitter  # full jitter is default-on
+        finally:
+            await c1.close()
+            await c2.close()
+    finally:
+        bus.close()
+
+
+async def test_reconnect_passes_client_rng(monkeypatch):
+    """The tcpbus reconnect path hands its seeded rng to retry_async —
+    the seam the fleet decorrelation rides on."""
+    bus = BusServer()
+    await bus.start("127.0.0.1", 0)
+    try:
+        c = await TCPBusClient.connect("127.0.0.1", bus.port, jitter_seed=42)
+        try:
+            seen = {}
+
+            async def spy_retry(fn, policy, **kwargs):
+                seen.update(kwargs)
+                return await fn()
+
+            import livekit_server_tpu.routing.tcpbus as tcpbus_mod
+            monkeypatch.setattr(tcpbus_mod, "retry_async", spy_retry)
+            assert await c._reconnect()
+            assert seen.get("rng") is c._dial_rng
+        finally:
+            await c.close()
+    finally:
+        bus.close()

@@ -353,6 +353,24 @@ async def test_max_rooms_rejection_and_debug_endpoint():
             assert j["admission_rejected"].get("room") == 1
             assert j["limits"]["max_rooms"] == 1
             assert "dropped_capacity" in j["ingest"]
+            # The same refusal, attributed to its canonical cause
+            # ("max rooms on node" → no_capacity).
+            assert j["admission_denied_reasons"].get("no_capacity") == 1
+
+            # The reason-labelled counter reaches the scrape endpoint
+            # once a tick's observe_overload has run.
+            deadline = asyncio.get_running_loop().time() + 5.0
+            while True:
+                async with s.get(
+                    f"http://127.0.0.1:{server.port}/metrics"
+                ) as r:
+                    text = await r.text()
+                if 'livekit_admission_denied_total{reason="no_capacity"} 1' \
+                        in text:
+                    break
+                assert asyncio.get_running_loop().time() < deadline, \
+                    "denied_total{reason} never reached /metrics"
+                await asyncio.sleep(0.02)
 
             await alice.close()
             await bob.close()
@@ -398,6 +416,45 @@ async def test_governor_l4_rejects_joins_and_publishes_over_wire():
             await alice.close()
             await bob.close()
             await carol.close()
+
+
+def test_failover_restore_bypasses_transient_overload_ladder():
+    """A 'restore' (failover adoption of a dead node's room) is existing
+    load the fleet already admitted — the transient L4 ladder must never
+    refuse it, or a busy fleet orphans rooms permanently exactly when a
+    flash crowd makes every survivor late. Hard gates still apply:
+    drain_hold stops restores (this node is leaving)."""
+    rt = make_rt()
+    gov = OverloadGovernor(rt, escalate_ticks=3, dwell_ticks=5)
+    rt.governor = gov
+    for _ in range(12):
+        gov.on_tick(HOT)
+    assert gov.level == gov_mod.L_REJECT
+    assert not gov.should_admit("room")
+    assert not gov.should_admit("join")
+    assert gov.should_admit("restore")
+    gov.hold_max()
+    assert not gov.should_admit("restore")
+    gov.release_hold()
+    assert gov.should_admit("restore")
+
+
+async def test_room_manager_restores_room_at_l4():
+    """End-to-end through get_or_create_room: at L4 a client-driven
+    create is refused with an explicit reason, while the failover
+    orchestrator's admission_kind='restore' create proceeds."""
+    from livekit_server_tpu.runtime import CapacityError
+
+    async with running_server() as server:
+        rm = server.room_manager
+        gov = rm.governor
+        assert gov is not None
+        gov._set_level(4, "test overload")
+        with pytest.raises(CapacityError, match="node overloaded"):
+            await rm.get_or_create_room("orphan")
+        room = await rm.get_or_create_room("orphan", admission_kind="restore")
+        assert room is rm.rooms["orphan"]
+        assert rm.admission_denied_reasons.get("overload", 0) == 1
 
 
 # -- ingest drop split + policer --------------------------------------------
@@ -607,7 +664,38 @@ def test_governor_telemetry_gauges():
     assert telem.gauges['livekit_admission_rejected_total{kind="join"}'] == 1
     assert telem.gauges["livekit_ingest_dropped_capacity_total"] == 0
 
+    # Reason-labelled denial breakdown (roommanager feeds this from
+    # admission_denied_reasons via _dispatch_tick).
+    telem.observe_overload({**gov.stats_dict(),
+                            "denied_reasons": {"overload": 3, "draining": 1}})
+    assert telem.gauges[
+        'livekit_admission_denied_total{reason="overload"}'] == 3
+    assert telem.gauges[
+        'livekit_admission_denied_total{reason="draining"}'] == 1
+
     snap = gov.snapshot()
     assert snap["level"] == 1
     assert snap["transitions"][0]["to"] == 1
     assert snap["thresholds"]["dwell_ticks"] == gov.dwell_ticks
+
+
+def test_denial_reason_labels_cover_every_refusal_string():
+    """Every human-readable refusal `_admission_denied` can produce maps
+    to one of the four canonical causes — an unmapped string would fall
+    back to `overload` and silently misattribute the denial."""
+    import inspect
+    import re
+
+    from livekit_server_tpu.service import roommanager
+    from livekit_server_tpu.service.roommanager import DENIAL_REASON_LABELS
+
+    assert set(DENIAL_REASON_LABELS.values()) <= {
+        "overload", "draining", "no_capacity", "fenced"
+    }
+    src = inspect.getsource(roommanager.RoomManager._admission_denied)
+    produced = set(re.findall(r'reason = "([^"]+)"', src))
+    assert produced, "refusal strings moved; update this scrape"
+    unmapped = produced - set(DENIAL_REASON_LABELS)
+    assert not unmapped, f"refusal strings without a canonical label: {unmapped}"
+    stale = set(DENIAL_REASON_LABELS) - produced
+    assert not stale, f"labels for refusals that no longer exist: {stale}"

@@ -1,0 +1,168 @@
+"""Traffic twin (runtime/traffic_twin.py): scenario DSL validation, the
+byte-identical-timeline determinism contract, a full same-seed replay
+equivalence check, the twin.* config knobs, and the bench last-line-JSON
+absorption contract shared by the wire twin and fleet_twin sections."""
+
+import json
+
+import pytest
+
+from livekit_server_tpu.config import ConfigError, load_config
+from livekit_server_tpu.runtime.traffic_twin import (
+    ChurnSegment,
+    Incident,
+    Scenario,
+    ScenarioError,
+    SizeClass,
+    TrafficTwin,
+    build_timeline,
+    scenario_from_config,
+    timeline_bytes,
+    validate_scenario,
+)
+
+BASE_YAML = "keys:\n  k: s\n"
+
+
+# -- scenario DSL -----------------------------------------------------------
+
+def test_default_scenarios_validate():
+    validate_scenario(Scenario())
+    validate_scenario(Scenario.micro())
+    validate_scenario(Scenario.standard())
+
+
+def test_scenario_rejects_bad_shapes():
+    good = Scenario.micro()
+    with pytest.raises(ScenarioError):
+        validate_scenario(Scenario(seed=1, segments=()))
+    with pytest.raises(ScenarioError):
+        validate_scenario(Scenario(
+            seed=1, segments=good.segments,
+            incidents=(Incident("meteor_strike", at=1, ticks=2),),
+        ))
+    with pytest.raises(ScenarioError):
+        # Incident anchored past the end of the timeline.
+        validate_scenario(Scenario(
+            seed=1, segments=(ChurnSegment(ticks=10, join_rate=1.0),),
+            incidents=(Incident("flash_crowd", at=50, ticks=2),),
+        ))
+    with pytest.raises(ScenarioError):
+        validate_scenario(Scenario(
+            seed=1, segments=good.segments,
+            incidents=(Incident("flash_crowd", at=1, ticks=2,
+                                magnitude=0.0),),
+        ))
+    with pytest.raises(ScenarioError):
+        # Size-class weights must carry probability mass.
+        validate_scenario(Scenario(
+            seed=1, segments=good.segments,
+            sizes=(SizeClass(0.0, 1, 2),),
+        ))
+
+
+def test_timeline_shape():
+    sc = Scenario.standard(seed=41, ticks=60)
+    events = build_timeline(sc, offered_load=1.0)
+    assert events, "standard scenario produced no traffic"
+    ticks = [e.tick for e in events]
+    assert ticks == sorted(ticks)
+    regions = {name for name, _ in sc.regions}
+    kinds = {"join", "leave", "reconnect", "incident_begin", "incident_end"}
+    for e in events:
+        assert e.kind in kinds
+        assert 0 <= e.tick < sc.total_ticks
+        if e.kind == "join":
+            assert e.region in regions
+            assert e.participants >= 1
+            # Codec mix: video rooms carry a codec, audio-only rooms opus.
+            assert e.codec != "" if e.video else e.codec == "opus"
+    assert any(e.kind == "incident_begin" for e in events)
+    assert any(e.kind == "reconnect" for e in events)
+
+
+# -- determinism contract ---------------------------------------------------
+
+def test_timeline_bytes_deterministic():
+    sc = Scenario.standard(seed=20, ticks=60)
+    b1 = timeline_bytes(build_timeline(sc, 2.0))
+    b2 = timeline_bytes(build_timeline(Scenario.standard(seed=20, ticks=60),
+                                       2.0))
+    assert b1 == b2, "same seed+load must be byte-identical"
+    assert b1 != timeline_bytes(
+        build_timeline(Scenario.standard(seed=21, ticks=60), 2.0)
+    ), "different seed must perturb the timeline"
+    assert b1 != timeline_bytes(build_timeline(sc, 4.0)), \
+        "offered load is part of the derivation"
+
+
+async def test_same_seed_runs_identical_slo_numbers():
+    """Two full replays at one seed agree on every counter-derived SLO
+    (deterministic_dict excludes the wall-clock members by design)."""
+    def make():
+        return TrafficTwin(
+            Scenario.micro(seed=23), nodes=1,
+            plane={"rooms": 8, "tracks_per_room": 4, "pkts_per_track": 8,
+                   "subs_per_room": 4, "tick_ms": 10},
+        )
+
+    rep1 = await make().run(1.0)
+    rep2 = await make().run(1.0)
+    assert rep1.deterministic_dict() == rep2.deterministic_dict()
+    assert rep1.joins_offered > 0
+    assert rep1.audio_expected > 0
+
+
+# -- twin.* config knobs ----------------------------------------------------
+
+def test_twin_config_knobs_and_validation():
+    cfg = load_config(yaml_text=BASE_YAML + (
+        "twin:\n  enabled: true\n  seed: 7\n  ticks: 40\n"
+        "  video_room_frac: 0.25\n"
+    ))
+    assert cfg.twin.seed == 7
+    sc = scenario_from_config(cfg.twin)
+    assert sc.seed == 7
+    assert sc.total_ticks == 40
+    assert sc.video_room_frac == 0.25
+
+    for frag in (
+        "twin:\n  nodes: 0\n",
+        "twin:\n  ticks: -3\n",
+        "twin:\n  probe_every: 0\n",
+        "twin:\n  video_room_frac: 1.5\n",
+        "twin:\n  loads: [1.0, -2.0, 3.0, 4.0]\n",
+        "twin:\n  enabled: true\n  loads: [1.0, 2.0]\n",
+        "twin:\n  no_such_knob: 1\n",
+    ):
+        with pytest.raises(ConfigError):
+            load_config(yaml_text=BASE_YAML + frag)
+
+
+# -- bench absorption contract ----------------------------------------------
+
+def test_bench_absorb_twin_last_json_line_wins():
+    from bench import absorb_twin_json
+
+    out = "\n".join([
+        "warmup chatter",
+        json.dumps({"steps": [1]}),
+        "progress: load x2.0",
+        json.dumps({"steps": [1, 2], "partial": True}),
+        json.dumps({"steps": [1, 2, 3], "capacity_knee_load": 2.0}),
+    ])
+    got = absorb_twin_json(out)
+    assert got["capacity_knee_load"] == 2.0
+    assert got["steps"] == [1, 2, 3]
+
+    # A killed child that emitted only a partial curve still salvages it.
+    partial = absorb_twin_json(out.rsplit("\n", 1)[0])
+    assert partial["partial"] is True
+
+
+def test_bench_absorb_twin_raises_without_json():
+    from bench import absorb_twin_json
+
+    for stdout in ("", "no json here\nstill none", None):
+        with pytest.raises(ValueError, match="twin produced no JSON"):
+            absorb_twin_json(stdout)

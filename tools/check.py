@@ -40,6 +40,9 @@ def main(argv: list[str] | None = None) -> int:
     ap.add_argument("--latency", action="store_true",
                     help="also run the slow express-lane latency smoke "
                          "(tests/test_latency_smoke.py; real sockets, ~30s)")
+    ap.add_argument("--twin-smoke", action="store_true",
+                    help="also run the ~2s traffic-twin micro-scenario "
+                         "end-to-end (runtime/traffic_twin.py --smoke)")
     ap.add_argument("--trace-schema", action="store_true",
                     help="also validate the trace-export schema on a tiny "
                          "traced run (telemetry/trace_export --selftest)")
@@ -189,6 +192,28 @@ def main(argv: list[str] | None = None) -> int:
             native_failures.append(
                 f"trace schema selftest failed "
                 f"(exit {proc.returncode}):\n{tail}"
+            )
+
+    # Opt-in traffic-twin smoke: the micro-scenario (one churn segment,
+    # one flash crowd) replayed end-to-end through a real single-node
+    # server in virtual time. Exit 0 requires zero audio gaps, zero
+    # duplicate wire packets, and at least one admitted join. Subprocess
+    # for the same hang-proofing as the latency smoke.
+    if args.twin_smoke:
+        import os
+        import subprocess
+
+        proc = subprocess.run(
+            [sys.executable, "-m",
+             "livekit_server_tpu.runtime.traffic_twin", "--smoke"],
+            cwd=REPO_ROOT, capture_output=True, text=True, timeout=120,
+            env={**os.environ, "JAX_PLATFORMS": os.environ.get(
+                "JAX_PLATFORMS", "cpu")},
+        )
+        if proc.returncode != 0:
+            tail = "\n".join((proc.stdout or "").splitlines()[-15:])
+            native_failures.append(
+                f"twin smoke failed (exit {proc.returncode}):\n{tail}"
             )
 
     if args.as_json:
